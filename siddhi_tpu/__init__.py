@@ -1,0 +1,31 @@
+"""siddhi_tpu — a TPU-native streaming SQL / complex event processing framework.
+
+Brand-new implementation of the capability surface of Siddhi 5.x
+(https://github.com/siddhi-io/siddhi; mounted read-only at /root/reference)
+re-architected for JAX/XLA: queries compile to pure functions over columnar
+event micro-batches `(state, batch) -> (state', outputs)`, partition keys
+shard across the TPU mesh, group-by aggregates run as segmented scans, and
+pattern NFAs advance as vectorized transitions.  See SURVEY.md.
+"""
+import jax
+
+# LONG attributes and epoch-ms timestamps need 64-bit ints (i32 overflows in
+# 2038 and on any epoch-ms value); XLA:TPU emulates s64.  DOUBLE still maps
+# to f32 on device (core/event.py) since TPUs have no f64.
+jax.config.update("jax_enable_x64", True)
+
+from .core.event import Event                                    # noqa: E402
+from .core.runtime import (                                      # noqa: E402
+    InputHandler,
+    QueryCallback,
+    SiddhiAppRuntime,
+    SiddhiManager,
+    StreamCallback,
+)
+from . import query_api                                          # noqa: E402
+
+__version__ = "0.1.0"
+__all__ = [
+    "Event", "InputHandler", "QueryCallback", "SiddhiAppRuntime",
+    "SiddhiManager", "StreamCallback", "query_api",
+]

@@ -1,0 +1,7 @@
+"""SiddhiQL compiler front-end (built in phase 3)."""
+
+
+class SiddhiCompiler:
+    @staticmethod
+    def parse(text: str):
+        raise NotImplementedError("SiddhiQL parser lands in phase 3")

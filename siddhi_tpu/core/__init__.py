@@ -1,0 +1,9 @@
+from . import event
+from .event import Event, EventBatch
+from .runtime import (
+    InputHandler,
+    QueryCallback,
+    SiddhiAppRuntime,
+    SiddhiManager,
+    StreamCallback,
+)

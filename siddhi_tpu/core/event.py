@@ -1,0 +1,329 @@
+"""Columnar event model — the TPU-native replacement for the reference's
+pooled linked-list event chunks.
+
+Reference (what, not how): CORE/event/stream/StreamEvent.java:37,
+CORE/event/ComplexEventChunk.java:32, CORE/event/Event.java. The reference
+pushes one pooled Java object at a time through processor chains; here an
+event micro-batch is a struct-of-arrays pytree with static shapes so each
+query step jit-compiles once per batch bucket and runs fully on device.
+
+Design:
+  * EventBatch: timestamps i64[B], kind i32[B] (CURRENT/EXPIRED/TIMER/RESET),
+    valid bool[B], and one fixed-dtype column per schema attribute.
+  * Strings are dictionary-encoded to int32 ids by a host-side interner
+    (per SiddhiManager), so string equality/group-by/partition-by are pure
+    integer ops on device.
+  * Batches are padded to bucket sizes (powers of 4) to bound the number of
+    XLA compilations.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..query_api.definition import AbstractDefinition, Attribute
+
+# Event kinds (reference: ComplexEvent.Type CURRENT/EXPIRED/TIMER/RESET)
+CURRENT = 0
+EXPIRED = 1
+TIMER = 2
+RESET = 3
+
+KIND_NAMES = {CURRENT: "CURRENT", EXPIRED: "EXPIRED", TIMER: "TIMER", RESET: "RESET"}
+
+# Attribute type -> on-device dtype.  DOUBLE maps to float32: TPU has no
+# native f64; parity tests use tolerances (see SURVEY.md §7 hard part (f)).
+# LONG is i64 (jax_enable_x64 is switched on in siddhi_tpu/__init__) because
+# epoch-millisecond timestamps overflow i32; XLA:TPU emulates s64.
+_DTYPES = {
+    "STRING": jnp.int32,   # interned id; -1 == null
+    "INT": jnp.int32,
+    "LONG": jnp.int64,
+    "FLOAT": jnp.float32,
+    "DOUBLE": jnp.float32,
+    "BOOL": jnp.bool_,
+    "OBJECT": jnp.int32,   # host-side object registry id
+}
+
+NULL_ID = -1  # interned id representing null string
+
+_BUCKETS = (8, 32, 128, 512, 2048, 8192, 32768, 131072, 524288)
+
+
+def bucket_size(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    raise ValueError(f"batch of {n} events exceeds max bucket {_BUCKETS[-1]}")
+
+
+def dtype_of(attr_type: str):
+    return _DTYPES[attr_type.upper()]
+
+
+def default_value(attr_type: str):
+    t = attr_type.upper()
+    if t in ("STRING", "OBJECT"):
+        return NULL_ID
+    if t == "BOOL":
+        return False
+    if t in ("FLOAT", "DOUBLE"):
+        return 0.0
+    return 0
+
+
+class StringInterner:
+    """Host-side dictionary encoder shared across an app's streams so ids are
+    comparable across streams/tables/joins."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._to_id: Dict[str, int] = {}
+        self._to_str: List[str] = []
+
+    def intern(self, s: Optional[str]) -> int:
+        if s is None:
+            return NULL_ID
+        got = self._to_id.get(s)
+        if got is not None:
+            return got
+        with self._lock:
+            got = self._to_id.get(s)
+            if got is None:
+                got = len(self._to_str)
+                self._to_str.append(s)
+                self._to_id[s] = got
+            return got
+
+    def lookup(self, i: int) -> Optional[str]:
+        if i < 0 or i >= len(self._to_str):
+            return None
+        return self._to_str[i]
+
+    def __len__(self):
+        return len(self._to_str)
+
+
+class ObjectRegistry:
+    """Host-side registry giving OBJECT attributes a device-representable id."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objs: List[Any] = []
+
+    def register(self, o: Any) -> int:
+        if o is None:
+            return NULL_ID
+        with self._lock:
+            self._objs.append(o)
+            return len(self._objs) - 1
+
+    def lookup(self, i: int) -> Any:
+        if i < 0 or i >= len(self._objs):
+            return None
+        return self._objs[i]
+
+
+class Event:
+    """Host-side event (reference: CORE/event/Event.java)."""
+
+    __slots__ = ("timestamp", "data")
+
+    def __init__(self, timestamp: int, data: Sequence[Any]):
+        self.timestamp = int(timestamp)
+        self.data = list(data)
+
+    def __repr__(self):
+        return f"Event({self.timestamp}, {self.data})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Event)
+            and self.timestamp == other.timestamp
+            and self.data == other.data
+        )
+
+
+class Schema:
+    """Runtime view of a definition: attribute order, dtypes, interner."""
+
+    def __init__(self, definition: AbstractDefinition, interner: StringInterner,
+                 objects: Optional[ObjectRegistry] = None):
+        self.definition = definition
+        self.id = definition.id
+        self.names: Tuple[str, ...] = tuple(definition.attribute_names)
+        self.types: Tuple[str, ...] = tuple(a.type for a in definition.attribute_list)
+        self.dtypes = tuple(dtype_of(t) for t in self.types)
+        self.interner = interner
+        self.objects = objects or ObjectRegistry()
+
+    def position(self, name: str) -> int:
+        return self.names.index(name)
+
+    def encode_value(self, attr_type: str, v: Any):
+        t = attr_type.upper()
+        if t == "STRING":
+            return self.interner.intern(v) if isinstance(v, str) or v is None else int(v)
+        if t == "OBJECT":
+            return self.objects.register(v)
+        if v is None:
+            return default_value(t)
+        if t == "BOOL":
+            return bool(v)
+        if t in ("FLOAT", "DOUBLE"):
+            return float(v)
+        return int(v)
+
+    def decode_value(self, attr_type: str, v):
+        t = attr_type.upper()
+        if t == "STRING":
+            return self.interner.lookup(int(v))
+        if t == "OBJECT":
+            return self.objects.lookup(int(v))
+        if t == "BOOL":
+            return bool(v)
+        if t in ("FLOAT", "DOUBLE"):
+            return float(v)
+        return int(v)
+
+
+@jax.tree_util.register_pytree_node_class
+class EventBatch:
+    """Struct-of-arrays event micro-batch (static shape [B])."""
+
+    def __init__(self, ts, kind, valid, cols: Tuple):
+        self.ts = ts          # i64[B]
+        self.kind = kind      # i32[B]
+        self.valid = valid    # bool[B]
+        self.cols = tuple(cols)
+
+    # -- pytree protocol --
+    def tree_flatten(self):
+        return ((self.ts, self.kind, self.valid, self.cols), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        ts, kind, valid, cols = children
+        return cls(ts, kind, valid, cols)
+
+    @property
+    def capacity(self) -> int:
+        return self.ts.shape[0]
+
+    def col(self, i: int):
+        return self.cols[i]
+
+    def with_cols(self, cols) -> "EventBatch":
+        return EventBatch(self.ts, self.kind, self.valid, tuple(cols))
+
+    def mask(self, keep) -> "EventBatch":
+        return EventBatch(self.ts, self.kind, jnp.logical_and(self.valid, keep), self.cols)
+
+    def with_kind(self, kind_value: int) -> "EventBatch":
+        return EventBatch(
+            self.ts, jnp.full_like(self.kind, kind_value), self.valid, self.cols
+        )
+
+    @staticmethod
+    def empty(schema: Schema, capacity: int) -> "EventBatch":
+        cols = tuple(
+            jnp.full((capacity,), default_value(t), dtype=d)
+            for t, d in zip(schema.types, schema.dtypes)
+        )
+        return EventBatch(
+            ts=jnp.zeros((capacity,), jnp.int64),
+            kind=jnp.zeros((capacity,), jnp.int32),
+            valid=jnp.zeros((capacity,), jnp.bool_),
+            cols=cols,
+        )
+
+
+def np_dtype(attr_type: str):
+    t = attr_type.upper()
+    if t in ("STRING", "OBJECT", "INT"):
+        return np.int32
+    if t == "LONG":
+        return np.int64
+    if t == "FLOAT":
+        return np.float32
+    if t == "DOUBLE":
+        return np.float32
+    return np.bool_
+
+
+class StagedBatch:
+    """Host (numpy) staging of a batch: used for group-key/partition-key slot
+    computation before the single host->device transfer."""
+
+    __slots__ = ("ts", "kind", "valid", "cols", "n")
+
+    def __init__(self, ts, kind, valid, cols, n):
+        self.ts, self.kind, self.valid, self.cols, self.n = ts, kind, valid, cols, n
+
+    def to_device(self, schema: Schema) -> EventBatch:
+        cols = tuple(jnp.asarray(c).astype(d)
+                     for c, d in zip(self.cols, schema.dtypes))
+        return EventBatch(jnp.asarray(self.ts), jnp.asarray(self.kind),
+                          jnp.asarray(self.valid), cols)
+
+
+def pack_np(schema: Schema, events: Sequence[Event],
+            kinds: Optional[Sequence[int]] = None,
+            capacity: Optional[int] = None) -> StagedBatch:
+    """Encode host events into padded numpy staging arrays."""
+    n = len(events)
+    cap = capacity if capacity is not None else bucket_size(max(n, 1))
+    ts = np.zeros((cap,), np.int64)
+    kind = np.zeros((cap,), np.int32)
+    valid = np.zeros((cap,), np.bool_)
+    raw_cols = [np.zeros((cap,), np_dtype(t)) for t in schema.types]
+    for i, e in enumerate(events):
+        ts[i] = e.timestamp
+        valid[i] = True
+        if kinds is not None:
+            kind[i] = kinds[i]
+        for j, (t, v) in enumerate(zip(schema.types, e.data)):
+            raw_cols[j][i] = schema.encode_value(t, v)
+    return StagedBatch(ts, kind, valid, raw_cols, n)
+
+
+def pack(schema: Schema, events: Sequence[Event],
+         kinds: Optional[Sequence[int]] = None,
+         capacity: Optional[int] = None) -> EventBatch:
+    """Encode host events into a padded columnar device batch."""
+    return pack_np(schema, events, kinds, capacity).to_device(schema)
+
+
+def timer_batch(schema: Schema, timestamp: int, capacity: int = 8) -> EventBatch:
+    """A batch containing a single TIMER row (reference: Scheduler timer events,
+    CORE/util/Scheduler.java:171)."""
+    b = EventBatch.empty(schema, capacity)
+    return EventBatch(
+        b.ts.at[0].set(timestamp),
+        b.kind.at[0].set(TIMER),
+        b.valid.at[0].set(True),
+        b.cols,
+    )
+
+
+def unpack(schema: Schema, batch: EventBatch,
+           want_kinds: Tuple[int, ...] = (CURRENT,)) -> List[Tuple[int, Event]]:
+    """Decode a device batch back to host [(kind, Event)] preserving order."""
+    ts = np.asarray(batch.ts)
+    kind = np.asarray(batch.kind)
+    valid = np.asarray(batch.valid)
+    cols = [np.asarray(c) for c in batch.cols]
+    out: List[Tuple[int, Event]] = []
+    for i in range(ts.shape[0]):
+        if not valid[i] or kind[i] == TIMER or kind[i] == RESET:
+            continue
+        if want_kinds is not None and int(kind[i]) not in want_kinds:
+            continue
+        data = [schema.decode_value(t, cols[j][i]) for j, t in enumerate(schema.types)]
+        out.append((int(kind[i]), Event(int(ts[i]), data)))
+    return out

@@ -1,0 +1,388 @@
+"""Expression compiler: SiddhiQL expression AST -> JAX column ops.
+
+This replaces the reference's interpreter-object executor trees
+(CORE/executor/ExpressionExecutor.java:27, the ~106 generated-style compare
+classes under CORE/executor/condition/compare/*, math executors under
+CORE/executor/math/*, and the giant type-dispatch in
+CORE/util/parser/ExpressionParser.java:224).  Instead of one Java object per
+AST node executing per event, we compile each expression once into a function
+over columnar environments; XLA fuses the result into the surrounding query
+step.  Filters become boolean masks, not control flow.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..query_api.expression import (
+    Add,
+    And,
+    AttributeFunction,
+    Compare,
+    Constant,
+    Divide,
+    Expression,
+    In,
+    IsNull,
+    Mod,
+    Multiply,
+    Not,
+    Or,
+    Subtract,
+    Variable,
+)
+from . import event as ev
+
+# numeric promotion order (reference: ExpressionParser type dispatch)
+_NUMERIC_ORDER = {"INT": 0, "LONG": 1, "FLOAT": 2, "DOUBLE": 3}
+NUMERIC_TYPES = set(_NUMERIC_ORDER)
+
+AGGREGATOR_NAMES = {
+    "sum", "avg", "count", "min", "max", "distinctCount", "stdDev",
+    "minForever", "maxForever", "and", "or", "unionSet",
+}
+
+
+class CompileError(Exception):
+    pass
+
+
+def promote(t1: str, t2: str) -> str:
+    if t1 not in _NUMERIC_ORDER or t2 not in _NUMERIC_ORDER:
+        raise CompileError(f"cannot apply arithmetic to {t1}/{t2}")
+    return max(t1, t2, key=lambda t: _NUMERIC_ORDER[t])
+
+
+@dataclasses.dataclass
+class CompiledExpr:
+    """fn(env) -> array; env is a dict scope_key -> tuple-of-column-arrays,
+    plus '__ts__:<key>' timestamp arrays and '__now__' scalar."""
+
+    fn: Callable[[Dict[str, Any]], Any]
+    type: str                      # result attribute type
+    is_constant: bool = False
+    constant_value: Any = None
+
+
+class Scope:
+    """Resolves Variable nodes to (scope_key, column_position, type).
+
+    scope keys: for single input streams there is one key (the stream id, and
+    its reference id if aliased).  Joins register both sides; patterns register
+    e1/e2/... reference ids.  `None`-qualified variables resolve through
+    `default_keys` in order (ambiguity is an error, as in the reference).
+    """
+
+    def __init__(self):
+        self._sources: Dict[str, "ev.Schema"] = {}
+        self._aliases: Dict[str, str] = {}
+        self.default_keys: List[str] = []
+        # pseudo-columns bound by the selector (aggregator outputs, projections)
+        self._bound: Dict[str, CompiledExpr] = {}
+
+    def add_source(self, key: str, schema: "ev.Schema",
+                   alias: Optional[str] = None, default: bool = True) -> None:
+        self._sources[key] = schema
+        if alias and alias != key:
+            self._aliases[alias] = key
+        if default:
+            self.default_keys.append(key)
+
+    def bind(self, name: str, compiled: CompiledExpr) -> None:
+        self._bound[name] = compiled
+
+    @property
+    def bound_names(self):
+        return self._bound
+
+    def schema(self, key: str) -> "ev.Schema":
+        key = self._aliases.get(key, key)
+        return self._sources[key]
+
+    def has_source(self, key: str) -> bool:
+        return key in self._sources or key in self._aliases
+
+    def resolve(self, var: Variable) -> Tuple[Optional[str], int, str]:
+        if var.stream_id is not None:
+            key = self._aliases.get(var.stream_id, var.stream_id)
+            if key not in self._sources:
+                raise CompileError(
+                    f"unknown stream reference {var.stream_id!r} for attribute "
+                    f"{var.attribute_name!r}")
+            schema = self._sources[key]
+            pos = schema.position(var.attribute_name)
+            return key, pos, schema.types[pos]
+        if var.attribute_name in self._bound:
+            return None, -1, self._bound[var.attribute_name].type
+        hits = []
+        for key in self.default_keys:
+            schema = self._sources[key]
+            if var.attribute_name in schema.names:
+                hits.append((key, schema))
+        if not hits:
+            raise CompileError(f"unknown attribute {var.attribute_name!r}")
+        if len(set(k for k, _ in hits)) > 1:
+            raise CompileError(
+                f"ambiguous attribute {var.attribute_name!r} (in "
+                f"{[k for k, _ in hits]})")
+        key, schema = hits[0]
+        pos = schema.position(var.attribute_name)
+        return key, pos, schema.types[pos]
+
+
+def _cast_to(x, t: str):
+    return x.astype(ev.dtype_of(t)) if hasattr(x, "astype") else jnp.asarray(
+        x, ev.dtype_of(t))
+
+
+def compile_expression(expr: Expression, scope: Scope) -> CompiledExpr:
+    """Recursively compile an expression tree to a column function."""
+    if isinstance(expr, Constant):
+        dtype = ev.dtype_of(expr.type)
+        if expr.type == "STRING":
+            # interned eagerly at compile time against the app interner so the
+            # id is a trace-time constant
+            interner = getattr(scope, "interner", None)
+            if interner is None:
+                raise CompileError("scope has no interner for string constant")
+            sid = jnp.asarray(interner.intern(expr.value), jnp.int32)
+            return CompiledExpr(lambda env, _v=sid: _v, "STRING", True,
+                                expr.value)
+        val = jnp.asarray(expr.value, dtype)
+        return CompiledExpr(lambda env, _v=val: _v, expr.type, True, expr.value)
+
+    if isinstance(expr, Variable):
+        key, pos, t = scope.resolve(expr)
+        if key is None:  # bound pseudo-column (aggregator output etc.)
+            inner = scope.bound_names[expr.attribute_name]
+            return CompiledExpr(inner.fn, inner.type)
+        def fn(env, _k=key, _p=pos):
+            return env[_k][_p]
+        return CompiledExpr(fn, t)
+
+    if isinstance(expr, (Add, Subtract, Multiply, Divide, Mod)):
+        l = compile_expression(expr.left, scope)
+        r = compile_expression(expr.right, scope)
+        t = promote(l.type, r.type)
+        dtype = ev.dtype_of(t)
+        op = {
+            Add: jnp.add, Subtract: jnp.subtract, Multiply: jnp.multiply,
+            Mod: jnp.mod,
+        }.get(type(expr))
+        if op is not None:
+            def fn(env, _l=l.fn, _r=r.fn, _op=op, _d=dtype):
+                return _op(_l(env).astype(_d), _r(env).astype(_d))
+            return CompiledExpr(fn, t)
+        # divide: integer types use truncating division toward zero (Java /)
+        if t in ("INT", "LONG"):
+            def fn(env, _l=l.fn, _r=r.fn, _d=dtype):
+                a = _l(env).astype(_d)
+                b = _r(env).astype(_d)
+                q = jnp.where(b == 0, jnp.zeros_like(a), a)  # guard div0
+                b = jnp.where(b == 0, jnp.ones_like(b), b)
+                return (jnp.sign(q) * jnp.sign(b) *
+                        (jnp.abs(q) // jnp.abs(b))).astype(_d)
+        else:
+            def fn(env, _l=l.fn, _r=r.fn, _d=dtype):
+                return _l(env).astype(_d) / _r(env).astype(_d)
+        return CompiledExpr(fn, t)
+
+    if isinstance(expr, Compare):
+        l = compile_expression(expr.left, scope)
+        r = compile_expression(expr.right, scope)
+        if l.type == "STRING" and r.type == "STRING":
+            if expr.operator not in ("==", "!="):
+                raise CompileError(
+                    "string ordering comparisons are not supported on device")
+        elif l.type == "BOOL" or r.type == "BOOL":
+            pass
+        else:
+            t = promote(l.type, r.type)
+        opf = {
+            "<": jnp.less, "<=": jnp.less_equal, ">": jnp.greater,
+            ">=": jnp.greater_equal, "==": jnp.equal, "!=": jnp.not_equal,
+        }[expr.operator]
+        def fn(env, _l=l.fn, _r=r.fn, _op=opf):
+            return _op(_l(env), _r(env))
+        return CompiledExpr(fn, "BOOL")
+
+    if isinstance(expr, And):
+        l = compile_expression(expr.left, scope)
+        r = compile_expression(expr.right, scope)
+        return CompiledExpr(
+            lambda env, _l=l.fn, _r=r.fn: jnp.logical_and(_l(env), _r(env)),
+            "BOOL")
+
+    if isinstance(expr, Or):
+        l = compile_expression(expr.left, scope)
+        r = compile_expression(expr.right, scope)
+        return CompiledExpr(
+            lambda env, _l=l.fn, _r=r.fn: jnp.logical_or(_l(env), _r(env)),
+            "BOOL")
+
+    if isinstance(expr, Not):
+        inner = compile_expression(expr.expression, scope)
+        return CompiledExpr(
+            lambda env, _i=inner.fn: jnp.logical_not(_i(env)), "BOOL")
+
+    if isinstance(expr, IsNull):
+        if expr.expression is None:
+            # isNull(stream) in patterns — handled by the pattern runtime
+            raise CompileError("stream-level is null only valid inside patterns")
+        inner = compile_expression(expr.expression, scope)
+        if inner.type in ("STRING", "OBJECT"):
+            return CompiledExpr(
+                lambda env, _i=inner.fn: _i(env) < 0, "BOOL")
+        return CompiledExpr(
+            lambda env, _i=inner.fn: jnp.zeros(jnp.shape(_i(env)), jnp.bool_),
+            "BOOL")
+
+    if isinstance(expr, In):
+        inner = compile_expression(expr.expression, scope)
+        def fn(env, _i=inner.fn, _src=expr.source_id):
+            probe = env["__in__:" + _src]
+            return probe(_i(env))
+        return CompiledExpr(fn, "BOOL")
+
+    if isinstance(expr, AttributeFunction):
+        return _compile_function(expr, scope)
+
+    raise CompileError(f"cannot compile expression node {type(expr).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Built-in scalar functions
+# (reference: CORE/executor/function/* — cast/convert/coalesce/ifThenElse/
+#  instanceOf*/maximum/minimum/default/eventTimestamp/currentTimeMillis/UUID)
+# ---------------------------------------------------------------------------
+
+def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
+    name = expr.name
+    full = f"{expr.namespace}:{name}" if expr.namespace else name
+    args = expr.parameters
+
+    if name in AGGREGATOR_NAMES and not expr.namespace:
+        raise CompileError(
+            f"aggregator {name!r} outside a select clause is not valid")
+
+    def carg(i):
+        return compile_expression(args[i], scope)
+
+    if full in ("cast", "convert"):
+        src = carg(0)
+        if not isinstance(args[1], Constant):
+            raise CompileError(f"{full}() target type must be a constant")
+        target = str(args[1].value).upper()
+        target = {"STRING": "STRING", "INT": "INT", "INTEGER": "INT",
+                  "LONG": "LONG", "FLOAT": "FLOAT", "DOUBLE": "DOUBLE",
+                  "BOOL": "BOOL", "BOOLEAN": "BOOL"}[target]
+        if target == "STRING" or src.type == "STRING":
+            if target == src.type:
+                return src
+            raise CompileError("string<->numeric cast requires host fallback")
+        d = ev.dtype_of(target)
+        return CompiledExpr(lambda env, _s=src.fn, _d=d: _s(env).astype(_d),
+                            target)
+
+    if full == "coalesce":
+        compiled = [carg(i) for i in range(len(args))]
+        t = compiled[0].type
+        if t in ("STRING", "OBJECT"):
+            def fn(env, _c=compiled):
+                out = _c[0].fn(env)
+                for c in _c[1:]:
+                    out = jnp.where(out < 0, c.fn(env), out)
+                return out
+            return CompiledExpr(fn, t)
+        return compiled[0]  # numerics carry no null mask
+
+    if full == "ifThenElse":
+        cond, then, els = carg(0), carg(1), carg(2)
+        t = then.type if then.type == els.type else promote(then.type, els.type)
+        d = ev.dtype_of(t)
+        def fn(env, _c=cond.fn, _t=then.fn, _e=els.fn, _d=d):
+            return jnp.where(_c(env), jnp.asarray(_t(env), _d),
+                             jnp.asarray(_e(env), _d))
+        return CompiledExpr(fn, t)
+
+    if full in ("maximum", "minimum"):
+        compiled = [carg(i) for i in range(len(args))]
+        t = compiled[0].type
+        for c in compiled[1:]:
+            t = promote(t, c.type)
+        d = ev.dtype_of(t)
+        red = jnp.maximum if full == "maximum" else jnp.minimum
+        def fn(env, _c=compiled, _d=d, _r=red):
+            out = jnp.asarray(_c[0].fn(env), _d)
+            for c in _c[1:]:
+                out = _r(out, jnp.asarray(c.fn(env), _d))
+            return out
+        return CompiledExpr(fn, t)
+
+    if full == "eventTimestamp":
+        def fn(env):
+            return env["__ts__"]
+        return CompiledExpr(fn, "LONG")
+
+    if full == "currentTimeMillis":
+        def fn(env):
+            return env["__now__"]
+        return CompiledExpr(fn, "LONG")
+
+    if full.startswith("instanceOf"):
+        target = {"instanceOfBoolean": "BOOL", "instanceOfString": "STRING",
+                  "instanceOfInteger": "INT", "instanceOfLong": "LONG",
+                  "instanceOfFloat": "FLOAT", "instanceOfDouble": "DOUBLE"}[full]
+        src = carg(0)
+        hit = src.type == target
+        def fn(env, _s=src.fn, _h=hit):
+            return jnp.full(jnp.shape(_s(env)), _h, jnp.bool_)
+        return CompiledExpr(fn, "BOOL")
+
+    if full == "default":
+        src, dflt = carg(0), carg(1)
+        if src.type in ("STRING", "OBJECT"):
+            def fn(env, _s=src.fn, _d=dflt.fn):
+                v = _s(env)
+                return jnp.where(v < 0, _d(env), v)
+            return CompiledExpr(fn, src.type)
+        return src
+
+    # math extension namespace (device-friendly subset)
+    _MATH = {
+        "math:abs": (jnp.abs, None), "math:ceil": (jnp.ceil, "DOUBLE"),
+        "math:floor": (jnp.floor, "DOUBLE"), "math:sqrt": (jnp.sqrt, "DOUBLE"),
+        "math:exp": (jnp.exp, "DOUBLE"), "math:ln": (jnp.log, "DOUBLE"),
+        "math:log10": (jnp.log10, "DOUBLE"), "math:sin": (jnp.sin, "DOUBLE"),
+        "math:cos": (jnp.cos, "DOUBLE"), "math:tan": (jnp.tan, "DOUBLE"),
+        "math:round": (jnp.round, None),
+    }
+    if full in _MATH:
+        f, rt = _MATH[full]
+        src = carg(0)
+        t = rt or src.type
+        d = ev.dtype_of(t)
+        return CompiledExpr(
+            lambda env, _s=src.fn, _f=f, _d=d: _f(_s(env)).astype(_d), t)
+    if full == "math:power":
+        a, b = carg(0), carg(1)
+        return CompiledExpr(
+            lambda env, _a=a.fn, _b=b.fn: jnp.power(
+                jnp.asarray(_a(env), jnp.float32),
+                jnp.asarray(_b(env), jnp.float32)), "DOUBLE")
+
+    # user-registered scalar extensions
+    reg = _extension_registry()
+    if full in reg:
+        impl = reg[full]
+        compiled = [carg(i) for i in range(len(args))]
+        return impl(compiled)
+
+    raise CompileError(f"unknown function {full!r}")
+
+
+def _extension_registry():
+    from .extension import scalar_function_registry
+    return scalar_function_registry()

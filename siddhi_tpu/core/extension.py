@@ -1,0 +1,36 @@
+"""Extension registry: decorator-based equivalent of the reference's
+@Extension + classpath scanning (modules/siddhi-annotations/.../Extension.java:56,
+CORE/util/SiddhiExtensionLoader.java:58).
+
+Extensions are registered explicitly (Python has no classpath scan):
+
+    @scalar_function("str:length", return_type="INT")
+    def str_length(args):  # args: list[CompiledExpr]
+        ...returns CompiledExpr
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+_SCALAR_FUNCTIONS: Dict[str, Callable] = {}
+_WINDOW_TYPES: Dict[str, type] = {}
+
+
+def scalar_function(name: str):
+    def deco(fn):
+        _SCALAR_FUNCTIONS[name] = fn
+        return fn
+    return deco
+
+
+def scalar_function_registry() -> Dict[str, Callable]:
+    return _SCALAR_FUNCTIONS
+
+
+def window_extension(name: str):
+    def deco(cls):
+        from .window import WINDOW_TYPES
+        WINDOW_TYPES[name] = cls
+        _WINDOW_TYPES[name] = cls
+        return cls
+    return deco

@@ -1,0 +1,100 @@
+"""Host-side vectorized key -> dense slot allocation.
+
+Replaces the reference's thread-local keyed state maps
+(CORE/util/snapshot/state/PartitionStateHolder.java:43 — nested
+Map<partitionKey, Map<groupByKey, State>> — and
+CORE/query/selector/GroupByKeyGenerator.java:37's per-event string-concat
+keys) with a batched design: group-by / partition keys are extracted from the
+already-encoded integer columns with numpy, deduped per batch, and mapped to
+dense slot ids through a persistent dict (Python cost is O(new keys), not
+O(events)).  Device state is then plain [K, ...] arrays indexed by slot, so
+aggregation is a segment op and partitioning is an axis — no hash probing on
+the critical path on device.
+
+Slots are recycled through a free list on purge (reference: @purge idle-key
+GC, PartitionRuntimeImpl.java:120-147).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class SlotAllocator:
+    def __init__(self, capacity: int, name: str = "?"):
+        self.capacity = capacity
+        self.name = name
+        self._map: Dict[bytes, int] = {}
+        self._free: List[int] = list(range(capacity - 1, -1, -1))
+        self._lock = threading.Lock()
+        self._keys_by_slot: Dict[int, bytes] = {}
+
+    def __len__(self):
+        return len(self._map)
+
+    def slots_for(self, key_cols: Sequence[np.ndarray],
+                  valid: Optional[np.ndarray] = None) -> np.ndarray:
+        """Vectorized lookup/insert: key_cols are 1-D arrays of equal length.
+        Returns int32 slot ids (-1 for invalid rows)."""
+        n = len(key_cols[0])
+        if n == 0:
+            return np.empty((0,), np.int32)
+        # pack the key columns into fixed-width bytes rows
+        stacked = np.stack(
+            [np.ascontiguousarray(c).view(np.uint8).reshape(n, -1)
+             if c.dtype != np.bool_ else
+             c.astype(np.uint8).reshape(n, 1)
+             for c in key_cols], axis=1) if len(key_cols) > 1 else \
+            _as_bytes_2d(key_cols[0])
+        if stacked.ndim == 3:
+            stacked = stacked.reshape(n, -1)
+        rows = stacked.view(
+            np.dtype((np.void, stacked.shape[1]))).reshape(n)
+        uniq, inverse = np.unique(rows, return_inverse=True)
+        uslots = np.empty(len(uniq), np.int32)
+        with self._lock:
+            for i, u in enumerate(uniq.tolist()):
+                key = bytes(u) if not isinstance(u, bytes) else u
+                got = self._map.get(key)
+                if got is None:
+                    if not self._free:
+                        raise RuntimeError(
+                            f"slot capacity {self.capacity} exhausted for "
+                            f"{self.name!r}; raise via @slots annotation")
+                    got = self._free.pop()
+                    self._map[key] = got
+                    self._keys_by_slot[got] = key
+                uslots[i] = got
+        slots = uslots[inverse].astype(np.int32)
+        if valid is not None:
+            slots = np.where(valid, slots, -1).astype(np.int32)
+        return slots
+
+    def purge(self, slots: Sequence[int]) -> None:
+        with self._lock:
+            for s in slots:
+                key = self._keys_by_slot.pop(int(s), None)
+                if key is not None:
+                    del self._map[key]
+                    self._free.append(int(s))
+
+    def snapshot(self) -> Dict[bytes, int]:
+        with self._lock:
+            return dict(self._map)
+
+    def restore(self, mapping: Dict[bytes, int]) -> None:
+        with self._lock:
+            self._map = dict(mapping)
+            self._keys_by_slot = {v: k for k, v in mapping.items()}
+            used = set(mapping.values())
+            self._free = [i for i in range(self.capacity - 1, -1, -1)
+                          if i not in used]
+
+
+def _as_bytes_2d(c: np.ndarray) -> np.ndarray:
+    n = len(c)
+    if c.dtype == np.bool_:
+        return c.astype(np.uint8).reshape(n, 1)
+    return np.ascontiguousarray(c).view(np.uint8).reshape(n, -1)

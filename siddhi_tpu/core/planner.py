@@ -1,0 +1,179 @@
+"""Query planner: query_api AST -> compiled, jitted step functions.
+
+Reference role (what): CORE/util/parser/QueryParser.java:90 +
+SingleInputStreamParser/SelectorParser/OutputParser — there the "plan" is a
+graph of interpreter objects.  Here each query compiles to ONE pure function
+    step(state, batch, gslot, now) -> (state', output rows, next_wakeup)
+traced and compiled once per batch bucket by XLA, with all filters, the
+window, aggregation scans and projections fused into a single device program.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..query_api.definition import StreamDefinition
+from ..query_api.query import (
+    Filter,
+    Query,
+    SingleInputStream,
+    StreamFunction,
+    Window,
+)
+from . import event as ev
+from .executor import CompileError, Scope, compile_expression
+from .keyslots import SlotAllocator
+from .selector import SelectorExec
+from .window import (
+    NO_WAKEUP,
+    NoWindow,
+    Rows,
+    WindowProcessor,
+    create_window,
+)
+
+
+@dataclasses.dataclass
+class PlannedQuery:
+    """Compiled single-input query."""
+
+    name: str
+    input_stream_id: str
+    in_schema: ev.Schema
+    out_schema: ev.Schema
+    output_target: str                 # target stream/table id ('' => return)
+    output_event_type: str             # CURRENT_EVENTS/EXPIRED_EVENTS/ALL_EVENTS
+    window: WindowProcessor
+    group_by_positions: List[int]
+    selector_exec: SelectorExec
+    step: Callable                     # jitted
+    init_state: Callable
+    slot_allocator: Optional[SlotAllocator]
+    batch_capacity: int
+    needs_timer: bool
+
+
+def _env_for(scope_key: str, cols, ts):
+    return {scope_key: cols, "__ts__": ts}
+
+
+def plan_single_query(
+    query: Query,
+    name: str,
+    definitions: Dict[str, StreamDefinition],
+    schemas: Dict[str, ev.Schema],
+    interner: ev.StringInterner,
+    batch_capacity: int = 512,
+    group_slots: int = 4096,
+    window_capacity_hint: int = 2048,
+) -> PlannedQuery:
+    ist = query.input_stream
+    assert isinstance(ist, SingleInputStream)
+    sid = ist.unique_stream_id
+    if sid not in schemas:
+        raise CompileError(f"undefined stream {sid!r}")
+    in_schema = schemas[sid]
+
+    scope = Scope()
+    scope.interner = interner
+    scope.add_source(sid, in_schema, alias=ist.stream_reference_id)
+
+    # ---- handlers: filters before/after the (single) window ---------------
+    pre_filters, post_filters = [], []
+    window_proc: WindowProcessor = NoWindow(in_schema, [], batch_capacity)
+    seen_window = False
+    for h in ist.stream_handlers:
+        if isinstance(h, Filter):
+            c = compile_expression(h.expression, scope)
+            if c.type != "BOOL":
+                raise CompileError("filter expression must be boolean")
+            (post_filters if seen_window else pre_filters).append(c)
+        elif isinstance(h, Window):
+            if seen_window:
+                raise CompileError("only one window per input stream")
+            seen_window = True
+            window_proc = create_window(
+                (h.namespace + ":" if h.namespace else "") + h.name,
+                in_schema, h.parameters, batch_capacity,
+                capacity_hint=window_capacity_hint)
+        elif isinstance(h, StreamFunction):
+            raise CompileError(
+                f"stream function {h.name!r} not yet supported")
+
+    # ---- selector -----------------------------------------------------------
+    out_target = query.output_stream.target_id if query.output_stream else ""
+    sel = SelectorExec(query.selector, scope, in_schema, group_slots,
+                       out_target or name, interner)
+
+    # output schema
+    out_def = StreamDefinition(out_target or f"#{name}.out")
+    for n, t in zip(sel.out_names, sel.out_types):
+        out_def.attribute(n, t)
+    out_schema = ev.Schema(out_def, interner, objects=in_schema.objects)
+
+    # group-by slot allocation (host side)
+    gpos = sel.group_by_positions
+    allocator = SlotAllocator(group_slots, name=f"{name}:groupby") if gpos \
+        else None
+
+    out_event_type = (query.output_stream.output_event_type
+                      if query.output_stream and
+                      query.output_stream.output_event_type
+                      else "CURRENT_EVENTS")
+
+    # ---- the fused step -----------------------------------------------------
+    wproc = window_proc
+
+    def step(state, ts, kind, valid, cols, gslot, now):
+        wstate, astate = state
+        env = {sid: cols, "__ts__": ts, "__now__": now}
+        keep = valid
+        is_current = kind == ev.CURRENT
+        for f in pre_filters:
+            m = f.fn(env)
+            keep = jnp.logical_and(keep,
+                                   jnp.logical_or(jnp.logical_not(is_current), m))
+        rows = Rows(ts=ts, kind=kind, valid=keep,
+                    seq=jnp.zeros_like(ts), gslot=gslot, cols=cols)
+        wstate, wout = wproc.process(wstate, rows, now)
+        orows = wout.rows
+        env2 = {sid: orows.cols, "__ts__": orows.ts, "__now__": now}
+        if post_filters:
+            keep2 = orows.valid
+            oc = orows.kind == ev.CURRENT
+            oe = orows.kind == ev.EXPIRED
+            data_row = jnp.logical_or(oc, oe)
+            for f in post_filters:
+                m = f.fn(env2)
+                keep2 = jnp.logical_and(
+                    keep2, jnp.logical_or(jnp.logical_not(data_row), m))
+            orows = orows._replace(valid=keep2)
+        astate, (ots, okind, ovalid, ocols) = sel.process(astate, orows, env2)
+        return ((wstate, astate), (ots, okind, ovalid, ocols),
+                wout.next_wakeup)
+
+    jit_step = jax.jit(step, donate_argnums=(0,))
+
+    def init_state():
+        return (wproc.init_state(), sel.init_state())
+
+    return PlannedQuery(
+        name=name,
+        input_stream_id=sid,
+        in_schema=in_schema,
+        out_schema=out_schema,
+        output_target=out_target,
+        output_event_type=out_event_type,
+        window=wproc,
+        group_by_positions=gpos,
+        selector_exec=sel,
+        step=jit_step,
+        init_state=init_state,
+        slot_allocator=allocator,
+        batch_capacity=batch_capacity,
+        needs_timer=wproc.needs_timer,
+    )

@@ -1,0 +1,471 @@
+"""Query selector: projection, group-by aggregation, having, order-by/limit.
+
+Reference behavior (what): CORE/query/selector/QuerySelector.java:44 — per
+event: update aggregators (keyed by group-by key), evaluate select
+expressions, apply having, order-by/limit per chunk; EXPIRED events subtract
+from aggregators, RESET events clear them (batch windows).
+Attribute aggregators: CORE/query/selector/attribute/aggregator/*.
+
+TPU-native design (how): rows arrive seq-ordered with a precomputed group
+slot id per row (host-side vectorized key->slot allocation, see
+core/keyslots.py).  Running aggregate values — Siddhi's "value after this
+event's update" semantics — are computed with *segmented associative scans*:
+rows are stably sorted by (group slot, reset epoch), an inclusive
+associative scan runs per segment, carry-in state is injected at segment
+heads, and results are unsorted back.  O(B log B), no per-event control flow,
+exact sequential semantics.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..query_api.expression import (
+    AttributeFunction,
+    Compare,
+    Constant,
+    Expression,
+    Variable,
+    _Binary,
+    Add, Subtract, Multiply, Divide, Mod,
+    And, Or, Not, IsNull, In,
+)
+from ..query_api.query import Selector
+from . import event as ev
+from .executor import (
+    AGGREGATOR_NAMES,
+    CompileError,
+    CompiledExpr,
+    Scope,
+    compile_expression,
+    promote,
+)
+from .window import Rows
+
+BIG = jnp.iinfo(jnp.int64).max // 4
+
+
+# ---------------------------------------------------------------------------
+# segmented inclusive scan over seg-sorted rows
+# ---------------------------------------------------------------------------
+
+def _segmented_scan(vals, segs, op):
+    """Inclusive scan of `op` within runs of equal `segs` (must be sorted)."""
+    def combine(a, b):
+        va, sa = a
+        vb, sb = b
+        return jnp.where(sa == sb, op(va, vb), vb), sb
+    out, _ = lax.associative_scan(combine, (vals, segs))
+    return out
+
+
+@dataclasses.dataclass
+class _AggSpec:
+    """One physical accumulator column (a scan over signed contributions)."""
+
+    key: str                      # dedupe key
+    op: Callable                  # associative op
+    init: Any                     # identity scalar
+    dtype: Any
+    # vals_fn(env, sign) -> [B] contribution per row
+    vals_fn: Callable
+
+
+class AggregatorBank:
+    """Compiles all aggregator calls of a query into a set of scan columns
+    plus per-slot carry state [K]."""
+
+    def __init__(self, group_slots: int):
+        self.K = group_slots
+        self.specs: List[_AggSpec] = []
+        self._index: Dict[str, int] = {}
+
+    def _add(self, spec: _AggSpec) -> int:
+        if spec.key in self._index:
+            return self._index[spec.key]
+        self._index[spec.key] = len(self.specs)
+        self.specs.append(spec)
+        return len(self.specs) - 1
+
+    def init_state(self):
+        return tuple(
+            jnp.full((self.K,), s.init, dtype=s.dtype) for s in self.specs)
+
+    # -- aggregator compilation ----------------------------------------------
+    def compile_call(self, fn_expr: AttributeFunction, scope: Scope,
+                     expr_key: str) -> Tuple[str, Callable, str]:
+        """Returns (result_type, result_fn(scan_results)->array, name).
+        `scan_results` is the tuple of per-row running values, one per spec."""
+        name = fn_expr.name
+        args = [compile_expression(p, scope) for p in fn_expr.parameters]
+
+        def fvals(c: CompiledExpr, dtype):
+            def vals(env, sign):
+                return jnp.asarray(c.fn(env), dtype) * jnp.asarray(sign, dtype)
+            return vals
+
+        if name == "sum" or name == "avg" or name == "stdDev":
+            (a,) = args
+            out_t = "LONG" if (name == "sum" and a.type in ("INT", "LONG")) \
+                else "DOUBLE"
+            acc_dtype = ev.dtype_of("LONG") if out_t == "LONG" \
+                else ev.dtype_of("DOUBLE")
+            i_sum = self._add(_AggSpec(
+                f"sum:{expr_key}", jnp.add, 0, acc_dtype, fvals(a, acc_dtype)))
+            i_cnt = self._add(_AggSpec(
+                f"cnt:{expr_key}", jnp.add, 0, jnp.int64,
+                lambda env, sign: jnp.asarray(sign, jnp.int64)))
+            if name == "sum":
+                return out_t, (lambda res, _i=i_sum: res[_i]), name
+            if name == "avg":
+                def favg(res, _s=i_sum, _c=i_cnt):
+                    c = res[_c]
+                    return jnp.where(
+                        c != 0,
+                        res[_s].astype(jnp.float32) / c.astype(jnp.float32),
+                        jnp.asarray(0.0, jnp.float32))
+                return "DOUBLE", favg, name
+            # stdDev = sqrt(E[x^2] - E[x]^2)
+            def sqvals(env, sign, _a=a):
+                v = jnp.asarray(_a.fn(env), jnp.float32)
+                return v * v * jnp.asarray(sign, jnp.float32)
+            i_sq = self._add(_AggSpec(
+                f"sumsq:{expr_key}", jnp.add, 0, jnp.float32, sqvals))
+            def fstd(res, _s=i_sum, _c=i_cnt, _q=i_sq):
+                c = jnp.maximum(res[_c], 1).astype(jnp.float32)
+                m = res[_s].astype(jnp.float32) / c
+                var = jnp.maximum(res[_q] / c - m * m, 0.0)
+                return jnp.sqrt(var)
+            return "DOUBLE", fstd, name
+
+        if name == "count":
+            i_cnt = self._add(_AggSpec(
+                f"count:{expr_key}", jnp.add, 0, jnp.int64,
+                lambda env, sign: jnp.asarray(sign, jnp.int64)))
+            return "LONG", (lambda res, _i=i_cnt: res[_i]), name
+
+        if name in ("min", "max", "minForever", "maxForever"):
+            (a,) = args
+            if a.type not in ("INT", "LONG", "FLOAT", "DOUBLE"):
+                raise CompileError(f"{name}() needs a numeric argument")
+            dtype = ev.dtype_of(a.type)
+            big = jnp.asarray(
+                jnp.inf if dtype in (jnp.float32, jnp.float64)
+                else jnp.iinfo(dtype).max, dtype)
+            is_min = name.startswith("min")
+            ident = big if is_min else (-big if dtype in (jnp.float32,) else
+                                        jnp.asarray(jnp.iinfo(dtype).min, dtype)
+                                        if dtype not in (jnp.float32, jnp.float64)
+                                        else -big)
+            opf = jnp.minimum if is_min else jnp.maximum
+            def vals(env, sign, _a=a, _id=ident, _d=dtype):
+                v = jnp.asarray(_a.fn(env), _d)
+                # only CURRENT rows contribute; EXPIRED need window exposure
+                return jnp.where(jnp.asarray(sign) > 0, v, _id)
+            i = self._add(_AggSpec(
+                f"{name}:{expr_key}", opf, ident, dtype, vals))
+            return a.type, (lambda res, _i=i: res[_i]), name
+
+        if name in ("and", "or"):
+            (a,) = args
+            want = name == "or"   # or: count trues; and: count falses
+            def vals(env, sign, _a=a, _w=want):
+                v = jnp.asarray(_a.fn(env), jnp.bool_)
+                hit = v if _w else jnp.logical_not(v)
+                return jnp.where(hit, jnp.asarray(sign, jnp.int64), 0)
+            i = self._add(_AggSpec(
+                f"{name}:{expr_key}", jnp.add, 0, jnp.int64, vals))
+            if want:
+                return "BOOL", (lambda res, _i=i: res[_i] > 0), name
+            return "BOOL", (lambda res, _i=i: res[_i] == 0), name
+
+        if name == "distinctCount":
+            raise CompileError(
+                "distinctCount is not yet supported on device")
+
+        raise CompileError(f"unknown aggregator {name!r}")
+
+    # -- runtime -------------------------------------------------------------
+    def process(self, state, rows: Rows, env) -> Tuple[Any, Tuple]:
+        """Returns (new_state, per-row running values per spec)."""
+        if not self.specs:
+            return state, ()
+        B = rows.capacity
+        sign = jnp.where(
+            jnp.logical_and(rows.valid, rows.kind == ev.CURRENT), 1,
+            jnp.where(jnp.logical_and(rows.valid, rows.kind == ev.EXPIRED),
+                      -1, 0))
+        gslot = jnp.where(rows.gslot >= 0, rows.gslot, 0).astype(jnp.int32)
+
+        is_reset = jnp.logical_and(rows.valid, rows.kind == ev.RESET)
+        reset_epoch = jnp.cumsum(is_reset.astype(jnp.int64))  # after row i
+        epoch_before = reset_epoch - is_reset.astype(jnp.int64)
+        total_resets = reset_epoch[-1]
+
+        # segment id: (slot, epoch); rows already seq-ordered
+        seg = gslot.astype(jnp.int64) * (B + 2) + epoch_before
+        order = jnp.argsort(seg, stable=True)
+        unorder = jnp.zeros((B,), jnp.int32).at[order].set(
+            jnp.arange(B, dtype=jnp.int32))
+        seg_s = seg[order]
+        first = jnp.concatenate([
+            jnp.ones((1,), jnp.bool_), seg_s[1:] != seg_s[:-1]])
+
+        sign_s = sign[order]
+        gslot_s = gslot[order]
+        epoch_s = epoch_before[order]
+
+        new_state = []
+        results = []
+        for spec, st in zip(self.specs, state):
+            vals = spec.vals_fn(env, sign)
+            # rows that don't contribute carry the identity
+            vals = jnp.where(sign != 0, vals,
+                             jnp.asarray(spec.init, spec.dtype))
+            v_s = vals[order]
+            # inject carry state at heads of epoch-0 segments
+            carry = st[gslot_s]
+            v_s = jnp.where(
+                jnp.logical_and(first, epoch_s == 0),
+                spec.op(carry, v_s), v_s)
+            scanned = _segmented_scan(v_s, seg_s, spec.op)
+            results.append(scanned[unorder])
+
+            # new state: per slot, value after the last row in the final epoch
+            contrib = jnp.logical_and(sign_s != 0, epoch_s == total_resets)
+            # last contributing row of each slot (sorted order): next row with
+            # different slot or non-contributing
+            idx = jnp.arange(B)
+            last_of_slot = jnp.zeros((self.K,), jnp.int32)
+            # scatter-max of sorted index per slot for contributing rows
+            last_idx = jnp.full((self.K,), -1, jnp.int32).at[
+                jnp.where(contrib, gslot_s, self.K).astype(jnp.int32)
+            ].max(jnp.where(contrib, idx, -1).astype(jnp.int32), mode="drop")
+            has = last_idx >= 0
+            gathered = scanned[jnp.clip(last_idx, 0, B - 1)]
+            base = jnp.where(total_resets > 0,
+                             jnp.full((self.K,), spec.init, spec.dtype), st)
+            # carry survives only if no reset happened
+            ns = jnp.where(has, gathered, base)
+            new_state.append(ns)
+
+        return tuple(new_state), tuple(results)
+
+
+# ---------------------------------------------------------------------------
+# Selector executor
+# ---------------------------------------------------------------------------
+
+def _rewrite_aggregators(expr: Expression, found: List[AttributeFunction],
+                         prefix: str) -> Expression:
+    """Replace aggregator calls with bound pseudo-variables __agg<i>."""
+    if isinstance(expr, AttributeFunction):
+        if not expr.namespace and expr.name in AGGREGATOR_NAMES:
+            found.append(expr)
+            return Variable(f"{prefix}{len(found) - 1}")
+        return AttributeFunction(expr.namespace, expr.name, [
+            _rewrite_aggregators(p, found, prefix) for p in expr.parameters])
+    if isinstance(expr, (Add, Subtract, Multiply, Divide, Mod)):
+        return type(expr)(_rewrite_aggregators(expr.left, found, prefix),
+                          _rewrite_aggregators(expr.right, found, prefix))
+    if isinstance(expr, Compare):
+        return Compare(_rewrite_aggregators(expr.left, found, prefix),
+                       expr.operator,
+                       _rewrite_aggregators(expr.right, found, prefix))
+    if isinstance(expr, (And, Or)):
+        return type(expr)(_rewrite_aggregators(expr.left, found, prefix),
+                          _rewrite_aggregators(expr.right, found, prefix))
+    if isinstance(expr, Not):
+        return Not(_rewrite_aggregators(expr.expression, found, prefix))
+    if isinstance(expr, IsNull) and expr.expression is not None:
+        return IsNull(_rewrite_aggregators(expr.expression, found, prefix))
+    if isinstance(expr, In):
+        return In(_rewrite_aggregators(expr.expression, found, prefix),
+                  expr.source_id)
+    return expr
+
+
+class SelectorExec:
+    """Compiled select clause over ordered Rows."""
+
+    def __init__(self, selector: Selector, scope: Scope,
+                 in_schema: ev.Schema, group_slots: int,
+                 out_stream_id: str, interner: ev.StringInterner):
+        self.selector = selector
+        self.scope = scope
+        self.group_by_positions: List[int] = []
+        for v in selector.group_by_list:
+            _, pos, _ = scope.resolve(v)
+            self.group_by_positions.append(pos)
+
+        self.bank = AggregatorBank(group_slots)
+        self._agg_calls: List[AttributeFunction] = []
+
+        # select list (select-all expands to the input schema)
+        sel_list = selector.selection_list
+        if not sel_list:
+            from ..query_api.query import OutputAttribute
+            sel_list = [
+                OutputAttribute(None, Variable(n)) for n in in_schema.names]
+
+        self.out_names: List[str] = []
+        self._proj: List[Tuple[Expression, str]] = []  # rewritten expr
+        for oa in sel_list:
+            rewritten = _rewrite_aggregators(oa.expression, self._agg_calls,
+                                             "__agg")
+            self.out_names.append(oa.name if oa.rename or isinstance(
+                oa.expression, Variable) else oa.name)
+            self._proj.append((rewritten, oa.name))
+
+        # compile aggregator calls -> result fns; bind pseudo-columns
+        self._agg_results: List[Tuple[str, Callable]] = []
+        for i, call in enumerate(self._agg_calls):
+            ekey = f"{out_stream_id}:{i}:{_expr_fingerprint(call)}"
+            t, fn, _ = self.bank.compile_call(call, scope, ekey)
+            self._agg_results.append((t, fn))
+            scope.bind(f"__agg{i}",
+                       CompiledExpr(fn=None, type=t))  # type only; fn later
+
+        # compile projections / having with pseudo-columns resolved lazily:
+        # we compile in process() env style: pseudo columns injected into env
+        self._compiled_proj: List[CompiledExpr] = []
+        for rewritten, name in self._proj:
+            self._compiled_proj.append(
+                _compile_with_pseudo(rewritten, scope, self._agg_results))
+        self.out_types = [c.type for c in self._compiled_proj]
+
+        self.having = None
+        if selector.having_expression is not None:
+            hre = _rewrite_aggregators(
+                selector.having_expression, self._agg_calls, "__agg")
+            # new aggs may have been appended by having
+            while len(self._agg_results) < len(self._agg_calls):
+                i = len(self._agg_results)
+                call = self._agg_calls[i]
+                ekey = f"{out_stream_id}:h{i}:{_expr_fingerprint(call)}"
+                t, fn, _ = self.bank.compile_call(call, scope, ekey)
+                self._agg_results.append((t, fn))
+                scope.bind(f"__agg{i}", CompiledExpr(fn=None, type=t))
+            self.having = _compile_with_pseudo(hre, scope, self._agg_results)
+
+        # order-by / limit
+        self._order_by = []
+        for ob in selector.order_by_list:
+            c = compile_expression(ob.variable, _projection_scope(
+                self.out_names, self.out_types, interner))
+            self._order_by.append((c, ob.order))
+        self.interner = interner
+
+    @property
+    def has_aggregation(self) -> bool:
+        return bool(self.bank.specs)
+
+    def init_state(self):
+        return self.bank.init_state()
+
+    def process(self, state, rows: Rows, env: Dict[str, Any]):
+        """env must contain the scope's source cols; returns
+        (state', out_ts, out_kind, out_valid, out_cols tuple)."""
+        new_state, scans = self.bank.process(state, rows, env)
+        env = dict(env)
+        env["__aggscan__"] = scans
+
+        out_cols = tuple(c.fn(env) for c in self._compiled_proj)
+        valid = jnp.logical_and(
+            rows.valid,
+            jnp.logical_or(rows.kind == ev.CURRENT, rows.kind == ev.EXPIRED))
+        if self.having is not None:
+            valid = jnp.logical_and(valid, self.having.fn(env))
+
+        ts, kind = rows.ts, rows.kind
+        if self._order_by or self.selector.limit is not None \
+                or self.selector.offset is not None:
+            ts, kind, valid, out_cols = self._order_limit(
+                ts, kind, valid, out_cols)
+        return new_state, (ts, kind, valid, out_cols)
+
+    def _order_limit(self, ts, kind, valid, out_cols):
+        B = ts.shape[0]
+        if self._order_by:
+            env = {"__out__": out_cols}
+            keys = []
+            for c, order in reversed(self._order_by):
+                k = c.fn(env)
+                if order == "DESC":
+                    k = -k if k.dtype != jnp.bool_ else jnp.logical_not(k)
+                keys.append(k)
+            idx = jnp.arange(B)
+            for k in keys:  # last applied = primary (stable sorts)
+                big = jnp.asarray(
+                    jnp.inf if k.dtype in (jnp.float32, jnp.float64)
+                    else jnp.iinfo(k.dtype).max
+                    if k.dtype not in (jnp.bool_,) else True)
+                kk = jnp.where(valid[idx], k[idx], big)
+                s = jnp.argsort(kk, stable=True)
+                idx = idx[s]
+            ts, kind, valid = ts[idx], kind[idx], valid[idx]
+            out_cols = tuple(c[idx] for c in out_cols)
+        if self.selector.offset is not None or self.selector.limit is not None:
+            rank = jnp.cumsum(valid.astype(jnp.int32)) - 1
+            lo = self.selector.offset or 0
+            keep = rank >= lo
+            if self.selector.limit is not None:
+                keep = jnp.logical_and(keep, rank < lo + self.selector.limit)
+            valid = jnp.logical_and(valid, keep)
+        return ts, kind, valid, out_cols
+
+
+def _expr_fingerprint(e: Expression) -> str:
+    if isinstance(e, Variable):
+        return f"v:{e.stream_id}.{e.attribute_name}[{e.stream_index}]"
+    if isinstance(e, Constant):
+        return f"c:{e.value}"
+    if isinstance(e, AttributeFunction):
+        inner = ",".join(_expr_fingerprint(p) for p in e.parameters)
+        return f"f:{e.namespace}:{e.name}({inner})"
+    if isinstance(e, Compare):
+        return f"({_expr_fingerprint(e.left)}{e.operator}{_expr_fingerprint(e.right)})"
+    if isinstance(e, (Add, Subtract, Multiply, Divide, Mod, And, Or)):
+        return (f"({_expr_fingerprint(e.left)}{type(e).__name__}"
+                f"{_expr_fingerprint(e.right)})")
+    if isinstance(e, Not):
+        return f"!({_expr_fingerprint(e.expression)})"
+    return repr(e)
+
+
+def _compile_with_pseudo(expr: Expression, scope: Scope,
+                         agg_results: List[Tuple[str, Callable]]) -> CompiledExpr:
+    """Compile an expression where __aggN variables read from env['__aggscan__']."""
+
+    class _PseudoScope:
+        def __init__(self, base: Scope):
+            self.base = base
+
+        def __getattr__(self, item):
+            return getattr(self.base, item)
+
+        def resolve(self, var):
+            return self.base.resolve(var)
+
+    # bind real fns for pseudo vars
+    for i, (t, fn) in enumerate(agg_results):
+        def make(fn):
+            return lambda env: fn(env["__aggscan__"])
+        scope.bind(f"__agg{i}", CompiledExpr(fn=make(fn), type=t))
+    return compile_expression(expr, scope)
+
+
+def _projection_scope(names, types, interner) -> Scope:
+    """Scope over the projected output columns (for order-by)."""
+    from ..query_api.definition import StreamDefinition
+
+    d = StreamDefinition("__out__")
+    for n, t in zip(names, types):
+        d.attribute(n, t)
+    s = Scope()
+    s.add_source("__out__", ev.Schema(d, interner))
+    return s

@@ -1,0 +1,673 @@
+"""Window processors as fixed-capacity columnar buffers.
+
+Reference behavior (what): CORE/query/processor/stream/window/* — sliding and
+batch retention policies emitting CURRENT + EXPIRED (+RESET) events, driven by
+arrivals and scheduler TIMER ticks (e.g. TimeWindowProcessor.java:132-168,
+LengthWindowProcessor.java, LengthBatchWindowProcessor.java,
+TimeBatchWindowProcessor.java).
+
+TPU-native design (how): each window keeps a struct-of-arrays buffer of
+capacity C.  Every event admitted to the window gets a monotone global
+sequence number `add_seq`; when it leaves it gets `expire_seq`.  One `process`
+call consumes a whole micro-batch and emits an output `Rows` block where every
+row carries its own sequence number, so downstream aggregation can recover the
+exact per-event ordering (expired-before-current interleavings included)
+without any per-event control flow.  Scan-style aggregators (min/max/
+distinctCount over a sliding window) receive an `alive[i, c]` exposure mask:
+entry c is visible to output row i iff add_seq[c] <= seq[i] < expire_seq[c].
+
+Buffers are recompacted (gather) once per batch instead of ring-indexed per
+event — O(C+B) vector work that XLA fuses well.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..query_api.expression import Constant
+from . import event as ev
+
+BIG_SEQ = jnp.iinfo(jnp.int64).max // 4  # "never expired"
+NO_WAKEUP = jnp.iinfo(jnp.int64).max // 4
+
+
+class Rows(NamedTuple):
+    """Ordered operator rows flowing between window -> selector -> output."""
+
+    ts: Any     # i64[B]
+    kind: Any   # i32[B] CURRENT/EXPIRED/TIMER/RESET
+    valid: Any  # bool[B]
+    seq: Any    # i64[B] global order
+    gslot: Any  # i32[B] group-by slot (-1 none)
+    cols: Tuple[Any, ...]
+
+    @property
+    def capacity(self):
+        return self.ts.shape[0]
+
+
+class Buffer(NamedTuple):
+    """Columnar window contents."""
+
+    ts: Any          # i64[C] original event ts
+    add_seq: Any     # i64[C]
+    expire_seq: Any  # i64[C] BIG_SEQ if still in window
+    expire_ts: Any   # i64[C] scheduled wall expiry (time windows) else BIG
+    alive: Any       # bool[C]
+    gslot: Any       # i32[C]
+    cols: Tuple[Any, ...]
+
+    @property
+    def capacity(self):
+        return self.ts.shape[0]
+
+
+def empty_buffer(schema: ev.Schema, capacity: int) -> Buffer:
+    cols = tuple(
+        jnp.full((capacity,), ev.default_value(t), dtype=d)
+        for t, d in zip(schema.types, schema.dtypes)
+    )
+    big = jnp.full((capacity,), BIG_SEQ, jnp.int64)
+    return Buffer(
+        ts=jnp.zeros((capacity,), jnp.int64),
+        add_seq=big,
+        expire_seq=big,
+        expire_ts=big,
+        alive=jnp.zeros((capacity,), jnp.bool_),
+        gslot=jnp.full((capacity,), -1, jnp.int32),
+        cols=cols,
+    )
+
+
+def _gather_rows(rows: Rows, idx, valid):
+    return Rows(
+        ts=rows.ts[idx], kind=rows.kind[idx],
+        valid=jnp.logical_and(rows.valid[idx], valid),
+        seq=rows.seq[idx], gslot=rows.gslot[idx],
+        cols=tuple(c[idx] for c in rows.cols),
+    )
+
+
+def sort_rows(rows: Rows) -> Rows:
+    """Stable order by (valid desc, seq asc): invalid rows pushed to the end."""
+    key = jnp.where(rows.valid, rows.seq, BIG_SEQ)
+    idx = jnp.argsort(key, stable=True)
+    return _gather_rows(rows, idx, jnp.ones_like(rows.valid)[idx])
+
+
+def concat_rows(a: Rows, b: Rows) -> Rows:
+    return Rows(
+        ts=jnp.concatenate([a.ts, b.ts]),
+        kind=jnp.concatenate([a.kind, b.kind]),
+        valid=jnp.concatenate([a.valid, b.valid]),
+        seq=jnp.concatenate([a.seq, b.seq]),
+        gslot=jnp.concatenate([a.gslot, b.gslot]),
+        cols=tuple(jnp.concatenate([x, y]) for x, y in zip(a.cols, b.cols)),
+    )
+
+
+class WindowOutput(NamedTuple):
+    rows: Rows
+    buffer: Optional[Buffer]      # post-state buffer (exposure source)
+    next_wakeup: Any              # i64 scalar, NO_WAKEUP if none
+
+
+# ---------------------------------------------------------------------------
+
+
+class WindowProcessor:
+    """Base: subclasses are pure — state is an explicit pytree."""
+
+    name = "?"
+    needs_timer = False
+
+    def __init__(self, schema: ev.Schema, params: List[Constant],
+                 batch_capacity: int, capacity_hint: int = 1024):
+        self.schema = schema
+        self.batch_capacity = batch_capacity
+        self.capacity_hint = capacity_hint
+
+    # -- static description ---------------------------------------------------
+    @property
+    def out_capacity(self) -> int:
+        raise NotImplementedError
+
+    def init_state(self):
+        raise NotImplementedError
+
+    def process(self, state, rows: Rows, now) -> Tuple[Any, WindowOutput]:
+        raise NotImplementedError
+
+
+def _param_int(params, i, default=None):
+    if i >= len(params):
+        if default is not None:
+            return default
+        raise ValueError("missing window parameter")
+    p = params[i]
+    if not isinstance(p, Constant):
+        raise ValueError("window parameters must be constants")
+    return int(p.value)
+
+
+class NoWindow(WindowProcessor):
+    """Pass-through when the query has no window handler."""
+
+    name = "(none)"
+
+    @property
+    def out_capacity(self):
+        return self.batch_capacity
+
+    def init_state(self):
+        return jnp.asarray(0, jnp.int64)  # seq counter
+
+    def process(self, state, rows: Rows, now):
+        seq0 = state
+        n = rows.capacity
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        ord_ = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
+        seq = jnp.where(is_cur, seq0 + ord_, BIG_SEQ)
+        out = Rows(rows.ts, rows.kind, is_cur, seq, rows.gslot, rows.cols)
+        nseq = seq0 + jnp.sum(is_cur.astype(jnp.int64))
+        return nseq, WindowOutput(sort_rows(out), None,
+                                  jnp.asarray(NO_WAKEUP, jnp.int64))
+
+
+class LengthWindow(WindowProcessor):
+    """Sliding length window (reference: LengthWindowProcessor).
+
+    On each arrival: if full, the oldest entry is emitted as EXPIRED just
+    before the CURRENT event.  expired ts keeps the original event ts.
+    """
+
+    name = "length"
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=1024):
+        super().__init__(schema, params, batch_capacity)
+        self.length = _param_int(params, 0)
+
+    @property
+    def out_capacity(self):
+        return 2 * self.batch_capacity
+
+    def init_state(self):
+        return (empty_buffer(self.schema, self.length),
+                jnp.asarray(0, jnp.int64))
+
+    def process(self, state, rows: Rows, now):
+        buf, seq0 = state
+        C = self.length
+        B = rows.capacity
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        ncur = jnp.sum(is_cur.astype(jnp.int64))
+
+        # order arrivals among themselves: k = 0..ncur-1
+        k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1   # [B]
+
+        # combined virtual sequence: old alive entries (by add_seq) then currents
+        # old entries compacted to the front, oldest first
+        old_key = jnp.where(buf.alive, buf.add_seq, BIG_SEQ)
+        old_order = jnp.argsort(old_key)               # [C] alive first by age
+        count0 = jnp.sum(buf.alive.astype(jnp.int64))
+
+        comb_ts = jnp.concatenate([buf.ts[old_order], rows.ts])
+        comb_gslot = jnp.concatenate([buf.gslot[old_order], rows.gslot])
+        comb_cols = tuple(jnp.concatenate([bc[old_order], rc])
+                          for bc, rc in zip(buf.cols, rows.cols))
+        comb_addseq = jnp.concatenate([buf.add_seq[old_order],
+                                       jnp.where(is_cur, seq0 + 2 * k + 1, BIG_SEQ)])
+        # validity of combined slots: first count0 old ones; currents where is_cur
+        comb_valid = jnp.concatenate([
+            jnp.arange(C, dtype=jnp.int64) < count0, is_cur])
+
+        # the k-th arrival evicts combined[count0 + k - length] (if >= 0)
+        evict_pos = (count0 + k - C)
+        has_evict = jnp.logical_and(is_cur, evict_pos >= 0)
+        safe_pos = jnp.clip(evict_pos, 0, C + B - 1).astype(jnp.int32)
+
+        exp_rows = Rows(
+            ts=comb_ts[safe_pos],
+            kind=jnp.full((B,), ev.EXPIRED, jnp.int32),
+            valid=has_evict,
+            seq=seq0 + 2 * k,           # expired emitted just before current k
+            gslot=comb_gslot[safe_pos],
+            cols=tuple(c[safe_pos] for c in comb_cols),
+        )
+        cur_rows = Rows(
+            ts=rows.ts, kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+            valid=is_cur, seq=seq0 + 2 * k + 1, gslot=rows.gslot,
+            cols=rows.cols,
+        )
+        out = sort_rows(concat_rows(exp_rows, cur_rows))
+
+        # new buffer = last `length` of combined valid entries
+        total = count0 + ncur
+        start = jnp.maximum(total - C, 0)
+        take = jnp.arange(C, dtype=jnp.int64) + start        # [C]
+        tvalid = take < total
+        tpos = jnp.clip(take, 0, C + B - 1).astype(jnp.int32)
+        # expire_seq of evicted entries: entry at combined pos p (p < total-C
+        # after the batch) was evicted by arrival k = p - count0 + C
+        nbuf = Buffer(
+            ts=comb_ts[tpos],
+            add_seq=comb_addseq[tpos],
+            expire_seq=jnp.where(tvalid, BIG_SEQ, BIG_SEQ),
+            expire_ts=jnp.full((C,), BIG_SEQ, jnp.int64),
+            alive=tvalid,
+            gslot=comb_gslot[tpos],
+            cols=tuple(c[tpos] for c in comb_cols),
+        )
+        nseq = seq0 + 2 * ncur
+        return ((nbuf, nseq),
+                WindowOutput(out, nbuf, jnp.asarray(NO_WAKEUP, jnp.int64)))
+
+
+class TimeWindow(WindowProcessor):
+    """Sliding time window (reference: TimeWindowProcessor.java:86).
+
+    Entries expire `t` ms after arrival; EXPIRED rows carry ts = expiry time
+    (matching the reference, which pre-stamps the cloned expired event).
+    Expiry is driven both by arrivals and by TIMER rows; `next_wakeup`
+    reports the earliest pending expiry for the host scheduler.
+    """
+
+    name = "time"
+    needs_timer = True
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
+        super().__init__(schema, params, batch_capacity)
+        self.time_ms = _param_int(params, 0)
+        self.capacity = max(capacity_hint, 2 * batch_capacity)
+
+    @property
+    def out_capacity(self):
+        return self.batch_capacity + self.capacity
+
+    def init_state(self):
+        return (empty_buffer(self.schema, self.capacity),
+                jnp.asarray(0, jnp.int64))
+
+    def process(self, state, rows: Rows, now):
+        buf, seq0 = state
+        C = self.capacity
+        B = rows.capacity
+        t = self.time_ms
+
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        ncur = jnp.sum(is_cur.astype(jnp.int64))
+
+        # ordering: merge (existing entries' expiries <= now) and arrivals by
+        # time; seq = 2*rank within this batch via sorting a combined key.
+        # Assign arrivals local order first.
+        k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
+
+        # Candidate expiries from the old buffer
+        exp_due = jnp.logical_and(buf.alive, buf.expire_ts <= now)
+
+        # Build combined "emission" list: expired entries (key=expire_ts, pri 0)
+        # + current arrivals (key=ts, pri 1)
+        em_ts = jnp.concatenate([buf.expire_ts, rows.ts])
+        em_pri = jnp.concatenate([jnp.zeros((C,), jnp.int64),
+                                  jnp.ones((B,), jnp.int64)])
+        em_valid = jnp.concatenate([exp_due, is_cur])
+        em_key = jnp.where(em_valid, em_ts * 2 + em_pri, BIG_SEQ)
+        order = jnp.argsort(em_key, stable=True)      # [C+B]
+        rank = jnp.zeros((C + B,), jnp.int64).at[order].set(
+            jnp.arange(C + B, dtype=jnp.int64))
+        seqs = seq0 + rank
+
+        exp_rows = Rows(
+            ts=buf.expire_ts,               # reference stamps expiry time
+            kind=jnp.full((C,), ev.EXPIRED, jnp.int32),
+            valid=exp_due,
+            seq=seqs[:C],
+            gslot=buf.gslot,
+            cols=buf.cols,
+        )
+        cur_rows = Rows(
+            ts=rows.ts, kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+            valid=is_cur, seq=seqs[C:], gslot=rows.gslot, cols=rows.cols,
+        )
+        out = sort_rows(concat_rows(exp_rows, cur_rows))
+
+        # new buffer = (old alive minus expired) + arrivals; compact by age
+        keep_old = jnp.logical_and(buf.alive, jnp.logical_not(exp_due))
+        cand_ts = jnp.concatenate([buf.ts, rows.ts])
+        cand_add = jnp.concatenate([buf.add_seq, seqs[C:]])
+        cand_expts = jnp.concatenate([buf.expire_ts, rows.ts + t])
+        cand_gslot = jnp.concatenate([buf.gslot, rows.gslot])
+        cand_cols = tuple(jnp.concatenate([bc, rc])
+                          for bc, rc in zip(buf.cols, rows.cols))
+        cand_valid = jnp.concatenate([keep_old, is_cur])
+        cand_key = jnp.where(cand_valid, cand_add, BIG_SEQ)
+        corder = jnp.argsort(cand_key)                # oldest first
+        total = jnp.sum(cand_valid.astype(jnp.int64))
+        # overflow: drop OLDEST if total > C (keep most recent C)
+        drop = jnp.maximum(total - C, 0)
+        sel = jnp.clip(jnp.arange(C, dtype=jnp.int64) + drop, 0, C + B - 1)
+        pos = corder[sel.astype(jnp.int32)]
+        svalid = (jnp.arange(C, dtype=jnp.int64) + drop) < total
+        nbuf = Buffer(
+            ts=cand_ts[pos], add_seq=jnp.where(svalid, cand_add[pos], BIG_SEQ),
+            expire_seq=jnp.full((C,), BIG_SEQ, jnp.int64),
+            expire_ts=jnp.where(svalid, cand_expts[pos], BIG_SEQ),
+            alive=svalid, gslot=cand_gslot[pos],
+            cols=tuple(c[pos] for c in cand_cols),
+        )
+        nseq = seq0 + rank.max() + 1
+        nseq = jnp.where(jnp.any(em_valid), nseq, seq0)
+        wake = jnp.min(jnp.where(nbuf.alive, nbuf.expire_ts, NO_WAKEUP))
+        return ((nbuf, nseq), WindowOutput(out, nbuf, wake))
+
+
+class LengthBatchWindow(WindowProcessor):
+    """Tumbling length batch (reference: LengthBatchWindowProcessor).
+
+    Arrivals accumulate silently; when `n` have gathered the whole batch is
+    emitted as CURRENT, preceded by the previous batch as EXPIRED and a RESET
+    row separating them.
+    """
+
+    name = "lengthBatch"
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=1024):
+        super().__init__(schema, params, batch_capacity)
+        self.length = _param_int(params, 0)
+
+    @property
+    def out_capacity(self):
+        # worst case: every arrival completes a batch of size 1
+        n = self.length
+        flushes = self.batch_capacity // n + 1
+        return 2 * self.batch_capacity + 2 * n + flushes
+
+    def init_state(self):
+        # pending buffer (filling), previous batch buffer (for EXPIRED replay)
+        return (empty_buffer(self.schema, self.length),
+                empty_buffer(self.schema, self.length),
+                jnp.asarray(0, jnp.int64))
+
+    def process(self, state, rows: Rows, now):
+        pend, prev, seq0 = state
+        n = self.length
+        B = rows.capacity
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        ncur = jnp.sum(is_cur.astype(jnp.int64))
+        fill0 = jnp.sum(pend.alive.astype(jnp.int64))
+
+        # global arrival index g = fill0 + k (k = order within batch)
+        k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
+        g = fill0 + k
+        batch_idx = g // n           # which tumble this arrival belongs to
+        nflush = (fill0 + ncur) // n  # completed batches this step
+
+        # ---- output construction -------------------------------------------
+        # seq layout per flush f (0-based among this step's flushes):
+        #   expired rows of batch f-1+prev : seq = seq0 + f*(2n+2) + [0..n)
+        #   reset row                      : seq0 + f*(2n+2) + n
+        #   current rows of batch f        : seq0 + f*(2n+2) + n+1 + [0..n)
+        span = 2 * n + 2
+
+        # currents of flushed batches: arrival with batch_idx < nflush
+        flushed_cur = jnp.logical_and(is_cur, batch_idx < nflush)
+        pos_in_batch = g % n
+        cur_seq = seq0 + batch_idx * span + n + 1 + pos_in_batch
+        # pending entries flushed in flush 0
+        pend_flush = jnp.logical_and(pend.alive, nflush > 0)
+        pend_rank = jnp.cumsum(pend.alive.astype(jnp.int64)) - 1
+        pend_seq = seq0 + 0 * span + n + 1 + pend_rank
+
+        cur_rows = Rows(
+            ts=jnp.concatenate([pend.ts, rows.ts]),
+            kind=jnp.full((n + B,), ev.CURRENT, jnp.int32),
+            valid=jnp.concatenate([pend_flush, flushed_cur]),
+            seq=jnp.concatenate([pend_seq, cur_seq]),
+            gslot=jnp.concatenate([pend.gslot, rows.gslot]),
+            cols=tuple(jnp.concatenate([pc, rc])
+                       for pc, rc in zip(pend.cols, rows.cols)),
+        )
+
+        # expired rows: prev batch replayed at flush 0; batch f-1 replayed at
+        # flush f.  prev buffer: ranks 0..n-1.
+        prev_rank = jnp.cumsum(prev.alive.astype(jnp.int64)) - 1
+        prev_valid = jnp.logical_and(prev.alive, nflush > 0)
+        prev_seq = seq0 + prev_rank
+        # arrivals replayed as expired at flush (batch_idx+1) if batch_idx+1 < nflush
+        arr_exp_valid = jnp.logical_and(is_cur, batch_idx + 1 < nflush)
+        arr_exp_seq = seq0 + (batch_idx + 1) * span + pos_in_batch
+        # pending entries (flushed at 0) replayed as expired at flush 1
+        pend_exp_valid = jnp.logical_and(pend.alive, nflush > 1)
+        pend_exp_seq = seq0 + 1 * span + pend_rank
+
+        exp_rows = Rows(
+            ts=jnp.concatenate([prev.ts, pend.ts, rows.ts]),
+            kind=jnp.full((2 * n + B,), ev.EXPIRED, jnp.int32),
+            valid=jnp.concatenate([prev_valid, pend_exp_valid, arr_exp_valid]),
+            seq=jnp.concatenate([prev_seq, pend_exp_seq, arr_exp_seq]),
+            gslot=jnp.concatenate([prev.gslot, pend.gslot, rows.gslot]),
+            cols=tuple(jnp.concatenate([a, b, c]) for a, b, c in
+                       zip(prev.cols, pend.cols, rows.cols)),
+        )
+
+        # reset rows, one per flush
+        F = B // n + 1
+        f = jnp.arange(F, dtype=jnp.int64)
+        reset_rows = Rows(
+            ts=jnp.full((F,), 0, jnp.int64) + now,
+            kind=jnp.full((F,), ev.RESET, jnp.int32),
+            valid=f < nflush,
+            seq=seq0 + f * span + n,
+            gslot=jnp.full((F,), -1, jnp.int32),
+            cols=tuple(jnp.full((F,), ev.default_value(t_), d)
+                       for t_, d in zip(self.schema.types, self.schema.dtypes)),
+        )
+
+        out = sort_rows(concat_rows(concat_rows(exp_rows, cur_rows), reset_rows))
+
+        # ---- new state ------------------------------------------------------
+        # pending' = arrivals with batch_idx == nflush (+ old pending if no flush)
+        np_old_valid = jnp.logical_and(pend.alive, nflush == 0)
+        np_arr_valid = jnp.logical_and(is_cur, batch_idx == nflush)
+        cand_valid = jnp.concatenate([np_old_valid, np_arr_valid])
+        cand_rank_src = jnp.concatenate([pend_rank, pos_in_batch])
+        cand_ts = jnp.concatenate([pend.ts, rows.ts])
+        cand_gslot = jnp.concatenate([pend.gslot, rows.gslot])
+        cand_cols = tuple(jnp.concatenate([pc, rc])
+                          for pc, rc in zip(pend.cols, rows.cols))
+        # scatter into fresh pending by rank
+        npend = empty_buffer(self.schema, n)
+        tgt = jnp.where(cand_valid, cand_rank_src, n).astype(jnp.int32)
+        def scat(dst, src):
+            return dst.at[tgt].set(src, mode="drop")
+        npend = Buffer(
+            ts=scat(npend.ts, cand_ts),
+            add_seq=npend.add_seq,
+            expire_seq=npend.expire_seq,
+            expire_ts=npend.expire_ts,
+            alive=jnp.zeros((n,), jnp.bool_).at[tgt].set(cand_valid, mode="drop"),
+            gslot=scat(npend.gslot, cand_gslot),
+            cols=tuple(scat(c0, c) for c0, c in zip(npend.cols, cand_cols)),
+        )
+
+        # prev' = last flushed batch (batch nflush-1) if any flush else prev
+        lb_old_valid = jnp.logical_and(pend.alive, nflush == 1)
+        lb_arr_valid = jnp.logical_and(is_cur, batch_idx == nflush - 1)
+        lbc_valid = jnp.concatenate([lb_old_valid, lb_arr_valid])
+        nprev0 = empty_buffer(self.schema, n)
+        tgt2 = jnp.where(lbc_valid, cand_rank_src, n).astype(jnp.int32)
+        def scat2(dst, src):
+            return dst.at[tgt2].set(src, mode="drop")
+        flushed_prev = Buffer(
+            ts=scat2(nprev0.ts, cand_ts),
+            add_seq=nprev0.add_seq, expire_seq=nprev0.expire_seq,
+            expire_ts=nprev0.expire_ts,
+            alive=jnp.zeros((n,), jnp.bool_).at[tgt2].set(lbc_valid, mode="drop"),
+            gslot=scat2(nprev0.gslot, cand_gslot),
+            cols=tuple(scat2(c0, c) for c0, c in zip(nprev0.cols, cand_cols)),
+        )
+        nprev = jax.tree.map(
+            lambda new, old: jnp.where(nflush > 0, new, old), flushed_prev, prev)
+
+        nseq = seq0 + nflush * span
+        return ((npend, nprev, nseq),
+                WindowOutput(out, None, jnp.asarray(NO_WAKEUP, jnp.int64)))
+
+
+class TimeBatchWindow(WindowProcessor):
+    """Tumbling time batch (reference: TimeBatchWindowProcessor).
+
+    Time is divided into [start + k*t, start + (k+1)*t) slices; at each slice
+    boundary the gathered events are emitted as CURRENT (preceded by the
+    previous slice as EXPIRED + RESET).  Driven by arrivals and TIMER rows.
+    """
+
+    name = "timeBatch"
+    needs_timer = True
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
+        super().__init__(schema, params, batch_capacity)
+        self.time_ms = _param_int(params, 0)
+        self.capacity = max(capacity_hint, 2 * batch_capacity)
+
+    @property
+    def out_capacity(self):
+        return 2 * self.capacity + 2 * self.batch_capacity + 2
+
+    def init_state(self):
+        return (
+            empty_buffer(self.schema, self.capacity),   # pending slice
+            empty_buffer(self.schema, self.capacity),   # previous slice
+            jnp.asarray(-1, jnp.int64),                 # slice start ts (-1 unset)
+            jnp.asarray(0, jnp.int64),                  # seq counter
+        )
+
+    def process(self, state, rows: Rows, now):
+        pend, prev, start0, seq0 = state
+        t = self.time_ms
+        C = self.capacity
+        B = rows.capacity
+
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        any_cur = jnp.any(is_cur)
+        first_ts = jnp.min(jnp.where(is_cur, rows.ts, BIG_SEQ))
+        start = jnp.where(start0 >= 0, start0, first_ts)
+
+        # how many slice boundaries passed by `now`?
+        elapsed = jnp.maximum(now - start, 0)
+        nflush = jnp.where(start0 >= 0,
+                           elapsed // t,
+                           jnp.maximum((now - first_ts), 0) // t)
+        nflush = jnp.where(jnp.logical_or(start0 >= 0, any_cur), nflush, 0)
+        flush = nflush > 0
+        # NOTE: if multiple slice boundaries pass in one gap, intermediate
+        # empty slices collapse — matching observable outputs (empty batches
+        # emit nothing).
+        new_start = jnp.where(flush, start + nflush * t, start)
+
+        # arrivals belong to pending slice if ts < boundary else to the new one
+        boundary = start + jnp.where(flush, nflush, 1) * t
+        to_pend = jnp.logical_and(is_cur, rows.ts < boundary)
+        to_next = jnp.logical_and(is_cur, jnp.logical_not(to_pend))
+
+        # flushed slice contents = pending + arrivals with ts < boundary
+        pend_rank = jnp.cumsum(pend.alive.astype(jnp.int64)) - 1
+        npend_fill = jnp.sum(pend.alive.astype(jnp.int64))
+        arr_rank = npend_fill + jnp.cumsum(to_pend.astype(jnp.int64)) - 1
+
+        # seq layout: expired prev [0..C), reset C, current flushed [C+1 ...)
+        exp_rows = Rows(
+            ts=prev.ts, kind=jnp.full((C,), ev.EXPIRED, jnp.int32),
+            valid=jnp.logical_and(prev.alive, flush),
+            seq=seq0 + jnp.cumsum(prev.alive.astype(jnp.int64)) - 1,
+            gslot=prev.gslot, cols=prev.cols,
+        )
+        reset_rows = Rows(
+            ts=jnp.full((1,), 0, jnp.int64) + now,
+            kind=jnp.full((1,), ev.RESET, jnp.int32),
+            valid=jnp.reshape(flush, (1,)),
+            seq=jnp.full((1,), seq0 + C, jnp.int64),
+            gslot=jnp.full((1,), -1, jnp.int32),
+            cols=tuple(jnp.full((1,), ev.default_value(t_), d)
+                       for t_, d in zip(self.schema.types, self.schema.dtypes)),
+        )
+        cur_rows = Rows(
+            ts=jnp.concatenate([pend.ts, rows.ts]),
+            kind=jnp.full((C + B,), ev.CURRENT, jnp.int32),
+            valid=jnp.concatenate([
+                jnp.logical_and(pend.alive, flush),
+                jnp.logical_and(to_pend, flush)]),
+            seq=seq0 + C + 1 + jnp.concatenate([pend_rank, arr_rank]),
+            gslot=jnp.concatenate([pend.gslot, rows.gslot]),
+            cols=tuple(jnp.concatenate([pc, rc])
+                       for pc, rc in zip(pend.cols, rows.cols)),
+        )
+        out = sort_rows(concat_rows(concat_rows(exp_rows, cur_rows), reset_rows))
+
+        # new pending: if flush -> arrivals beyond boundary; else pending+arrivals
+        keep_pend = jnp.logical_and(pend.alive, jnp.logical_not(flush))
+        arr_keep = jnp.where(flush, to_next, to_pend)
+        base_fill = jnp.sum(keep_pend.astype(jnp.int64))
+        cand_valid = jnp.concatenate([keep_pend, arr_keep])
+        cand_rank = jnp.concatenate([
+            pend_rank,
+            base_fill + jnp.cumsum(arr_keep.astype(jnp.int64)) - 1])
+        cand_ts = jnp.concatenate([pend.ts, rows.ts])
+        cand_gslot = jnp.concatenate([pend.gslot, rows.gslot])
+        cand_cols = tuple(jnp.concatenate([pc, rc])
+                          for pc, rc in zip(pend.cols, rows.cols))
+        tgt = jnp.where(cand_valid, cand_rank, C).astype(jnp.int32)
+        fresh = empty_buffer(self.schema, C)
+        npend = Buffer(
+            ts=fresh.ts.at[tgt].set(cand_ts, mode="drop"),
+            add_seq=fresh.add_seq, expire_seq=fresh.expire_seq,
+            expire_ts=fresh.expire_ts,
+            alive=jnp.zeros((C,), jnp.bool_).at[tgt].set(cand_valid, mode="drop"),
+            gslot=fresh.gslot.at[tgt].set(cand_gslot, mode="drop"),
+            cols=tuple(f.at[tgt].set(c, mode="drop")
+                       for f, c in zip(fresh.cols, cand_cols)),
+        )
+
+        # new prev: flushed slice if flush else old prev
+        ftgt = jnp.where(
+            jnp.concatenate([pend.alive, to_pend]),
+            jnp.concatenate([pend_rank, arr_rank]), C).astype(jnp.int32)
+        fprev = Buffer(
+            ts=fresh.ts.at[ftgt].set(cand_ts, mode="drop"),
+            add_seq=fresh.add_seq, expire_seq=fresh.expire_seq,
+            expire_ts=fresh.expire_ts,
+            alive=jnp.zeros((C,), jnp.bool_).at[ftgt].set(
+                jnp.concatenate([pend.alive, to_pend]), mode="drop"),
+            gslot=fresh.gslot.at[ftgt].set(cand_gslot, mode="drop"),
+            cols=tuple(f.at[ftgt].set(c, mode="drop")
+                       for f, c in zip(fresh.cols, cand_cols)),
+        )
+        nprev = jax.tree.map(lambda a, b: jnp.where(flush, a, b), fprev, prev)
+
+        nseq = jnp.where(flush, seq0 + 2 * C + B + 2, seq0)
+        nstart = jnp.where(jnp.logical_or(start0 >= 0, any_cur), new_start,
+                           jnp.asarray(-1, jnp.int64))
+        wake = jnp.where(nstart >= 0, nstart + t, NO_WAKEUP)
+        return ((npend, nprev, nstart, nseq), WindowOutput(out, None, wake))
+
+
+# ---------------------------------------------------------------------------
+
+WINDOW_TYPES = {
+    "length": LengthWindow,
+    "time": TimeWindow,
+    "lengthBatch": LengthBatchWindow,
+    "timeBatch": TimeBatchWindow,
+}
+
+
+def create_window(name: str, schema: ev.Schema, params, batch_capacity: int,
+                  capacity_hint: int = 2048) -> WindowProcessor:
+    if name not in WINDOW_TYPES:
+        raise ValueError(f"unknown window type {name!r}; "
+                         f"available: {sorted(WINDOW_TYPES)}")
+    return WINDOW_TYPES[name](schema, params, batch_capacity,
+                              capacity_hint=capacity_hint)

@@ -1,0 +1,92 @@
+"""SiddhiApp: the top-level AST / fluent builder.
+
+Reference: modules/siddhi-query-api/.../SiddhiApp.java
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+from .definition import (
+    AbstractDefinition,
+    AggregationDefinition,
+    Annotation,
+    FunctionDefinition,
+    StreamDefinition,
+    TableDefinition,
+    TriggerDefinition,
+    WindowDefinition,
+)
+from .query import ExecutionElement, OnDemandQuery, Partition, Query
+
+
+class SiddhiApp:
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+        self.stream_definition_map: Dict[str, StreamDefinition] = {}
+        self.table_definition_map: Dict[str, TableDefinition] = {}
+        self.window_definition_map: Dict[str, WindowDefinition] = {}
+        self.trigger_definition_map: Dict[str, TriggerDefinition] = {}
+        self.aggregation_definition_map: Dict[str, AggregationDefinition] = {}
+        self.function_definition_map: Dict[str, FunctionDefinition] = {}
+        self.execution_element_list: List[ExecutionElement] = []
+        self.annotations: List[Annotation] = []
+
+    @staticmethod
+    def siddhi_app(name: Optional[str] = None) -> "SiddhiApp":
+        return SiddhiApp(name)
+
+    def define_stream(self, d: StreamDefinition) -> "SiddhiApp":
+        self.stream_definition_map[d.id] = d
+        return self
+
+    def define_table(self, d: TableDefinition) -> "SiddhiApp":
+        self.table_definition_map[d.id] = d
+        return self
+
+    def define_window(self, d: WindowDefinition) -> "SiddhiApp":
+        self.window_definition_map[d.id] = d
+        return self
+
+    def define_trigger(self, d: TriggerDefinition) -> "SiddhiApp":
+        self.trigger_definition_map[d.id] = d
+        # a trigger implicitly defines a stream <id> (triggered_time long)
+        sd = StreamDefinition(d.id).attribute("triggered_time", "LONG")
+        self.stream_definition_map[d.id] = sd
+        return self
+
+    def define_aggregation(self, d: AggregationDefinition) -> "SiddhiApp":
+        self.aggregation_definition_map[d.id] = d
+        return self
+
+    def define_function(self, d: FunctionDefinition) -> "SiddhiApp":
+        self.function_definition_map[d.id] = d
+        return self
+
+    def add_query(self, q: Query) -> "SiddhiApp":
+        self.execution_element_list.append(q)
+        return self
+
+    def add_partition(self, p: Partition) -> "SiddhiApp":
+        self.execution_element_list.append(p)
+        return self
+
+    def annotation(self, ann: Annotation) -> "SiddhiApp":
+        self.annotations.append(ann)
+        return self
+
+    def get_annotation(self, name: str) -> Optional[Annotation]:
+        for a in self.annotations:
+            if a.name.lower() == name.lower():
+                return a
+        return None
+
+    def definition(self, id: str) -> AbstractDefinition:
+        for m in (
+            self.stream_definition_map,
+            self.table_definition_map,
+            self.window_definition_map,
+            self.aggregation_definition_map,
+        ):
+            if id in m:
+                return m[id]
+        raise KeyError(f"no definition for {id!r}")

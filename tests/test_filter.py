@@ -1,0 +1,180 @@
+"""Filter + projection e2e tests via the fluent API.
+
+Modeled on the reference's behavioral test pattern
+(TEST/query/FilterTestCase1.java: build app, attach callback, send events,
+assert counts/payloads)."""
+import pytest
+
+from siddhi_tpu import Event, SiddhiManager
+from siddhi_tpu.query_api import (
+    Expression as E,
+    InputStream,
+    Query,
+    Selector,
+    SiddhiApp,
+    StreamDefinition,
+)
+
+
+def make_app(query):
+    app = SiddhiApp("FilterTest")
+    app.define_stream(
+        StreamDefinition.id("cseEventStream")
+        .attribute("symbol", "STRING")
+        .attribute("price", "FLOAT")
+        .attribute("volume", "INT"))
+    app.add_query(query)
+    return app
+
+
+def collect(runtime, name):
+    got = []
+    runtime.add_callback(
+        name, lambda ts, ins, outs: got.append((ts, ins, outs)))
+    return got
+
+
+class TestFilter:
+    def test_filter_greater_than(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream")
+                    .filter(E.compare(E.variable("volume"), ">", E.value(50))))
+             .select(Selector.selector()
+                     .select(E.variable("symbol"))
+                     .select(E.variable("price")))
+             .insert_into("outputStream"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["WSO2", 55.6, 100])
+        h.send(["IBM", 75.6, 40])
+        h.send(["GOOG", 12.0, 200])
+        ins = [e for _, i, _ in got if i for e in i]
+        assert len(ins) == 2
+        assert ins[0].data == ["WSO2", pytest.approx(55.6)]
+        assert ins[1].data == ["GOOG", pytest.approx(12.0)]
+
+    def test_filter_string_equality(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream")
+                    .filter(E.compare(E.variable("symbol"), "==",
+                                      E.value("IBM"))))
+             .select(Selector.selector().select(E.variable("volume")))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["WSO2", 55.6, 100])
+        h.send(["IBM", 75.6, 40])
+        h.send(["IBM", 5.6, 7])
+        ins = [e for _, i, _ in got if i for e in i]
+        assert [e.data for e in ins] == [[40], [7]]
+
+    def test_filter_and_or(self, manager):
+        cond = E.and_(
+            E.compare(E.variable("price"), ">", E.value(50.0)),
+            E.or_(E.compare(E.variable("volume"), "<", E.value(100)),
+                  E.compare(E.variable("symbol"), "==", E.value("WSO2"))))
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream").filter(cond))
+             .select(Selector.selector().select(E.variable("symbol")))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["WSO2", 55.6, 100])   # price>50, symbol==WSO2 -> pass
+        h.send(["IBM", 75.6, 400])    # price>50 but vol>=100 & !=WSO2 -> drop
+        h.send(["IBM", 75.6, 40])     # pass
+        h.send(["IBM", 5.0, 40])      # price<50 -> drop
+        ins = [e for _, i, _ in got if i for e in i]
+        assert [e.data for e in ins] == [["WSO2"], ["IBM"]]
+
+    def test_arithmetic_projection(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream"))
+             .select(Selector.selector()
+                     .select("total", E.multiply(E.variable("price"),
+                                                 E.variable("volume")))
+                     .select("vol2", E.add(E.variable("volume"), E.value(5))))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        rt.get_input_handler("cseEventStream").send(["WSO2", 2.5, 10])
+        ins = [e for _, i, _ in got if i for e in i]
+        assert ins[0].data == [pytest.approx(25.0), 15]
+
+    def test_select_all(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream"))
+             .select(Selector.selector())
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        rt.get_input_handler("cseEventStream").send(["WSO2", 2.5, 10])
+        ins = [e for _, i, _ in got if i for e in i]
+        assert ins[0].data == ["WSO2", pytest.approx(2.5), 10]
+
+    def test_chained_queries(self, manager):
+        q1 = (Query.query()
+              .from_(InputStream.stream("cseEventStream")
+                     .filter(E.compare(E.variable("volume"), ">", E.value(10))))
+              .select(Selector.selector()
+                      .select(E.variable("symbol"))
+                      .select(E.variable("volume")))
+              .insert_into("midStream"))
+        q2 = (Query.query()
+              .from_(InputStream.stream("midStream")
+                     .filter(E.compare(E.variable("volume"), "<", E.value(100))))
+              .select(Selector.selector().select(E.variable("symbol")))
+              .insert_into("outStream"))
+        app = make_app(q1)
+        app.add_query(q2)
+        rt = manager.create_siddhi_app_runtime(app)
+        got = collect(rt, "query2")
+        stream_got = []
+        rt.add_callback("outStream", lambda evs: stream_got.extend(evs))
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["A", 1.0, 5])     # dropped by q1
+        h.send(["B", 1.0, 50])    # passes both
+        h.send(["C", 1.0, 500])   # dropped by q2
+        ins = [e for _, i, _ in got if i for e in i]
+        assert [e.data for e in ins] == [["B"]]
+        assert [e.data for e in stream_got] == [["B"]]
+
+    def test_batch_send(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream")
+                    .filter(E.compare(E.variable("volume"), ">=", E.value(100))))
+             .select(Selector.selector().select(E.variable("volume")))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send([["S", 1.0, v] for v in range(80, 120)])
+        ins = [e for _, i, _ in got if i for e in i]
+        assert [e.data[0] for e in ins] == list(range(100, 120))
+
+    def test_if_then_else_and_math(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream"))
+             .select(Selector.selector()
+                     .select("cls", E.function(
+                         "ifThenElse",
+                         E.compare(E.variable("volume"), ">", E.value(50)),
+                         E.value(1), E.value(0))))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["A", 1.0, 100])
+        h.send(["B", 1.0, 10])
+        ins = [e for _, i, _ in got if i for e in i]
+        assert [e.data for e in ins] == [[1], [0]]
