@@ -93,7 +93,10 @@ class QueryRuntime:
     def __init__(self, planned: PlannedQuery, app: "SiddhiAppRuntime"):
         self.planned = planned
         self.app = app
-        self.state = planned.init_state()
+        # force-copy every leaf: constant-folding can alias identical init
+        # arrays into one buffer, which breaks donated-argument execution
+        self.state = jax.tree.map(
+            lambda x: jax.numpy.array(x, copy=True), planned.init_state())
         self.callbacks: List[Callable] = []
         self.next_wakeup: int = _NO_WAKEUP_INT
 
@@ -186,13 +189,27 @@ class _Scheduler:
         self._cv = threading.Condition()
         self._counter = 0
         self._running = False
+        self._draining = False
         self._thread: Optional[threading.Thread] = None
 
     def start(self):
+        if self.app.playback:
+            return  # event-driven time: timers fire from _route drains
         self._running = True
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="siddhi-scheduler")
         self._thread.start()
+
+    def drain_playback(self, now: int) -> None:
+        if self._draining:
+            return
+        self._draining = True
+        try:
+            while self._heap and self._heap[0][0] <= now:
+                ts, _, q = heapq.heappop(self._heap)
+                q.on_timer(ts)
+        finally:
+            self._draining = False
 
     def stop(self):
         with self._cv:
@@ -242,7 +259,11 @@ class SiddhiAppRuntime:
         self._lock = threading.RLock()
         self._scheduler = _Scheduler(self)
         self._started = False
-        self.playback = False
+        # playback: event-driven time (reference: @app:playback,
+        # CORE/util/timestamp/TimestampGeneratorImpl.java:118)
+        pb = app.get_annotation("app:playback")
+        self.playback = pb is not None
+        self._playback_time = 0
 
         # schemas & junctions
         self.schemas: Dict[str, ev.Schema] = {}
@@ -310,6 +331,8 @@ class SiddhiAppRuntime:
             self._started = False
 
     def timestamp_millis(self) -> int:
+        if self.playback:
+            return self._playback_time
         return current_millis()
 
     # -- I/O ------------------------------------------------------------------
@@ -331,8 +354,15 @@ class SiddhiAppRuntime:
         junction = self.junctions.get(stream_id)
         if junction is None:
             raise KeyError(f"undefined stream {stream_id!r}")
+        if self.playback and events:
+            self._playback_time = max(self._playback_time,
+                                      max(e.timestamp for e in events))
         now = self.timestamp_millis()
         with self._lock:
+            # in playback, fire timers the event clock has passed first (they
+            # are earlier in event time than the new events)
+            if self.playback:
+                self._scheduler.drain_playback(now)
             junction.publish(events, now)
 
     # -- snapshot/restore ------------------------------------------------------

@@ -208,26 +208,30 @@ class LengthWindow(WindowProcessor):
         # order arrivals among themselves: k = 0..ncur-1
         k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1   # [B]
 
-        # combined virtual sequence: old alive entries (by add_seq) then currents
-        # old entries compacted to the front, oldest first
+        # combined virtual sequence: old alive entries (by add_seq) then
+        # currents, BOTH compacted to the front of their region; virtual index
+        # v maps to physical position v (old region) or C + v - count0.
         old_key = jnp.where(buf.alive, buf.add_seq, BIG_SEQ)
         old_order = jnp.argsort(old_key)               # [C] alive first by age
         count0 = jnp.sum(buf.alive.astype(jnp.int64))
+        cur_order = jnp.argsort(jnp.where(is_cur, k, BIG_SEQ))  # [B]
 
-        comb_ts = jnp.concatenate([buf.ts[old_order], rows.ts])
-        comb_gslot = jnp.concatenate([buf.gslot[old_order], rows.gslot])
-        comb_cols = tuple(jnp.concatenate([bc[old_order], rc])
+        comb_ts = jnp.concatenate([buf.ts[old_order], rows.ts[cur_order]])
+        comb_gslot = jnp.concatenate([buf.gslot[old_order],
+                                      rows.gslot[cur_order]])
+        comb_cols = tuple(jnp.concatenate([bc[old_order], rc[cur_order]])
                           for bc, rc in zip(buf.cols, rows.cols))
+        cur_addseq = jnp.where(is_cur, seq0 + 2 * k + 1, BIG_SEQ)
         comb_addseq = jnp.concatenate([buf.add_seq[old_order],
-                                       jnp.where(is_cur, seq0 + 2 * k + 1, BIG_SEQ)])
-        # validity of combined slots: first count0 old ones; currents where is_cur
-        comb_valid = jnp.concatenate([
-            jnp.arange(C, dtype=jnp.int64) < count0, is_cur])
+                                       cur_addseq[cur_order]])
 
-        # the k-th arrival evicts combined[count0 + k - length] (if >= 0)
+        def phys(v):
+            return jnp.where(v < count0, v, C + v - count0)
+
+        # the k-th arrival evicts virtual entry (count0 + k - length) (if >= 0)
         evict_pos = (count0 + k - C)
         has_evict = jnp.logical_and(is_cur, evict_pos >= 0)
-        safe_pos = jnp.clip(evict_pos, 0, C + B - 1).astype(jnp.int32)
+        safe_pos = jnp.clip(phys(evict_pos), 0, C + B - 1).astype(jnp.int32)
 
         exp_rows = Rows(
             ts=comb_ts[safe_pos],
@@ -247,9 +251,9 @@ class LengthWindow(WindowProcessor):
         # new buffer = last `length` of combined valid entries
         total = count0 + ncur
         start = jnp.maximum(total - C, 0)
-        take = jnp.arange(C, dtype=jnp.int64) + start        # [C]
+        take = jnp.arange(C, dtype=jnp.int64) + start        # [C] virtual
         tvalid = take < total
-        tpos = jnp.clip(take, 0, C + B - 1).astype(jnp.int32)
+        tpos = jnp.clip(phys(take), 0, C + B - 1).astype(jnp.int32)
         # expire_seq of evicted entries: entry at combined pos p (p < total-C
         # after the batch) was evicted by arrival k = p - count0 + C
         nbuf = Buffer(

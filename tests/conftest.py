@@ -2,9 +2,16 @@ import os
 
 # Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
 # exercised without TPU hardware (see task brief / SURVEY.md).
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The axon PJRT sitecustomize force-sets jax_platforms="axon,cpu" at
+# interpreter boot, overriding the env var — override it back so tests run on
+# the 8-device virtual CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,214 @@
+"""Window semantics tests (modeled on TEST/query/window/LengthWindowTestCase,
+LengthBatchWindowTestCase, TimeWindowTestCase behavioral assertions)."""
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.query_api import (
+    Expression as E,
+    InputStream,
+    Query,
+    Selector,
+    SiddhiApp,
+    StreamDefinition,
+)
+
+
+def make_app(*queries):
+    app = SiddhiApp("WindowTest")
+    app.define_stream(
+        StreamDefinition.id("cseEventStream")
+        .attribute("symbol", "STRING")
+        .attribute("price", "FLOAT")
+        .attribute("volume", "INT"))
+    for q in queries:
+        app.add_query(q)
+    return app
+
+
+def collect(runtime, name):
+    got = {"in": [], "out": []}
+    def cb(ts, ins, outs):
+        if ins:
+            got["in"].extend(ins)
+        if outs:
+            got["out"].extend(outs)
+    runtime.add_callback(name, cb)
+    return got
+
+
+class TestLengthWindow:
+    def test_sliding_sum(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream").window("length",
+                                                                E.value(2)))
+             .select(Selector.selector()
+                     .select(E.variable("symbol"))
+                     .select("tot", E.function("sum", E.variable("volume"))))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["A", 1.0, 10])
+        h.send(["B", 1.0, 20])
+        h.send(["C", 1.0, 30])
+        h.send(["D", 1.0, 40])
+        assert [e.data for e in got["in"]] == [
+            ["A", 10], ["B", 30], ["C", 50], ["D", 70]]
+        # expired events carry the aggregate AFTER their removal:
+        # C arrives -> window [B,C]=50, A removed at 30-10=20;
+        # D arrives -> B removed at 50-20=30, then D makes 70
+        assert [e.data for e in got["out"]] == [["A", 20], ["B", 30]]
+
+    def test_window_overflow_in_one_batch(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream").window("length",
+                                                                E.value(3)))
+             .select(Selector.selector()
+                     .select("c", E.function("count")))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        # one batch of 10 events through a length-3 window
+        h.send([["S", 1.0, v] for v in range(10)])
+        # running count: grows to 3 then stays (expired balance currents)
+        assert [e.data[0] for e in got["in"]] == [1, 2, 3, 3, 3, 3, 3, 3, 3, 3]
+        assert len(got["out"]) == 7
+
+    def test_groupby_windowed_sum(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream").window("length",
+                                                                E.value(2)))
+             .select(Selector.selector()
+                     .select(E.variable("symbol"))
+                     .select("tot", E.function("sum", E.variable("volume")))
+                     .group_by(E.variable("symbol")))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["IBM", 1.0, 10])
+        h.send(["WSO2", 1.0, 100])
+        h.send(["IBM", 1.0, 20])   # IBM window-local: [10, 20]
+        h.send(["WSO2", 1.0, 200])
+        h.send(["IBM", 1.0, 30])
+        # the length window is global FIFO (not per-group): each arrival past
+        # capacity 2 evicts the oldest event, whichever group it belongs to
+        assert [e.data for e in got["in"]] == [
+            ["IBM", 10], ["WSO2", 100], ["IBM", 20], ["WSO2", 200],
+            ["IBM", 30]]
+        assert [e.data for e in got["out"]] == [
+            ["IBM", 0], ["WSO2", 0], ["IBM", 0]]
+
+
+class TestLengthBatchWindow:
+    def test_batch_avg(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream").window(
+                 "lengthBatch", E.value(3)))
+             .select(Selector.selector()
+                     .select("a", E.function("avg", E.variable("price"))))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["A", 10.0, 1])
+        h.send(["B", 20.0, 1])
+        assert got["in"] == []          # nothing until the batch fills
+        h.send(["C", 30.0, 1])
+        assert [e.data[0] for e in got["in"]] == [
+            pytest.approx(10.0), pytest.approx(15.0), pytest.approx(20.0)]
+        got["in"].clear()
+        h.send(["D", 40.0, 1])
+        h.send(["E", 50.0, 1])
+        h.send(["F", 60.0, 1])
+        assert [e.data[0] for e in got["in"]] == [
+            pytest.approx(40.0), pytest.approx(45.0), pytest.approx(50.0)]
+        # previous batch replayed as expired
+        assert len(got["out"]) == 3
+
+    def test_batch_in_single_send(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream").window(
+                 "lengthBatch", E.value(4)))
+             .select(Selector.selector()
+                     .select("s", E.function("sum", E.variable("volume"))))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send([["S", 1.0, v] for v in [1, 2, 3, 4, 5, 6, 7, 8, 9]])
+        # two complete batches flushed; 9th pends
+        assert [e.data[0] for e in got["in"]] == [
+            1, 3, 6, 10,          # batch 1 running sums
+            5, 11, 18, 26]        # batch 2 running sums (after reset)
+
+
+def make_playback_app(*queries):
+    from siddhi_tpu.query_api import Annotation
+    app = make_app(*queries)
+    app.annotation(Annotation("app:playback"))
+    return app
+
+
+class TestTimeWindow:
+    def test_time_window_expiry_playback(self, manager):
+        """Event-driven time: expiry fires when the event clock passes it."""
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream").window(
+                 "time", E.Time.millisec(150)))
+             .select(Selector.selector()
+                     .select(E.variable("symbol"))
+                     .select("c", E.function("count")))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_playback_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["A", 1.0, 10], timestamp=1000)
+        h.send(["B", 1.0, 20], timestamp=1100)
+        assert [e.data for e in got["in"]] == [["A", 1], ["B", 2]]
+        # advance the event clock far past both expiries
+        h.send(["C", 1.0, 30], timestamp=2000)
+        assert [e.data for e in got["out"]] == [["A", 1], ["B", 0]]
+        assert got["in"][-1].data == ["C", 1]
+
+    def test_time_window_sliding_on_arrival(self, manager):
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream").window(
+                 "time", E.Time.millisec(100)))
+             .select(Selector.selector()
+                     .select("c", E.function("count")))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_playback_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["A", 1.0, 10], timestamp=1000)
+        h.send(["B", 1.0, 20], timestamp=1250)  # A expired before B arrives
+        assert got["in"][-1].data == [1]
+
+    def test_time_window_realtime_scheduler(self, manager):
+        """Wall-clock mode: the scheduler thread must expire entries."""
+        q = (Query.query()
+             .from_(InputStream.stream("cseEventStream").window(
+                 "time", E.Time.millisec(200)))
+             .select(Selector.selector()
+                     .select("c", E.function("count")))
+             .insert_into("out"))
+        rt = manager.create_siddhi_app_runtime(make_app(q))
+        got = collect(rt, "query1")
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["W", 1.0, 0])  # warm-up: compile the step
+        deadline = time.time() + 10
+        while len(got["out"]) < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert [e.data for e in got["out"]] == [[0]]
