@@ -1,0 +1,329 @@
+"""SiddhiQL parser tests (modeled on siddhi-query-compiler src/test parse
+fixtures)."""
+import pytest
+
+from siddhi_tpu.compiler import SiddhiCompiler
+from siddhi_tpu.compiler.tokenizer import SiddhiParserException
+from siddhi_tpu.query_api import (
+    AbsentStreamStateElement,
+    Compare,
+    Constant,
+    CountStateElement,
+    EveryStateElement,
+    JoinInputStream,
+    LogicalStateElement,
+    NextStateElement,
+    Partition,
+    Query,
+    SingleInputStream,
+    StateInputStream,
+    StreamStateElement,
+    ValuePartitionType,
+    Variable,
+    Window,
+)
+
+
+class TestDefinitions:
+    def test_stream_definition(self):
+        app = SiddhiCompiler.parse(
+            "define stream StockStream (symbol string, price float, "
+            "volume long);")
+        d = app.stream_definition_map["StockStream"]
+        assert d.attribute_names == ["symbol", "price", "volume"]
+        assert [a.type for a in d.attribute_list] == ["STRING", "FLOAT",
+                                                      "LONG"]
+
+    def test_table_and_annotations(self):
+        app = SiddhiCompiler.parse("""
+            @app:name('TestApp')
+            @PrimaryKey('symbol')
+            @Index('volume')
+            define table StockTable (symbol string, price float, volume long);
+        """)
+        assert app.name == "TestApp"
+        d = app.table_definition_map["StockTable"]
+        assert d.get_annotation("PrimaryKey").element() == "symbol"
+        assert d.get_annotation("Index").element() == "volume"
+
+    def test_window_definition(self):
+        app = SiddhiCompiler.parse(
+            "define window SymbolWindow (symbol string, price float) "
+            "time(1 sec) output all events;")
+        d = app.window_definition_map["SymbolWindow"]
+        assert d.window.name == "time"
+        assert d.window.parameters[0].value == 1000
+        assert d.output_event_type == "ALL_EVENTS"
+
+    def test_trigger_definitions(self):
+        app = SiddhiCompiler.parse("""
+            define trigger FiveMinTrigger at every 5 min;
+            define trigger StartTrigger at 'start';
+        """)
+        assert app.trigger_definition_map["FiveMinTrigger"].at_every == 300000
+        assert app.trigger_definition_map["StartTrigger"].at == "start"
+
+    def test_function_definition(self):
+        app = SiddhiCompiler.parse("""
+            define function concatFn[javascript] return string {
+                var r = data[0] + data[1]; return r
+            };
+        """)
+        f = app.function_definition_map["concatFn"]
+        assert f.language == "javascript"
+        assert f.return_type == "STRING"
+        assert "data" in f.body
+
+    def test_aggregation_definition(self):
+        app = SiddhiCompiler.parse("""
+            define stream TradeStream (symbol string, price double,
+                                       volume long, timestamp long);
+            define aggregation TradeAggregation
+              from TradeStream
+              select symbol, avg(price) as avgPrice, sum(price) as total
+                group by symbol
+              aggregate by timestamp every sec ... year;
+        """)
+        a = app.aggregation_definition_map["TradeAggregation"]
+        assert a.time_periods == ["SECONDS", "MINUTES", "HOURS", "DAYS",
+                                  "MONTHS", "YEARS"]
+        assert a.aggregate_attribute.attribute_name == "timestamp"
+        assert len(a.selector.selection_list) == 3
+
+
+class TestQueries:
+    def test_filter_query(self):
+        app = SiddhiCompiler.parse("""
+            define stream S (symbol string, price float, volume int);
+            @info(name = 'query1')
+            from S[volume > 100 and symbol == 'IBM']
+            select symbol, price insert into Out;
+        """)
+        q = app.execution_element_list[0]
+        assert isinstance(q, Query)
+        assert q.get_annotation("info").element("name") == "query1"
+        s = q.input_stream
+        assert isinstance(s, SingleInputStream)
+        assert s.stream_id == "S"
+        assert len(s.stream_handlers) == 1
+        assert q.output_stream.target_id == "Out"
+
+    def test_window_query(self):
+        app = SiddhiCompiler.parse("""
+            define stream S (symbol string, price float, volume int);
+            from S[price > 10]#window.lengthBatch(1000)
+            select symbol, avg(price) as avgPrice
+            group by symbol having avgPrice > 50
+            order by avgPrice desc limit 10 offset 2
+            insert expired events into Out;
+        """)
+        q = app.execution_element_list[0]
+        w = q.input_stream.window_handler
+        assert w.name == "lengthBatch"
+        assert w.parameters[0].value == 1000
+        sel = q.selector
+        assert sel.group_by_list[0].attribute_name == "symbol"
+        assert sel.having_expression is not None
+        assert sel.order_by_list[0].order == "DESC"
+        assert sel.limit == 10 and sel.offset == 2
+        assert q.output_stream.output_event_type == "EXPIRED_EVENTS"
+
+    def test_join_query(self):
+        app = SiddhiCompiler.parse("""
+            define stream A (symbol string, price float);
+            define stream B (symbol string, volume int);
+            from A#window.length(10) as l
+              join B#window.length(20) as r
+              on l.symbol == r.symbol
+            select l.symbol as symbol, price, volume
+            insert into Out;
+        """)
+        q = app.execution_element_list[0]
+        j = q.input_stream
+        assert isinstance(j, JoinInputStream)
+        assert j.type == JoinInputStream.JOIN
+        assert j.left_input_stream.stream_reference_id == "l"
+        assert j.right_input_stream.stream_reference_id == "r"
+        assert isinstance(j.on_compare, Compare)
+
+    def test_outer_joins(self):
+        for kw, jt in [("left outer join", "LEFT_OUTER_JOIN"),
+                       ("right outer join", "RIGHT_OUTER_JOIN"),
+                       ("full outer join", "FULL_OUTER_JOIN")]:
+            app = SiddhiCompiler.parse(f"""
+                define stream A (symbol string);
+                define stream B (symbol string);
+                from A#window.length(5) {kw} B#window.length(5)
+                  on A.symbol == B.symbol
+                select A.symbol as s insert into Out;
+            """)
+            assert app.execution_element_list[0].input_stream.type == jt
+
+    def test_pattern_query(self):
+        app = SiddhiCompiler.parse("""
+            define stream S1 (symbol string, price float);
+            define stream S2 (symbol string, price float);
+            from every e1=S1[price > 20] -> e2=S2[price > e1.price]
+            within 1 sec
+            select e1.symbol as s1, e2.price as p2
+            insert into Out;
+        """)
+        q = app.execution_element_list[0]
+        st = q.input_stream
+        assert isinstance(st, StateInputStream)
+        assert st.state_type == "PATTERN"
+        assert st.within_time == 1000
+        root = st.state_element
+        assert isinstance(root, NextStateElement)
+        assert isinstance(root.state_element, EveryStateElement)
+        e1 = root.state_element.state_element
+        assert isinstance(e1, StreamStateElement)
+        assert e1.basic_single_input_stream.stream_reference_id == "e1"
+
+    def test_pattern_count_and_logical(self):
+        app = SiddhiCompiler.parse("""
+            define stream A (x int);
+            define stream B (x int);
+            define stream C (x int);
+            from every a=A -> b=B[x > a.x]<2:5> -> c=C and d=A
+            select a.x as ax insert into Out;
+        """)
+        st = app.execution_element_list[0].input_stream
+        chain = st.state_element
+        b = chain.next_state_element.state_element
+        assert isinstance(b, CountStateElement)
+        assert (b.min_count, b.max_count) == (2, 5)
+        logical = chain.next_state_element.next_state_element
+        assert isinstance(logical, LogicalStateElement)
+        assert logical.type == "AND"
+
+    def test_absent_pattern(self):
+        app = SiddhiCompiler.parse("""
+            define stream A (x int);
+            define stream B (x int);
+            from A -> not B for 1 sec
+            select * insert into Out;
+        """)
+        st = app.execution_element_list[0].input_stream
+        absent = st.state_element.next_state_element
+        assert isinstance(absent, AbsentStreamStateElement)
+        assert absent.waiting_time == 1000
+
+    def test_sequence_query(self):
+        app = SiddhiCompiler.parse("""
+            define stream S (symbol string, price float);
+            from every e1=S, e2=S[price > e1.price]
+            select e1.symbol as s insert into Out;
+        """)
+        st = app.execution_element_list[0].input_stream
+        assert st.state_type == "SEQUENCE"
+        assert isinstance(st.state_element, NextStateElement)
+
+    def test_partition(self):
+        app = SiddhiCompiler.parse("""
+            define stream S (symbol string, price float);
+            partition with (symbol of S)
+            begin
+              @info(name='q1')
+              from S select symbol, price insert into #Inner;
+              from #Inner select symbol insert into Out;
+            end;
+        """)
+        p = app.execution_element_list[0]
+        assert isinstance(p, Partition)
+        pt = p.partition_type_map["S"]
+        assert isinstance(pt, ValuePartitionType)
+        assert len(p.query_list) == 2
+        assert p.query_list[1].input_stream.is_inner_stream
+
+    def test_output_rate(self):
+        app = SiddhiCompiler.parse("""
+            define stream S (x int);
+            from S select x output last every 5 events insert into Out;
+        """)
+        r = app.execution_element_list[0].output_rate
+        assert (r.type, r.value, r.behavior) == ("EVENTS", 5, "LAST")
+
+    def test_time_literals(self):
+        app = SiddhiCompiler.parse("""
+            define stream S (x int);
+            from S#window.time(1 min 30 sec) select x insert into Out;
+        """)
+        w = app.execution_element_list[0].input_stream.window_handler
+        assert w.parameters[0].value == 90_000
+
+    def test_update_output(self):
+        app = SiddhiCompiler.parse("""
+            define stream S (symbol string, price float);
+            define table T (symbol string, price float);
+            from S select symbol, price
+            update or insert into T
+              set T.price = price
+              on T.symbol == symbol;
+        """)
+        q = app.execution_element_list[0]
+        assert q.output_stream.target_id == "T"
+        assert len(q.output_stream.update_set.set_attribute_list) == 1
+
+    def test_on_demand_query(self):
+        oq = SiddhiCompiler.parse_on_demand_query(
+            "from StockTable on price > 40 select symbol, price")
+        assert oq.input_store.store_id == "StockTable"
+        assert oq.type == "FIND"
+        assert len(oq.selector.selection_list) == 2
+
+    def test_parse_error_has_location(self):
+        with pytest.raises(SiddhiParserException):
+            SiddhiCompiler.parse("define stream S (x int) from")
+
+    def test_comments(self):
+        app = SiddhiCompiler.parse("""
+            -- line comment
+            // another
+            /* block
+               comment */
+            define stream S (x int);
+            from S select x insert into Out;
+        """)
+        assert "S" in app.stream_definition_map
+
+
+class TestEndToEndSiddhiQL:
+    def test_filter_via_string(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            @app:name('FilterApp')
+            define stream cseEventStream (symbol string, price float,
+                                          volume long);
+            @info(name = 'query1')
+            from cseEventStream[volume < 150]
+            select symbol, price
+            insert into outputStream;
+        """)
+        got = []
+        rt.add_callback("query1", lambda ts, i, o: got.extend(i or []))
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send(["WSO2", 55.6, 100])
+        h.send(["IBM", 75.6, 400])
+        h.send(["GOOG", 50.0, 30])
+        assert [e.data for e in got] == [
+            ["WSO2", pytest.approx(55.6)], ["GOOG", pytest.approx(50.0)]]
+
+    def test_group_by_window_via_string(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream cseEventStream (symbol string, price float,
+                                          volume int);
+            @info(name = 'query1')
+            from cseEventStream#window.lengthBatch(4)
+            select symbol, sum(volume) as total
+            group by symbol
+            insert into outputStream;
+        """)
+        got = []
+        rt.add_callback("query1", lambda ts, i, o: got.extend(i or []))
+        rt.start()
+        h = rt.get_input_handler("cseEventStream")
+        h.send([["IBM", 1.0, 10], ["WSO2", 1.0, 5],
+                ["IBM", 1.0, 20], ["WSO2", 1.0, 15]])
+        assert [e.data for e in got] == [
+            ["IBM", 10], ["WSO2", 5], ["IBM", 30], ["WSO2", 20]]
