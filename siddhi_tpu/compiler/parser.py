@@ -409,9 +409,11 @@ class Parser:
                         i + 1 < len(toks) and toks[i + 1].kind == "ID" and
                         toks[i + 1].lower in ("outer", "join")):
                     return "join"
-                if lw in ("every",):
-                    kind = "pattern" if kind == "standard" else kind
-                if lw == "not" and kind == "standard":
+                if lw in ("every", "not", "and", "or") and kind == "standard":
+                    kind = "pattern"
+                # event binding  e1=Stream  (depth-0 '=')
+                if (toks[i + 1].kind == "PUNCT" and toks[i + 1].text == "="
+                        and kind == "standard"):
                     kind = "pattern"
             i += 1
         return kind

@@ -158,6 +158,13 @@ def compile_expression(expr: Expression, scope: Scope) -> CompiledExpr:
         if key is None:  # bound pseudo-column (aggregator output etc.)
             inner = scope.bound_names[expr.attribute_name]
             return CompiledExpr(inner.fn, inner.type)
+        if expr.stream_index is not None:
+            # pattern count-state index: e1[2].attr / e1[last].attr resolve
+            # through per-depth env entries provided by the pattern runtime
+            idx = expr.stream_index if expr.stream_index >= 0 else -1
+            def fn(env, _k=f"{key}@{idx}", _p=pos):
+                return env[_k][_p]
+            return CompiledExpr(fn, t)
         def fn(env, _k=key, _p=pos):
             return env[_k][_p]
         return CompiledExpr(fn, t)

@@ -1,0 +1,646 @@
+"""Pattern / sequence matching as a vectorized slot-slab NFA.
+
+Reference behavior (what): CORE/query/input/stream/state/* — chains of
+Pre/Post state processors holding per-pending-StateEvent lists, supporting
+`every`, count quantifiers <m:n>, logical and/or, absent (`not X for t`) and
+`within` (StreamPreStateProcessor.java:363-403 is the per-event O(pending)
+inner loop; StateInputStreamParser.java:76-146 builds the chain).
+
+TPU-native design (how): a pattern compiles to a *linear chain of atoms*.
+Runtime state is a fixed slab of P pending slots per key with captured event
+columns per atom.  One `step` consumes a micro-batch laid out per key as
+[K,E] (the host groups events by partition key): a lax.scan walks the E
+event columns — sequential semantics within a key — and each tick evaluates
+every chain position for every (key, slot) in parallel, so the reference's
+O(pending × events) Java loop becomes a handful of [K,P] vector ops per
+tick.  Forked continuations (count quantifiers, `every` seeds) allocate free
+slots by masked ranking with drop-on-overflow; completions emit capture rows
+consumed by the query selector.
+
+Tick phase order (strict): within-expiry -> absent-deadline advance ->
+match eval (pre-capture state) -> in-place capture -> emission gather ->
+fork/seed spawn -> in-place advance / kill / deactivate.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..query_api.expression import Expression
+from ..query_api.query import (
+    AbsentStreamStateElement,
+    CountStateElement,
+    EveryStateElement,
+    Filter,
+    LogicalStateElement,
+    NextStateElement,
+    SingleInputStream,
+    StateElement,
+    StateInputStream,
+    StreamStateElement,
+)
+from . import event as ev
+from .executor import CompileError, CompiledExpr, Scope, compile_expression
+
+BIG = jnp.iinfo(jnp.int64).max // 4
+
+
+# ---------------------------------------------------------------------------
+# Compilation: StateElement tree -> linear atom chain
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Atom:
+    pos: int
+    stream_id: str
+    ref: str
+    filter_expr: Optional[Expression]
+    min_count: int = 1
+    max_count: int = 1            # -1 == ANY
+    absent: bool = False
+    waiting_time: Optional[int] = None
+    every: bool = False
+    logical: Optional[str] = None  # 'AND' | 'OR' (self = side 0)
+    partner: Optional["Atom"] = None
+    capture_depth: int = 1
+
+    @property
+    def is_count(self) -> bool:
+        return self.max_count != 1 or self.min_count != 1
+
+    @property
+    def ckey(self) -> str:
+        return f"{self.pos}:{self.ref}"
+
+
+@dataclasses.dataclass
+class PatternSpec:
+    atoms: List[Atom]
+    state_type: str               # PATTERN | SEQUENCE
+    within: Optional[int]
+    count_cap: int = 8
+
+    @property
+    def n_states(self) -> int:
+        return len(self.atoms)
+
+    @property
+    def stream_ids(self) -> List[str]:
+        out = []
+        for a in self.all_atoms():
+            if a.stream_id not in out:
+                out.append(a.stream_id)
+        return out
+
+    def all_atoms(self):
+        for a in self.atoms:
+            yield a
+            if a.partner is not None:
+                yield a.partner
+
+    @property
+    def has_absent(self) -> bool:
+        return any(a.absent for a in self.atoms)
+
+
+def linearize(sis: StateInputStream, count_cap: int = 8) -> PatternSpec:
+    atoms: List[Atom] = []
+
+    def mk_atom(stream: SingleInputStream, pos: int, every: bool) -> Atom:
+        filt = None
+        for h in stream.stream_handlers:
+            if isinstance(h, Filter):
+                if filt is not None:
+                    raise CompileError("multiple filters on a pattern element")
+                filt = h.expression
+            else:
+                raise CompileError(
+                    "windows/functions on pattern elements not supported")
+        ref = stream.stream_reference_id or f"__p{pos}"
+        return Atom(pos, stream.stream_id, ref, filt, every=every)
+
+    def rec(el: StateElement, every: bool):
+        if isinstance(el, NextStateElement):
+            rec(el.state_element, every)
+            rec(el.next_state_element, False)
+        elif isinstance(el, EveryStateElement):
+            rec(el.state_element, True)
+        elif isinstance(el, StreamStateElement):
+            atoms.append(mk_atom(el.basic_single_input_stream,
+                                 len(atoms), every))
+        elif isinstance(el, AbsentStreamStateElement):
+            a = mk_atom(el.basic_single_input_stream, len(atoms), every)
+            a.absent = True
+            a.waiting_time = el.waiting_time
+            if a.waiting_time is None:
+                raise CompileError(
+                    "absent pattern elements need 'for <time>' in this build")
+            atoms.append(a)
+        elif isinstance(el, CountStateElement):
+            inner = el.stream_state_element
+            a = mk_atom(inner.basic_single_input_stream, len(atoms), every)
+            a.min_count = el.min_count
+            a.max_count = el.max_count
+            cap = count_cap if el.max_count == CountStateElement.ANY \
+                else min(el.max_count, count_cap)
+            a.capture_depth = max(cap, 1)
+            atoms.append(a)
+        elif isinstance(el, LogicalStateElement):
+            def as_stream(x):
+                if isinstance(x, StreamStateElement):
+                    return x.basic_single_input_stream
+                raise CompileError(
+                    "logical pattern sides must be plain stream elements")
+            pos = len(atoms)
+            a = mk_atom(as_stream(el.stream_state_element_1), pos, every)
+            b = mk_atom(as_stream(el.stream_state_element_2), pos, False)
+            if b.ref == f"__p{pos}":
+                b.ref = f"__p{pos}b"
+            a.logical = el.type
+            a.partner = b
+            atoms.append(a)
+        else:
+            raise CompileError(
+                f"unsupported pattern element {type(el).__name__}")
+
+    rec(sis.state_element, False)
+    if not atoms:
+        raise CompileError("empty pattern")
+    return PatternSpec(atoms, sis.state_type, sis.within_time,
+                       count_cap=count_cap)
+
+
+# ---------------------------------------------------------------------------
+# Runtime
+# ---------------------------------------------------------------------------
+
+class PatternState(NamedTuple):
+    active: Any       # bool[K,P]
+    pos: Any          # i32[K,P]
+    count: Any        # i32[K,P] captures at current pos
+    lmask: Any        # i32[K,P] logical sides satisfied (bit0/bit1)
+    start_ts: Any     # i64[K,P]
+    entry_ts: Any     # i64[K,P] ts of entering current pos
+    seed_on: Any      # bool[K]
+    done: Any         # bool[K]  non-every pattern already matched
+    dropped: Any      # i64 scalar: forks dropped on slab overflow
+    caps: Dict[str, Tuple]   # atom.ckey -> (ts[K,P,D], cols tuple [K,P,D])
+
+
+class PatternExec:
+    def __init__(self, spec: PatternSpec, schemas: Dict[str, ev.Schema],
+                 interner: ev.StringInterner, slots: int = 8):
+        self.spec = spec
+        self.schemas = schemas
+        self.P = slots
+        self.S = spec.n_states
+        self.interner = interner
+
+        # selector-facing scope: every non-absent atom ref is a source
+        self.scope = Scope()
+        self.scope.interner = interner
+        for a in spec.all_atoms():
+            if not a.absent:
+                self.scope.add_source(a.ref, schemas[a.stream_id])
+
+        # per-atom filter scopes: unqualified attrs bind to the atom's OWN
+        # stream (the incoming event); qualified refs reach earlier captures
+        self._filters: Dict[str, Optional[CompiledExpr]] = {}
+        for a in spec.all_atoms():
+            if a.filter_expr is None:
+                self._filters[a.ckey] = None
+                continue
+            fscope = Scope()
+            fscope.interner = interner
+            fscope.add_source(a.ref, schemas[a.stream_id], default=True)
+            for other in spec.all_atoms():
+                if other.ckey != a.ckey and not other.absent:
+                    fscope.add_source(other.ref, schemas[other.stream_id],
+                                      default=False)
+            self._filters[a.ckey] = compile_expression(a.filter_expr, fscope)
+
+    # -- state ----------------------------------------------------------------
+    def init_state(self, K: int) -> PatternState:
+        P = self.P
+        caps: Dict[str, Tuple] = {}
+        for a in self.spec.all_atoms():
+            if a.absent:
+                continue
+            schema = self.schemas[a.stream_id]
+            D = a.capture_depth
+            cols = tuple(
+                jnp.full((K, P, D), ev.default_value(t), dtype=d)
+                for t, d in zip(schema.types, schema.dtypes))
+            caps[a.ckey] = (jnp.zeros((K, P, D), jnp.int64), cols)
+        return PatternState(
+            active=jnp.zeros((K, P), jnp.bool_),
+            pos=jnp.zeros((K, P), jnp.int32),
+            count=jnp.zeros((K, P), jnp.int32),
+            lmask=jnp.zeros((K, P), jnp.int32),
+            start_ts=jnp.zeros((K, P), jnp.int64),
+            entry_ts=jnp.zeros((K, P), jnp.int64),
+            seed_on=jnp.ones((K,), jnp.bool_),
+            done=jnp.zeros((K,), jnp.bool_),
+            dropped=jnp.asarray(0, jnp.int64),
+            caps=caps,
+        )
+
+    # -- one event per key ----------------------------------------------------
+    def tick(self, st: PatternState, stream_id: str, ev_cols, ev_ts,
+             ev_valid, now_k):
+        spec = self.spec
+        S = self.S
+        K, P = st.active.shape
+        a0 = spec.atoms[0]
+        F = jnp.zeros((K, P), jnp.bool_)
+
+        # ---- phase 1: within expiry ----------------------------------------
+        if spec.within is not None:
+            alive = now_k[:, None] - st.start_ts <= spec.within
+            st = st._replace(active=jnp.logical_and(st.active, alive))
+
+        # ---- phase 2: absent deadlines -------------------------------------
+        absent_complete = F
+        absent_ts = jnp.zeros((K, P), jnp.int64)
+        for a in spec.atoms:
+            if not a.absent:
+                continue
+            at_pos = jnp.logical_and(st.active, st.pos == a.pos)
+            due = jnp.logical_and(
+                at_pos, st.entry_ts + a.waiting_time <= now_k[:, None])
+            if a.pos == S - 1:
+                absent_complete = jnp.logical_or(absent_complete, due)
+                absent_ts = jnp.where(due, st.entry_ts + a.waiting_time,
+                                      absent_ts)
+                st = st._replace(active=jnp.logical_and(
+                    st.active, jnp.logical_not(due)))
+            else:
+                st = st._replace(
+                    pos=jnp.where(due, a.pos + 1, st.pos).astype(jnp.int32),
+                    count=jnp.where(due, 0, st.count).astype(jnp.int32),
+                    lmask=jnp.where(due, 0, st.lmask).astype(jnp.int32),
+                    entry_ts=jnp.where(due, st.entry_ts + a.waiting_time,
+                                       st.entry_ts),
+                )
+
+        # ---- phase 3: match evaluation (pre-capture state) -----------------
+        env = self._build_env(st, stream_id, ev_cols, ev_ts)
+        ev_ok = jnp.logical_and(ev_valid, jnp.logical_not(st.done))   # [K]
+
+        advance_inplace = F
+        complete = absent_complete
+        deactivate = absent_complete
+        fork = F
+        kill = F
+        matched_any = F
+        capture: Dict[str, Any] = {}
+        lmask_new = st.lmask
+
+        def mark(d, key, m):
+            d[key] = jnp.logical_or(d.get(key, F), m)
+
+        for a in spec.atoms:
+            last = a.pos == S - 1
+            sides = [(a, 0)] + ([(a.partner, 1)] if a.partner else [])
+            for atom, side in sides:
+                if atom.stream_id != stream_id:
+                    continue
+                filt = self._filters[atom.ckey]
+                cond = jnp.ones((K, P), jnp.bool_) if filt is None else \
+                    jnp.broadcast_to(filt.fn(env), (K, P))
+                at_pos = jnp.logical_and(st.active, st.pos == a.pos)
+                m = jnp.logical_and(jnp.logical_and(at_pos, cond),
+                                    ev_ok[:, None])
+                if a.absent:
+                    kill = jnp.logical_or(kill, m)   # absence violated
+                    continue
+                matched_any = jnp.logical_or(matched_any, m)
+                if a.logical is not None:
+                    bit = 1 << side
+                    have_other = (lmask_new & (3 ^ bit)) != 0
+                    adv = m if a.logical == "OR" else jnp.logical_and(
+                        m, have_other)
+                    lmask_new = jnp.where(m, lmask_new | bit, lmask_new)
+                    mark(capture, atom.ckey, m)
+                    if last:
+                        complete = jnp.logical_or(complete, adv)
+                        deactivate = jnp.logical_or(deactivate, adv)
+                    else:
+                        advance_inplace = jnp.logical_or(advance_inplace, adv)
+                elif not a.is_count:
+                    mark(capture, atom.ckey, m)
+                    if last:
+                        complete = jnp.logical_or(complete, m)
+                        deactivate = jnp.logical_or(deactivate, m)
+                    else:
+                        advance_inplace = jnp.logical_or(advance_inplace, m)
+                else:
+                    newc = st.count + 1
+                    maxc = spec.count_cap if a.max_count < 0 else a.max_count
+                    can_stay = jnp.logical_and(m, newc < maxc)
+                    can_adv = jnp.logical_and(m, newc >= a.min_count)
+                    mark(capture, atom.ckey, m)
+                    if last:
+                        complete = jnp.logical_or(complete, can_adv)
+                        deactivate = jnp.logical_or(
+                            deactivate,
+                            jnp.logical_and(can_adv, jnp.logical_not(can_stay)))
+                    else:
+                        fork = jnp.logical_or(
+                            fork, jnp.logical_and(can_adv, can_stay))
+                        advance_inplace = jnp.logical_or(
+                            advance_inplace,
+                            jnp.logical_and(can_adv, jnp.logical_not(can_stay)))
+
+        # SEQUENCE: strict continuity
+        if spec.state_type == "SEQUENCE":
+            no_match = jnp.logical_and(
+                st.active,
+                jnp.logical_and(ev_ok[:, None], jnp.logical_not(matched_any)))
+            kill = jnp.logical_or(kill, no_match)
+
+        # ---- seed (virtual pending slot at position 0) ---------------------
+        seed_match = jnp.zeros((K,), jnp.bool_)
+        seed_side = jnp.zeros((K,), jnp.int32)
+        for atom, side in [(a0, 0)] + ([(a0.partner, 1)] if a0.partner else []):
+            if atom is None or atom.stream_id != stream_id or a0.absent:
+                continue
+            filt = self._filters[atom.ckey]
+            c = jnp.ones((K,), jnp.bool_) if filt is None else \
+                _seed_eval(filt, env, K)
+            sm = jnp.logical_and(jnp.logical_and(st.seed_on, ev_ok), c)
+            seed_side = jnp.where(
+                jnp.logical_and(sm, jnp.logical_not(seed_match)), side,
+                seed_side)
+            seed_match = jnp.logical_or(seed_match, sm)
+
+        # a seed advances immediately iff the first atom completes with one
+        # event: single non-count atom, count with min<=1, or logical OR
+        if a0.logical is not None:
+            seed_immediate = a0.logical == "OR"
+        elif a0.is_count:
+            seed_immediate = a0.min_count <= 1
+        else:
+            seed_immediate = True
+        # ...and keeps a collecting continuation iff a count atom can take more
+        seed_keeps = a0.is_count and (a0.max_count < 0 or a0.max_count > 1)
+
+        seed_complete = jnp.logical_and(
+            seed_match, jnp.asarray(seed_immediate and S == 1))
+        seed_spawn = jnp.logical_and(seed_match, jnp.asarray(
+            (seed_immediate and S > 1) or not seed_immediate or seed_keeps))
+        # spawned seed slot's position / count
+        if seed_immediate and not seed_keeps:
+            seed_pos, seed_count = 1, 0
+        else:
+            seed_pos, seed_count = 0, 1
+        seed_fork_also = seed_immediate and seed_keeps and S > 1
+        # (count atom with min<=1,max>1 at pos 0: one slot advances, one
+        #  collects => spawn up to 2; handled by a second seed candidate)
+
+        if not a0.every:
+            st = st._replace(seed_on=jnp.logical_and(
+                st.seed_on, jnp.logical_not(seed_match)))
+            newly_done = jnp.logical_or(jnp.any(complete, axis=1),
+                                        seed_complete)
+            st = st._replace(done=jnp.logical_or(st.done, newly_done))
+
+        st = st._replace(lmask=lmask_new)
+
+        # ---- phase 4: in-place capture -------------------------------------
+        newcaps = {}
+        for a in spec.all_atoms():
+            if a.absent:
+                continue
+            ck = a.ckey
+            ts_c, cols_c = st.caps[ck]
+            here = capture.get(ck)
+            if here is None:
+                newcaps[ck] = (ts_c, cols_c)
+                continue
+            D = ts_c.shape[2]
+            idx = jnp.clip(st.count, 0, D - 1)
+            ncols = tuple(
+                _set_along(c, idx, jnp.broadcast_to(
+                    ev_cols[j][:, None], idx.shape), here)
+                for j, c in enumerate(cols_c))
+            nts = _set_along(ts_c, idx, jnp.broadcast_to(
+                ev_ts[:, None], idx.shape), here)
+            newcaps[ck] = (nts, ncols)
+        st = st._replace(caps=newcaps)
+
+        # ---- phase 5: emission gather --------------------------------------
+        emit_mask = jnp.concatenate([complete, seed_complete[:, None]], axis=1)
+        emit_ts = jnp.concatenate([
+            jnp.where(absent_complete, absent_ts,
+                      jnp.broadcast_to(ev_ts[:, None], (K, P))),
+            ev_ts[:, None]], axis=1)                      # [K,P+1]
+        emit_count = jnp.concatenate(
+            [jnp.where(complete, st.count + jnp.where(
+                capture_any(capture, F), 1, 0), 0),
+             jnp.ones((K, 1), jnp.int32)], axis=1)
+        emit: Dict[str, Any] = {"mask": emit_mask, "ts": emit_ts,
+                                "count": emit_count}
+        for a in spec.all_atoms():
+            if a.absent:
+                continue
+            ck = a.ckey
+            ts_c, cols_c = st.caps[ck]
+            D = ts_c.shape[2]
+            is_seed_cap = (a.pos == 0 and a.stream_id == stream_id)
+            seed_cols = tuple(
+                jnp.broadcast_to(ev_cols[j][:, None, None], (K, 1, D))
+                if is_seed_cap else
+                jnp.zeros((K, 1, D), c.dtype)
+                for j, c in enumerate(cols_c))
+            emit[ck] = (
+                jnp.concatenate(
+                    [ts_c, jnp.broadcast_to(ev_ts[:, None, None], (K, 1, D))
+                     if is_seed_cap else jnp.zeros((K, 1, D), jnp.int64)],
+                    axis=1),
+                tuple(jnp.concatenate([c, sc], axis=1)
+                      for c, sc in zip(cols_c, seed_cols)))
+
+        # ---- phase 6: spawn forks + seed -----------------------------------
+        st = self._spawn(st, fork, seed_spawn, seed_pos, seed_count,
+                         seed_side, seed_fork_also, stream_id, ev_cols,
+                         ev_ts, a0)
+
+        # ---- phase 7: in-place advance / kill / deactivate -----------------
+        captured_now = capture_any(capture, F)
+        st = st._replace(
+            count=jnp.where(advance_inplace | deactivate, 0,
+                            jnp.where(captured_now, st.count + 1,
+                                      st.count)).astype(jnp.int32),
+            pos=jnp.where(advance_inplace, st.pos + 1,
+                          st.pos).astype(jnp.int32),
+            lmask=jnp.where(advance_inplace, 0, st.lmask).astype(jnp.int32),
+            entry_ts=jnp.where(advance_inplace, ev_ts[:, None], st.entry_ts),
+            active=jnp.logical_and(
+                st.active,
+                jnp.logical_not(jnp.logical_or(kill, deactivate))),
+        )
+        return st, emit
+
+    # -- spawn ----------------------------------------------------------------
+    def _spawn(self, st: PatternState, fork, seed_spawn, seed_pos, seed_count,
+               seed_side, seed_fork_also, stream_id, ev_cols, ev_ts, a0):
+        K, P = st.active.shape
+        spec = self.spec
+
+        # candidates: P slot-forks + seed (+ optional second seed continuation)
+        extra = 2 if seed_fork_also else 1
+        NC = P + extra
+        seed2 = jnp.logical_and(seed_spawn, jnp.asarray(seed_fork_also))
+        if seed_fork_also:
+            cand_valid = jnp.concatenate(
+                [fork, seed_spawn[:, None], seed2[:, None]], axis=1)
+        else:
+            cand_valid = jnp.concatenate([fork, seed_spawn[:, None]], axis=1)
+
+        rank = jnp.cumsum(cand_valid.astype(jnp.int32), axis=1) - 1
+        free = jnp.logical_not(st.active)
+        free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
+        slot_ids = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (K, P))
+        krow = jnp.arange(K)[:, None]
+        free_idx = jnp.full((K, P), P, jnp.int32).at[
+            krow, jnp.where(free, free_rank, P)
+        ].set(slot_ids, mode="drop")
+        nfree = jnp.sum(free.astype(jnp.int32), axis=1)
+        ok = jnp.logical_and(cand_valid, rank < nfree[:, None])
+        tgt = jnp.take_along_axis(free_idx, jnp.clip(rank, 0, P - 1), axis=1)
+        tgt = jnp.where(ok, tgt, P)          # P == drop
+
+        st = st._replace(dropped=st.dropped + jnp.sum(
+            jnp.logical_and(cand_valid, jnp.logical_not(ok))
+            .astype(jnp.int64)))
+
+        def scat(dst, vals):
+            return dst.at[krow, tgt].set(vals, mode="drop")
+
+        # payloads
+        fork_pos = st.pos + 1
+        fork_start = st.start_ts
+        if seed_fork_also:
+            # first seed candidate: advancing slot (pos 1); second: collector
+            cpos = jnp.concatenate(
+                [fork_pos,
+                 jnp.full((K, 1), 1, jnp.int32),
+                 jnp.full((K, 1), 0, jnp.int32)], axis=1)
+            ccount = jnp.concatenate(
+                [jnp.zeros((K, P), jnp.int32),
+                 jnp.zeros((K, 1), jnp.int32),
+                 jnp.ones((K, 1), jnp.int32)], axis=1)
+        else:
+            cpos = jnp.concatenate(
+                [fork_pos, jnp.full((K, 1), seed_pos, jnp.int32)], axis=1)
+            ccount = jnp.concatenate(
+                [jnp.zeros((K, P), jnp.int32),
+                 jnp.full((K, 1), seed_count, jnp.int32)], axis=1)
+        seed_lmask = jnp.where(
+            seed_spawn, jnp.left_shift(jnp.ones((K,), jnp.int32), seed_side),
+            0)[:, None] if a0.logical is not None else jnp.zeros((K, 1),
+                                                                 jnp.int32)
+        clmask = jnp.concatenate(
+            [jnp.zeros((K, P), jnp.int32)] + [seed_lmask] * extra, axis=1)
+        cstart = jnp.concatenate(
+            [fork_start] + [ev_ts[:, None]] * extra, axis=1)
+        centry = jnp.broadcast_to(ev_ts[:, None], (K, NC))
+
+        st = st._replace(
+            active=scat(st.active, ok),
+            pos=scat(st.pos, cpos),
+            count=scat(st.count, ccount),
+            lmask=scat(st.lmask, clmask),
+            start_ts=scat(st.start_ts, cstart),
+            entry_ts=scat(st.entry_ts, centry),
+        )
+
+        # captures: forks inherit the source slot (post-capture state, which
+        # already includes this event); seeds get the incoming event at atom0
+        newcaps = {}
+        src_slot = jnp.concatenate(
+            [jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (K, P))] +
+            [jnp.zeros((K, 1), jnp.int32)] * extra, axis=1)  # [K,NC]
+        is_seed_cand = jnp.concatenate(
+            [jnp.zeros((K, P), jnp.bool_)] +
+            [jnp.ones((K, 1), jnp.bool_)] * extra, axis=1)
+        for a in spec.all_atoms():
+            if a.absent:
+                continue
+            ck = a.ckey
+            ts_c, cols_c = st.caps[ck]
+            D = ts_c.shape[2]
+            drange = jnp.arange(D)[None, None, :]
+            seed_has = (a.pos == 0 and a.stream_id == stream_id)
+
+            def payload(c, incoming):
+                src = jnp.take_along_axis(c, src_slot[:, :, None], axis=1)
+                if seed_has:
+                    iv = jnp.broadcast_to(incoming[:, None, None], src.shape)
+                    first_d = drange == 0
+                    src = jnp.where(
+                        jnp.logical_and(is_seed_cand[:, :, None], first_d),
+                        iv, jnp.where(is_seed_cand[:, :, None],
+                                      jnp.zeros_like(src), src))
+                else:
+                    src = jnp.where(is_seed_cand[:, :, None],
+                                    jnp.zeros_like(src), src)
+                return src
+
+            nts = ts_c.at[krow[:, :, None], tgt[:, :, None], drange].set(
+                payload(ts_c, ev_ts), mode="drop")
+            ncols = tuple(
+                c.at[krow[:, :, None], tgt[:, :, None], drange].set(
+                    payload(c, ev_cols[j]), mode="drop")
+                for j, c in enumerate(cols_c))
+            newcaps[ck] = (nts, ncols)
+        return st._replace(caps=newcaps)
+
+    # -- env ------------------------------------------------------------------
+    def _build_env(self, st: PatternState, stream_id: str, ev_cols, ev_ts):
+        env: Dict[str, Any] = {"__ts__": ev_ts[:, None]}
+        for a in self.spec.all_atoms():
+            if a.absent:
+                continue
+            ts_c, cols_c = st.caps[a.ckey]
+            D = ts_c.shape[2]
+            if a.stream_id == stream_id:
+                env[a.ref] = tuple(jnp.broadcast_to(
+                    c[:, None], st.active.shape) for c in ev_cols)
+            else:
+                env[a.ref] = tuple(c[:, :, 0] for c in cols_c)
+            for i in range(D):
+                env[f"{a.ref}@{i}"] = tuple(c[:, :, i] for c in cols_c)
+            last_i = jnp.clip(st.count - 1, 0, D - 1)
+            env[f"{a.ref}@-1"] = tuple(
+                jnp.take_along_axis(c, last_i[:, :, None], axis=2)[:, :, 0]
+                for c in cols_c)
+        return env
+
+
+def capture_any(capture: Dict[str, Any], F):
+    out = F
+    for m in capture.values():
+        out = jnp.logical_or(out, m)
+    return out
+
+
+def _seed_eval(filt: CompiledExpr, env, K):
+    v = filt.fn(env)
+    v = jnp.broadcast_to(v, v.shape if v.ndim else (K,))
+    if v.ndim == 2:
+        return v[:, 0]
+    return v
+
+
+def _set_along(arr, idx, vals, mask):
+    """arr[k,p, idx[k,p]] = vals[k,p] where mask[k,p]."""
+    hit = jnp.logical_and(
+        jnp.arange(arr.shape[2])[None, None, :] == idx[:, :, None],
+        mask[:, :, None])
+    return jnp.where(hit, vals[:, :, None].astype(arr.dtype), arr)

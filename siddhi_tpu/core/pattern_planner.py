@@ -1,0 +1,217 @@
+"""Planner + host runtime glue for pattern/sequence queries.
+
+Reference role: CORE/util/parser/StateInputStreamParser.java (NFA build) +
+pattern receivers (CORE/query/input/stream/state/receiver/*).  Each pattern
+query compiles to one jitted step per input stream; the host groups incoming
+events by partition key into a [K, E] layout and the device scan does the
+sequential-per-key NFA advance.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..query_api.definition import StreamDefinition
+from ..query_api.query import Query, StateInputStream
+from . import event as ev
+from .executor import CompileError, Scope
+from .pattern import PatternExec, PatternSpec, linearize
+from .selector import SelectorExec
+from .window import NO_WAKEUP, Rows
+
+
+@dataclasses.dataclass
+class PlannedPatternQuery:
+    name: str
+    spec: PatternSpec
+    exec: PatternExec
+    in_schemas: Dict[str, ev.Schema]
+    out_schema: ev.Schema
+    output_target: str
+    output_event_type: str
+    steps: Dict[str, Callable]          # stream_id -> jitted step
+    timer_step: Optional[Callable]
+    init_state: Callable                # (K) -> (pattern_state, sel_state)
+    key_capacity: int
+    slots: int
+
+
+def plan_pattern_query(
+    query: Query,
+    name: str,
+    schemas: Dict[str, ev.Schema],
+    interner: ev.StringInterner,
+    key_capacity: int = 1,
+    slots: int = 8,
+    count_cap: int = 8,
+) -> PlannedPatternQuery:
+    sis = query.input_stream
+    assert isinstance(sis, StateInputStream)
+    spec = linearize(sis, count_cap=count_cap)
+    for sid in spec.stream_ids:
+        if sid not in schemas:
+            raise CompileError(f"undefined stream {sid!r} in pattern")
+    pexec = PatternExec(spec, schemas, interner, slots=slots)
+
+    out_target = query.output_stream.target_id if query.output_stream else ""
+    sel = SelectorExec(query.selector, pexec.scope,
+                       _first_schema(spec, schemas), 64,
+                       out_target or name, interner)
+
+    out_def = StreamDefinition(out_target or f"#{name}.out")
+    for n, t in zip(sel.out_names, sel.out_types):
+        out_def.attribute(n, t)
+    out_schema = ev.Schema(out_def, interner)
+
+    P = pexec.P
+    refs = [a.ref for a in spec.all_atoms() if not a.absent]
+    depths = {a.ref: a.capture_depth for a in spec.all_atoms() if not a.absent}
+
+    def make_step(stream_id: str):
+        def step(pstate, sel_state, cols, ts, valid, ord_, key_idx, now):
+            # gather this batch's keys ([K_total,...] -> [Kb,...])
+            sub = pstate.__class__(
+                active=pstate.active[key_idx], pos=pstate.pos[key_idx],
+                count=pstate.count[key_idx], lmask=pstate.lmask[key_idx],
+                start_ts=pstate.start_ts[key_idx],
+                entry_ts=pstate.entry_ts[key_idx],
+                seed_on=pstate.seed_on[key_idx], done=pstate.done[key_idx],
+                dropped=pstate.dropped,
+                caps={k: (v[0][key_idx], tuple(c[key_idx] for c in v[1]))
+                      for k, v in pstate.caps.items()})
+
+            def body(carry, xs):
+                st = carry
+                cols_e, ts_e, valid_e = xs
+                now_k = jnp.where(valid_e, ts_e, now)
+                st, emit = pexec.tick(st, stream_id, cols_e, ts_e, valid_e,
+                                      now_k)
+                return st, emit
+
+            xs = (tuple(c.T for c in cols), ts.T, valid.T)   # scan over E
+            sub, emits = lax.scan(body, sub, xs)
+
+            # scatter back
+            pstate = pstate.__class__(
+                active=pstate.active.at[key_idx].set(sub.active),
+                pos=pstate.pos.at[key_idx].set(sub.pos),
+                count=pstate.count.at[key_idx].set(sub.count),
+                lmask=pstate.lmask.at[key_idx].set(sub.lmask),
+                start_ts=pstate.start_ts.at[key_idx].set(sub.start_ts),
+                entry_ts=pstate.entry_ts.at[key_idx].set(sub.entry_ts),
+                seed_on=pstate.seed_on.at[key_idx].set(sub.seed_on),
+                done=pstate.done.at[key_idx].set(sub.done),
+                dropped=sub.dropped,
+                caps={k: (pstate.caps[k][0].at[key_idx].set(v[0]),
+                          tuple(pc.at[key_idx].set(c) for pc, c in
+                                zip(pstate.caps[k][1], v[1])))
+                      for k, v in sub.caps.items()})
+
+            sel_state, out, wake = _emit_matches(
+                pexec, sel, spec, emits, ord_, sel_state, pstate, now)
+            return pstate, sel_state, out, wake
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    steps = {sid: make_step(sid) for sid in spec.stream_ids}
+
+    timer_step = None
+    if spec.has_absent:
+        any_sid = spec.stream_ids[0]
+        schema0 = schemas[any_sid]
+
+        def tstep(pstate, sel_state, now):
+            K = pstate.active.shape[0]
+            zero_cols = tuple(
+                jnp.full((K,), ev.default_value(t), dtype=d)
+                for t, d in zip(schema0.types, schema0.dtypes))
+            ts_e = jnp.full((K,), now, jnp.int64)
+            valid_e = jnp.zeros((K,), jnp.bool_)
+            now_k = jnp.full((K,), now, jnp.int64)
+            st, emit = pexec.tick(pstate, any_sid, zero_cols, ts_e, valid_e,
+                                  now_k)
+            emits = jax.tree.map(lambda x: x[None], emit)  # E=1
+            ord_ = jnp.zeros((K, 1), jnp.int64)
+            sel_state, out, wake = _emit_matches(
+                pexec, sel, spec, emits, ord_, sel_state, st, now)
+            return st, sel_state, out, wake
+
+        timer_step = jax.jit(tstep, donate_argnums=(0, 1))
+
+    def init_state(K: int):
+        return pexec.init_state(K), sel.init_state()
+
+    return PlannedPatternQuery(
+        name=name, spec=spec, exec=pexec,
+        in_schemas={sid: schemas[sid] for sid in spec.stream_ids},
+        out_schema=out_schema,
+        output_target=out_target,
+        output_event_type=(query.output_stream.output_event_type
+                           if query.output_stream and
+                           query.output_stream.output_event_type
+                           else "CURRENT_EVENTS"),
+        steps=steps, timer_step=timer_step, init_state=init_state,
+        key_capacity=key_capacity, slots=slots)
+
+
+def _first_schema(spec: PatternSpec, schemas) -> ev.Schema:
+    return schemas[spec.stream_ids[0]]
+
+
+def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,
+                  emits, ord_, sel_state, pstate, now):
+    """Flatten scan emissions [E,K,P+1] into selector Rows + env."""
+    mask = emits["mask"]                       # [E,K,P+1]
+    E, K, P1 = mask.shape
+    B = E * K * P1
+
+    flat = lambda x: x.reshape(B)
+    rows_ts = flat(emits["ts"])
+    # order: by arrival (ord), then slot index
+    slot_rank = jnp.broadcast_to(
+        jnp.arange(P1, dtype=jnp.int64)[None, None, :], mask.shape)
+    ord_ekp = jnp.broadcast_to(
+        jnp.transpose(ord_)[:, :, None].astype(jnp.int64), mask.shape)
+    seq = flat(ord_ekp * (P1 + 1) + slot_rank)
+
+    env: Dict[str, Any] = {"__ts__": rows_ts, "__now__": now}
+    for a in spec.all_atoms():
+        if a.absent:
+            continue
+        cap_ts, cap_cols = emits[a.ckey]       # [E,K,P+1,D]
+        D = cap_ts.shape[-1]
+        env[a.ref] = tuple(c[..., 0].reshape(B) for c in cap_cols)
+        for i in range(D):
+            env[f"{a.ref}@{i}"] = tuple(
+                c[..., i].reshape(B) for c in cap_cols)
+        last_i = jnp.clip(flat(emits["count"]).astype(jnp.int32) - 1, 0,
+                          D - 1)
+        env[f"{a.ref}@-1"] = tuple(
+            jnp.take_along_axis(
+                c.reshape(B, D), last_i[:, None], axis=1)[:, 0]
+            for c in cap_cols)
+
+    rows = Rows(
+        ts=rows_ts,
+        kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+        valid=flat(mask),
+        seq=seq,
+        gslot=jnp.zeros((B,), jnp.int32),
+        cols=(),
+    )
+    sel_state, out = sel.process(sel_state, rows, env)
+
+    # next wakeup: earliest absent deadline
+    wake = jnp.asarray(NO_WAKEUP, jnp.int64)
+    for a in spec.atoms:
+        if a.absent:
+            at_pos = jnp.logical_and(pstate.active, pstate.pos == a.pos)
+            w = jnp.min(jnp.where(at_pos, pstate.entry_ts + a.waiting_time,
+                                  NO_WAKEUP))
+            wake = jnp.minimum(wake, w)
+    return sel_state, out, wake

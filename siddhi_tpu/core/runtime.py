@@ -131,28 +131,94 @@ class QueryRuntime:
         self.process_staged(staged, now)
 
     def _emit(self, out, now: int) -> None:
-        ots, okind, ovalid, ocols = out
+        _emit_output(self, out, now)
+
+
+class PatternQueryRuntime:
+    """Host wrapper for a pattern/sequence query: groups events per key into
+    the [K, E] device layout and drives the per-stream NFA steps."""
+
+    def __init__(self, planned, app: "SiddhiAppRuntime"):
+        self.planned = planned
+        self.app = app
+        self.state = jax.tree.map(
+            lambda x: jax.numpy.array(x, copy=True),
+            planned.init_state(planned.key_capacity))
+        self.callbacks: List[Callable] = []
+        self.next_wakeup: int = _NO_WAKEUP_INT
+
+    @property
+    def name(self):
+        return self.planned.name
+
+    def process_staged(self, stream_id: str, staged: ev.StagedBatch,
+                       now: int) -> None:
         p = self.planned
-        if not np.any(np.asarray(ovalid)):
+        B = staged.ts.shape[0]
+        # v1 single-key layout: [1, B]; partitioned layout lands with the
+        # partition phase
+        cols = tuple(
+            jax.numpy.asarray(c[None, :]).astype(d)
+            for c, d in zip(staged.cols, p.in_schemas[stream_id].dtypes))
+        ts = jax.numpy.asarray(staged.ts[None, :])
+        valid = jax.numpy.asarray(staged.valid[None, :])
+        ord_ = jax.numpy.asarray(
+            np.arange(B, dtype=np.int64)[None, :])
+        key_idx = jax.numpy.asarray(np.zeros((1,), np.int32))
+        pstate, sel_state = self.state
+        pstate, sel_state, out, wake = p.steps[stream_id](
+            pstate, sel_state, cols, ts, valid, ord_, key_idx,
+            jax.numpy.asarray(now, jax.numpy.int64))
+        self.state = (pstate, sel_state)
+        _emit_output(self, out, now)
+        self._maybe_schedule(wake)
+
+    def on_timer(self, now: int) -> None:
+        p = self.planned
+        if p.timer_step is None:
             return
-        batch = ev.EventBatch(ots, okind, ovalid, ocols)
-        pairs = ev.unpack(p.out_schema, batch, want_kinds=(ev.CURRENT, ev.EXPIRED))
-        if not pairs:
+        pstate, sel_state = self.state
+        pstate, sel_state, out, wake = p.timer_step(
+            pstate, sel_state, jax.numpy.asarray(now, jax.numpy.int64))
+        self.state = (pstate, sel_state)
+        _emit_output(self, out, now)
+        self._maybe_schedule(wake)
+
+    def _maybe_schedule(self, wake) -> None:
+        if self.planned.timer_step is None:
             return
-        current = [e for k, e in pairs if k == ev.CURRENT]
-        expired = [e for k, e in pairs if k == ev.EXPIRED]
-        for cb in self.callbacks:
-            cb(now, current or None, expired or None)
-        if p.output_target:
-            sel = p.output_event_type
-            if sel == "CURRENT_EVENTS":
-                routed = current
-            elif sel == "EXPIRED_EVENTS":
-                routed = expired
-            else:
-                routed = [e for _, e in pairs]
-            if routed:
-                self.app._route(p.output_target, routed)
+        w = int(wake)
+        self.next_wakeup = w
+        if w < _NO_WAKEUP_INT:
+            self.app._scheduler.notify_at(w, self)
+
+
+def _emit_output(qr, out, now: int) -> None:
+    """Shared output emission: unpack device output rows, fan out to query
+    callbacks and the target junction."""
+    ots, okind, ovalid, ocols = out
+    p = qr.planned
+    if not np.any(np.asarray(ovalid)):
+        return
+    batch = ev.EventBatch(ots, okind, ovalid, ocols)
+    pairs = ev.unpack(p.out_schema, batch,
+                      want_kinds=(ev.CURRENT, ev.EXPIRED))
+    if not pairs:
+        return
+    current = [e for k, e in pairs if k == ev.CURRENT]
+    expired = [e for k, e in pairs if k == ev.EXPIRED]
+    for cb in qr.callbacks:
+        cb(now, current or None, expired or None)
+    if p.output_target:
+        sel = p.output_event_type
+        if sel == "CURRENT_EVENTS":
+            routed = current
+        elif sel == "EXPIRED_EVENTS":
+            routed = expired
+        else:
+            routed = [e for _, e in pairs]
+        if routed:
+            qr.app._route(p.output_target, routed)
 
 
 class StreamJunction:
@@ -297,12 +363,33 @@ class SiddhiAppRuntime:
         return f"query{i + 1}"
 
     def _add_query(self, q: Query, name: str):
+        from ..query_api.query import StateInputStream
+        if isinstance(q.input_stream, StateInputStream):
+            from .pattern_planner import plan_pattern_query
+            planned = plan_pattern_query(q, name, self.schemas, self.interner)
+            runtime = PatternQueryRuntime(planned, self)
+            self.query_runtimes[name] = runtime
+            for sid in planned.spec.stream_ids:
+
+                class _Sub:
+                    def __init__(self, qr, stream):
+                        self._qr, self._sid = qr, stream
+
+                    def process_staged(self, staged, now):
+                        self._qr.process_staged(self._sid, staged, now)
+
+                self.junctions[sid].subscribe_query(_Sub(runtime, sid))
+            self._define_output_for(planned, name)
+            return
         planned = plan_single_query(
             q, name, self.app.stream_definition_map, self.schemas,
             self.interner)
         runtime = QueryRuntime(planned, self)
         self.query_runtimes[name] = runtime
         self.junctions[planned.input_stream_id].subscribe_query(runtime)
+        self._define_output_for(planned, name)
+
+    def _define_output_for(self, planned, name: str):
         # define the output stream if missing
         tgt = planned.output_target
         if tgt and tgt not in self.junctions:

@@ -1,0 +1,197 @@
+"""Pattern/sequence NFA tests (modeled on TEST/query/pattern/* and
+TEST/query/sequence/* behavioral cases)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def run_app(manager, ql, sends, query="query1"):
+    """sends: list of (stream, data, ts)."""
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(query, lambda ts, i, o: got.extend(i or []))
+    rt.start()
+    handlers = {}
+    for stream, data, ts in sends:
+        h = handlers.setdefault(stream, rt.get_input_handler(stream))
+        h.send(data, timestamp=ts)
+    return got
+
+
+BASE = """
+@app:playback
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+
+
+class TestPattern:
+    def test_simple_followed_by(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from e1=Stream1[price > 20] -> e2=Stream2[price > e1.price]
+            select e1.symbol as s1, e2.symbol as s2, e2.price as p2
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["WSO2", 55.6, 100], 1000),
+            ("Stream2", ["IBM", 45.7, 100], 1010),   # not > 55.6
+            ("Stream2", ["GOOG", 85.0, 100], 1020),  # match
+            ("Stream2", ["MSFT", 95.0, 100], 1030),  # no more (no every)
+        ])
+        assert [e.data for e in got] == [
+            ["WSO2", "GOOG", pytest.approx(85.0)]]
+
+    def test_without_every_matches_once(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from e1=Stream1 -> e2=Stream2
+            select e1.volume as v1, e2.volume as v2
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["A", 1.0, 1], 1000),
+            ("Stream1", ["A", 1.0, 2], 1001),   # seed consumed: ignored
+            ("Stream2", ["B", 1.0, 3], 1002),
+            ("Stream1", ["A", 1.0, 4], 1003),
+            ("Stream2", ["B", 1.0, 5], 1004),   # pattern done
+        ])
+        assert [e.data for e in got] == [[1, 3]]
+
+    def test_every_restarts(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from every e1=Stream1 -> e2=Stream2
+            select e1.volume as v1, e2.volume as v2
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["A", 1.0, 1], 1000),
+            ("Stream1", ["A", 1.0, 2], 1001),
+            ("Stream2", ["B", 1.0, 3], 1002),   # completes BOTH pendings
+            ("Stream1", ["A", 1.0, 4], 1003),
+            ("Stream2", ["B", 1.0, 5], 1004),
+        ])
+        assert sorted(e.data for e in got) == [[1, 3], [2, 3], [4, 5]]
+
+    def test_three_state_chain(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from every e1=Stream1[volume == 1] -> e2=Stream1[volume == 2]
+                 -> e3=Stream1[volume == 3]
+            select e1.symbol as s1, e2.symbol as s2, e3.symbol as s3
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["A", 1.0, 1], 1000),
+            ("Stream1", ["B", 1.0, 2], 1001),
+            ("Stream1", ["X", 1.0, 9], 1002),  # irrelevant, pattern waits
+            ("Stream1", ["C", 1.0, 3], 1003),
+        ])
+        assert [e.data for e in got] == [["A", "B", "C"]]
+
+    def test_within_expires(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from every e1=Stream1 -> e2=Stream2 within 1 sec
+            select e1.volume as v1, e2.volume as v2
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["A", 1.0, 1], 1000),
+            ("Stream2", ["B", 1.0, 2], 2500),   # too late
+            ("Stream1", ["A", 1.0, 3], 3000),
+            ("Stream2", ["B", 1.0, 4], 3600),   # in time
+        ])
+        assert [e.data for e in got] == [[3, 4]]
+
+    def test_count_quantifier(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from e1=Stream1 -> e2=Stream1[volume > 10]<2:4> -> e3=Stream1[volume == 0]
+            select e1.volume as v1, e2[0].volume as a, e2[1].volume as b,
+                   e3.volume as v3
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["S", 1.0, 5], 1000),    # e1
+            ("Stream1", ["S", 1.0, 11], 1001),   # e2[0]
+            ("Stream1", ["S", 1.0, 12], 1002),   # e2[1]
+            ("Stream1", ["S", 1.0, 0], 1003),    # e3 -> match (count=2)
+        ])
+        assert [e.data for e in got] == [[5, 11, 12, 0]]
+
+    def test_logical_and(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from e1=Stream1 and e2=Stream2
+            select e1.volume as v1, e2.volume as v2
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["A", 1.0, 1], 1000),
+            ("Stream2", ["B", 1.0, 2], 1001),
+        ])
+        assert [e.data for e in got] == [[1, 2]]
+
+    def test_logical_or(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from e1=Stream1[volume == 7] or e2=Stream2[volume == 8]
+            select e2.volume as v2
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["A", 1.0, 1], 1000),   # matches neither side
+            ("Stream2", ["B", 1.0, 8], 1001),   # side 2 completes
+        ])
+        assert [e.data for e in got] == [[8]]
+
+    def test_absent_pattern(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from e1=Stream1 -> not Stream2 for 1 sec
+            select e1.volume as v1
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["A", 1.0, 1], 1000),
+            # no Stream2 within 1s; advance event clock
+            ("Stream1", ["X", 1.0, 99], 2500),
+        ])
+        assert [e.data for e in got] == [[1]]
+
+    def test_absent_pattern_violated(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from e1=Stream1 -> not Stream2 for 1 sec
+            select e1.volume as v1
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["A", 1.0, 1], 1000),
+            ("Stream2", ["B", 1.0, 2], 1400),   # violates absence
+            ("Stream1", ["X", 1.0, 99], 2500),
+        ])
+        assert got == []
+
+
+class TestSequence:
+    def test_strict_sequence(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from every e1=Stream1[volume == 1], e2=Stream1[volume == 2]
+            select e1.symbol as s1, e2.symbol as s2
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["A", 1.0, 1], 1000),
+            ("Stream1", ["X", 1.0, 9], 1001),   # breaks the partial
+            ("Stream1", ["B", 1.0, 1], 1002),
+            ("Stream1", ["C", 1.0, 2], 1003),   # completes with B
+        ])
+        assert [e.data for e in got] == [["B", "C"]]
+
+    def test_sequence_kleene(self, manager):
+        got = run_app(manager, BASE + """
+            @info(name='query1')
+            from every e1=Stream1[volume == 1], e2=Stream1[volume == 5]+,
+                 e3=Stream1[volume == 2]
+            select e1.symbol as s1, e2[0].symbol as k0, e3.symbol as s3
+            insert into OutputStream;
+        """, [
+            ("Stream1", ["A", 1.0, 1], 1000),
+            ("Stream1", ["K", 1.0, 5], 1001),
+            ("Stream1", ["L", 1.0, 5], 1002),
+            ("Stream1", ["B", 1.0, 2], 1003),
+        ])
+        assert [e.data for e in got] == [["A", "K", "B"]]
