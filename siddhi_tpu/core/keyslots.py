@@ -98,3 +98,49 @@ def _as_bytes_2d(c: np.ndarray) -> np.ndarray:
     if c.dtype == np.bool_:
         return c.astype(np.uint8).reshape(n, 1)
     return np.ascontiguousarray(c).view(np.uint8).reshape(n, -1)
+
+
+def _bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+_KB_BUCKETS = (1, 8, 64, 512, 4096, 32768, 262144, 1048576)
+_E_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096, 16384)
+
+
+def group_events_by_key(slots: np.ndarray, valid: np.ndarray):
+    """Arrange a batch into the per-key [Kb, E] device layout.
+
+    Returns (key_idx [Kb] int32, sel [Kb, E] int32 original-batch indices
+    (-1 = padding), kvalid [Kb, E] bool).  Kb/E are padded to buckets to
+    bound recompilation.  Events of one key keep their batch order along E
+    (sequential NFA semantics per key)."""
+    vmask = valid & (slots >= 0)
+    idx = np.nonzero(vmask)[0]
+    if idx.size == 0:
+        key_idx = np.zeros((1,), np.int32)
+        sel = np.full((1, 1), -1, np.int32)
+        return key_idx, sel, np.zeros((1, 1), np.bool_)
+    s = slots[idx]
+    order = np.argsort(s, kind="stable")
+    s_sorted = s[order]
+    idx_sorted = idx[order]
+    uniq, starts, counts = np.unique(s_sorted, return_index=True,
+                                     return_counts=True)
+    E = _bucket(int(counts.max()), _E_BUCKETS)
+    Kb = _bucket(len(uniq), _KB_BUCKETS)
+    key_idx = np.zeros((Kb,), np.int32)
+    key_idx[:len(uniq)] = uniq.astype(np.int32)
+    # duplicate-gather guard: pad rows reuse key 0's slot; their events are
+    # invalid so the scan is a no-op, but scatter-back of duplicate key rows
+    # would be nondeterministic — point padding rows at a reserved dummy slot
+    if len(uniq) < Kb:
+        key_idx[len(uniq):] = -1  # caller maps -1 to a scratch row
+    within = np.arange(len(s_sorted)) - np.repeat(starts, counts)
+    sel = np.full((Kb, E), -1, np.int32)
+    group_rank = np.repeat(np.arange(len(uniq)), counts)
+    sel[group_rank, within] = idx_sorted.astype(np.int32)
+    return key_idx, sel, sel >= 0

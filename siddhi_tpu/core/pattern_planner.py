@@ -39,6 +39,7 @@ class PlannedPatternQuery:
     init_state: Callable                # (K) -> (pattern_state, sel_state)
     key_capacity: int
     slots: int
+    partition_positions: Optional[Dict[str, List[int]]] = None
 
 
 def plan_pattern_query(
@@ -49,6 +50,7 @@ def plan_pattern_query(
     key_capacity: int = 1,
     slots: int = 8,
     count_cap: int = 8,
+    partition_positions: Optional[Dict[str, List[int]]] = None,
 ) -> PlannedPatternQuery:
     sis = query.input_stream
     assert isinstance(sis, StateInputStream)
@@ -59,8 +61,10 @@ def plan_pattern_query(
     pexec = PatternExec(spec, schemas, interner, slots=slots)
 
     out_target = query.output_stream.target_id if query.output_stream else ""
+    # per-key aggregation: the selector's group slots are the partition keys
+    group_slots = key_capacity if partition_positions else 64
     sel = SelectorExec(query.selector, pexec.scope,
-                       _first_schema(spec, schemas), 64,
+                       _first_schema(spec, schemas), group_slots,
                        out_target or name, interner)
 
     out_def = StreamDefinition(out_target or f"#{name}.out")
@@ -113,7 +117,8 @@ def plan_pattern_query(
                       for k, v in sub.caps.items()})
 
             sel_state, out, wake = _emit_matches(
-                pexec, sel, spec, emits, ord_, sel_state, pstate, now)
+                pexec, sel, spec, emits, ord_, sel_state, pstate, now,
+                key_idx=key_idx)
             return pstate, sel_state, out, wake
 
         return jax.jit(step, donate_argnums=(0, 1))
@@ -156,7 +161,8 @@ def plan_pattern_query(
                            query.output_stream.output_event_type
                            else "CURRENT_EVENTS"),
         steps=steps, timer_step=timer_step, init_state=init_state,
-        key_capacity=key_capacity, slots=slots)
+        key_capacity=key_capacity, slots=slots,
+        partition_positions=partition_positions)
 
 
 def _first_schema(spec: PatternSpec, schemas) -> ev.Schema:
@@ -164,7 +170,7 @@ def _first_schema(spec: PatternSpec, schemas) -> ev.Schema:
 
 
 def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,
-                  emits, ord_, sel_state, pstate, now):
+                  emits, ord_, sel_state, pstate, now, key_idx=None):
     """Flatten scan emissions [E,K,P+1] into selector Rows + env."""
     mask = emits["mask"]                       # [E,K,P+1]
     E, K, P1 = mask.shape
@@ -196,12 +202,18 @@ def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,
                 c.reshape(B, D), last_i[:, None], axis=1)[:, 0]
             for c in cap_cols)
 
+    if key_idx is not None:
+        gslot = flat(jnp.broadcast_to(
+            key_idx[None, :, None].astype(jnp.int32), mask.shape))
+        gslot = jnp.maximum(gslot, 0)
+    else:
+        gslot = jnp.zeros((B,), jnp.int32)
     rows = Rows(
         ts=rows_ts,
         kind=jnp.full((B,), ev.CURRENT, jnp.int32),
         valid=flat(mask),
         seq=seq,
-        gslot=jnp.zeros((B,), jnp.int32),
+        gslot=gslot,
         cols=(),
     )
     sel_state, out = sel.process(sel_state, rows, env)

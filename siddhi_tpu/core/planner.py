@@ -70,6 +70,7 @@ def plan_single_query(
     batch_capacity: int = 512,
     group_slots: int = 4096,
     window_capacity_hint: int = 2048,
+    partition_positions: Optional[List[int]] = None,
 ) -> PlannedQuery:
     ist = query.input_stream
     assert isinstance(ist, SingleInputStream)
@@ -115,8 +116,17 @@ def plan_single_query(
         out_def.attribute(n, t)
     out_schema = ev.Schema(out_def, interner, objects=in_schema.objects)
 
-    # group-by slot allocation (host side)
-    gpos = sel.group_by_positions
+    # group-by slot allocation (host side).  Inside a partition, the
+    # partition key is prepended to the group key: state isolation per
+    # partition key composes with group-by
+    # (reference: PartitionStateHolder's nested partitionKey->groupByKey map)
+    gpos = list(sel.group_by_positions)
+    if partition_positions:
+        if seen_window:
+            raise CompileError(
+                "windows inside partitions land in a later phase")
+        if sel.has_aggregation or gpos:
+            gpos = [p for p in partition_positions if p not in gpos] + gpos
     allocator = SlotAllocator(group_slots, name=f"{name}:groupby") if gpos \
         else None
 

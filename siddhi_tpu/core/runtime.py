@@ -24,6 +24,7 @@ from ..query_api.definition import StreamDefinition
 from ..query_api.query import Partition, Query, SingleInputStream
 from . import event as ev
 from .executor import CompileError
+from .keyslots import SlotAllocator
 from .planner import PlannedQuery, plan_single_query
 from .window import NO_WAKEUP
 
@@ -138,7 +139,8 @@ class PatternQueryRuntime:
     """Host wrapper for a pattern/sequence query: groups events per key into
     the [K, E] device layout and drives the per-stream NFA steps."""
 
-    def __init__(self, planned, app: "SiddhiAppRuntime"):
+    def __init__(self, planned, app: "SiddhiAppRuntime",
+                 slot_allocator=None):
         self.planned = planned
         self.app = app
         self.state = jax.tree.map(
@@ -146,6 +148,7 @@ class PatternQueryRuntime:
             planned.init_state(planned.key_capacity))
         self.callbacks: List[Callable] = []
         self.next_wakeup: int = _NO_WAKEUP_INT
+        self.slot_allocator = slot_allocator  # shared per partition
 
     @property
     def name(self):
@@ -155,16 +158,28 @@ class PatternQueryRuntime:
                        now: int) -> None:
         p = self.planned
         B = staged.ts.shape[0]
-        # v1 single-key layout: [1, B]; partitioned layout lands with the
-        # partition phase
-        cols = tuple(
-            jax.numpy.asarray(c[None, :]).astype(d)
-            for c, d in zip(staged.cols, p.in_schemas[stream_id].dtypes))
-        ts = jax.numpy.asarray(staged.ts[None, :])
-        valid = jax.numpy.asarray(staged.valid[None, :])
-        ord_ = jax.numpy.asarray(
-            np.arange(B, dtype=np.int64)[None, :])
-        key_idx = jax.numpy.asarray(np.zeros((1,), np.int32))
+        if p.partition_positions:
+            from .keyslots import group_events_by_key
+            pos = p.partition_positions[stream_id]
+            slots = self.slot_allocator.slots_for(
+                [staged.cols[i] for i in pos], staged.valid)
+            key_idx_np, sel, kvalid = group_events_by_key(slots, staged.valid)
+            csel = np.clip(sel, 0, B - 1)
+            cols = tuple(
+                jax.numpy.asarray(c[csel]).astype(d)
+                for c, d in zip(staged.cols, p.in_schemas[stream_id].dtypes))
+            ts = jax.numpy.asarray(staged.ts[csel])
+            valid = jax.numpy.asarray(kvalid)
+            ord_ = jax.numpy.asarray(csel.astype(np.int64))
+            key_idx = jax.numpy.asarray(key_idx_np)
+        else:
+            cols = tuple(
+                jax.numpy.asarray(c[None, :]).astype(d)
+                for c, d in zip(staged.cols, p.in_schemas[stream_id].dtypes))
+            ts = jax.numpy.asarray(staged.ts[None, :])
+            valid = jax.numpy.asarray(staged.valid[None, :])
+            ord_ = jax.numpy.asarray(np.arange(B, dtype=np.int64)[None, :])
+            key_idx = jax.numpy.asarray(np.zeros((1,), np.int32))
         pstate, sel_state = self.state
         pstate, sel_state, out, wake = p.steps[stream_id](
             pstate, sel_state, cols, ts, valid, ord_, key_idx,
@@ -346,7 +361,7 @@ class SiddhiAppRuntime:
                 qi += 1
                 self._add_query(element, qname)
             elif isinstance(element, Partition):
-                raise CompileError("partitions land in a later phase")
+                qi = self._add_partition(element, qi)
 
     # -- construction ---------------------------------------------------------
     def _define_stream_runtime(self, sdef: StreamDefinition):
@@ -388,6 +403,91 @@ class SiddhiAppRuntime:
         self.query_runtimes[name] = runtime
         self.junctions[planned.input_stream_id].subscribe_query(runtime)
         self._define_output_for(planned, name)
+
+    def _add_partition(self, part: Partition, qi: int) -> int:
+        """Partitions: key-scoped state clones (reference:
+        CORE/partition/PartitionRuntimeImpl.java:75).  Here the partition key
+        becomes an explicit key axis: pattern queries get per-key NFA slabs,
+        aggregations compose the partition key into their group key."""
+        from ..query_api.query import (
+            RangePartitionType,
+            StateInputStream,
+            ValuePartitionType,
+        )
+        from ..query_api.expression import Variable as V
+        from .pattern_planner import plan_pattern_query
+
+        # partition key attribute position per stream
+        positions: Dict[str, List[int]] = {}
+        for sid, pt in part.partition_type_map.items():
+            if isinstance(pt, RangePartitionType):
+                raise CompileError(
+                    "range partitions land in a later phase")
+            assert isinstance(pt, ValuePartitionType)
+            if not isinstance(pt.expression, V):
+                raise CompileError(
+                    "partition-by expression must be a plain attribute in "
+                    "this build")
+            schema = self.schemas.get(sid)
+            if schema is None:
+                raise CompileError(f"undefined partitioned stream {sid!r}")
+            positions[sid] = [schema.position(pt.expression.attribute_name)]
+
+        # capacity annotation: @capacity(keys='..', slots='..') on partition
+        keys_cap, nfa_slots = 4096, 8
+        for ann in part.annotations:
+            if ann.name.lower() == "capacity":
+                keys_cap = int(ann.element("keys", keys_cap))
+                nfa_slots = int(ann.element("slots", nfa_slots))
+
+        shared_allocator = SlotAllocator(keys_cap, name="partition")
+
+        for q in part.query_list:
+            qname = self._query_name(q, qi)
+            qi += 1
+            if isinstance(q.input_stream, StateInputStream):
+                spec_streams = q.input_stream.all_stream_ids
+                ppos = {}
+                for sid in spec_streams:
+                    if sid not in positions:
+                        raise CompileError(
+                            f"pattern stream {sid!r} has no partition key")
+                    ppos[sid] = positions[sid]
+                planned = plan_pattern_query(
+                    q, qname, self.schemas, self.interner,
+                    key_capacity=keys_cap, slots=nfa_slots,
+                    partition_positions=ppos)
+                runtime = PatternQueryRuntime(planned, self,
+                                              slot_allocator=shared_allocator)
+                self.query_runtimes[qname] = runtime
+                for sid in planned.spec.stream_ids:
+                    class _Sub:
+                        def __init__(self, qr, stream):
+                            self._qr, self._sid = qr, stream
+
+                        def process_staged(self, staged, now):
+                            self._qr.process_staged(self._sid, staged, now)
+                    self.junctions[sid].subscribe_query(_Sub(runtime, sid))
+                self._define_output_for(planned, qname)
+            else:
+                ist = q.input_stream
+                if not isinstance(ist, SingleInputStream):
+                    raise CompileError(
+                        "joins inside partitions land in a later phase")
+                sid = ist.unique_stream_id
+                ppos = positions.get(sid)
+                if ppos is None and not ist.is_inner_stream:
+                    raise CompileError(
+                        f"stream {sid!r} has no partition key")
+                planned = plan_single_query(
+                    q, qname, self.app.stream_definition_map, self.schemas,
+                    self.interner, group_slots=max(keys_cap, 4096),
+                    partition_positions=ppos)
+                runtime = QueryRuntime(planned, self)
+                self.query_runtimes[qname] = runtime
+                self.junctions[sid].subscribe_query(runtime)
+                self._define_output_for(planned, qname)
+        return qi
 
     def _define_output_for(self, planned, name: str):
         # define the output stream if missing

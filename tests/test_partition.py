@@ -1,0 +1,143 @@
+"""Partition tests (modeled on TEST/query/partition/PartitionTestCase1)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def run_app(manager, ql, sends, query="query1"):
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(query, lambda ts, i, o: got.extend(i or []))
+    rt.start()
+    handlers = {}
+    for stream, data, ts in sends:
+        h = handlers.setdefault(stream, rt.get_input_handler(stream))
+        h.send(data, timestamp=ts)
+    return got
+
+
+class TestPartition:
+    def test_partitioned_count(self, manager):
+        got = run_app(manager, """
+            @app:playback
+            define stream S (symbol string, price float, volume int);
+            partition with (symbol of S)
+            begin
+              @info(name='query1')
+              from S select symbol, count() as c insert into Out;
+            end;
+        """, [
+            ("S", ["IBM", 1.0, 1], 1000),
+            ("S", ["WSO2", 1.0, 1], 1001),
+            ("S", ["IBM", 1.0, 1], 1002),
+            ("S", ["IBM", 1.0, 1], 1003),
+            ("S", ["WSO2", 1.0, 1], 1004),
+        ])
+        assert [e.data for e in got] == [
+            ["IBM", 1], ["WSO2", 1], ["IBM", 2], ["IBM", 3], ["WSO2", 2]]
+
+    def test_partitioned_sum_with_groupby(self, manager):
+        # partition key + group-by compose
+        got = run_app(manager, """
+            @app:playback
+            define stream S (region string, symbol string, volume int);
+            partition with (region of S)
+            begin
+              @info(name='query1')
+              from S select region, symbol, sum(volume) as t
+              group by symbol insert into Out;
+            end;
+        """, [
+            ("S", ["US", "IBM", 10], 1000),
+            ("S", ["EU", "IBM", 100], 1001),
+            ("S", ["US", "IBM", 1], 1002),
+            ("S", ["US", "MSFT", 5], 1003),
+            ("S", ["EU", "IBM", 2], 1004),
+        ])
+        assert [e.data for e in got] == [
+            ["US", "IBM", 10], ["EU", "IBM", 100], ["US", "IBM", 11],
+            ["US", "MSFT", 5], ["EU", "IBM", 102]]
+
+    def test_partitioned_pattern(self, manager):
+        """The benchmark shape: per-key NFA isolation."""
+        got = run_app(manager, """
+            @app:playback
+            define stream S (symbol string, price float, volume int);
+            partition with (symbol of S)
+            begin
+              @info(name='query1')
+              from every e1=S[volume == 1] -> e2=S[volume == 2]
+              select e1.symbol as s, e1.price as p1, e2.price as p2
+              insert into Out;
+            end;
+        """, [
+            ("S", ["A", 10.0, 1], 1000),   # A: e1
+            ("S", ["B", 20.0, 1], 1001),   # B: e1
+            ("S", ["B", 21.0, 2], 1002),   # B completes
+            ("S", ["A", 11.0, 2], 1003),   # A completes
+            ("S", ["A", 12.0, 1], 1004),   # A: new e1 (every)
+            ("S", ["A", 13.0, 2], 1005),   # A completes again
+        ])
+        assert [e.data for e in got] == [
+            ["B", pytest.approx(20.0), pytest.approx(21.0)],
+            ["A", pytest.approx(10.0), pytest.approx(11.0)],
+            ["A", pytest.approx(12.0), pytest.approx(13.0)],
+        ]
+
+    def test_partitioned_pattern_no_cross_key_match(self, manager):
+        got = run_app(manager, """
+            @app:playback
+            define stream S (symbol string, volume int);
+            partition with (symbol of S)
+            begin
+              @info(name='query1')
+              from e1=S[volume == 1] -> e2=S[volume == 2]
+              select e1.symbol as s1, e2.symbol as s2 insert into Out;
+            end;
+        """, [
+            ("S", ["A", 1], 1000),
+            ("S", ["B", 2], 1001),   # must NOT complete A's pattern
+            ("S", ["A", 2], 1002),   # completes A
+        ])
+        assert [e.data for e in got] == [["A", "A"]]
+
+    def test_partitioned_pattern_batch_send(self, manager):
+        """Many keys in a single micro-batch exercise the [K,E] layout."""
+        sends = []
+        for i in range(50):
+            sends.append(("S", [f"sym{i}", 1], 1000 + i))
+        for i in range(50):
+            sends.append(("S", [f"sym{i}", 2], 2000 + i))
+        rt = None
+        manager2 = manager
+        got = run_app(manager2, """
+            @app:playback
+            define stream S (symbol string, volume int);
+            partition with (symbol of S)
+            begin
+              @info(name='query1')
+              from every e1=S[volume == 1] -> e2=S[volume == 2]
+              select e1.symbol as s insert into Out;
+            end;
+        """, [("S", [[d for d in data] for _, data, _ in sends[:50]], 1000),
+              ("S", [[d for d in data] for _, data, _ in sends[50:]], 2000)])
+        assert sorted(e.data[0] for e in got) == sorted(
+            f"sym{i}" for i in range(50))
+
+    def test_inner_stream_chain(self, manager):
+        got = run_app(manager, """
+            @app:playback
+            define stream S (symbol string, volume int);
+            partition with (symbol of S)
+            begin
+              from S select symbol, count() as c insert into #Inner;
+              @info(name='query2')
+              from #Inner[c >= 2] select symbol, c insert into Out;
+            end;
+        """, [
+            ("S", ["A", 1], 1000),
+            ("S", ["A", 1], 1001),
+            ("S", ["B", 1], 1002),
+            ("S", ["A", 1], 1003),
+        ], query="query2")
+        assert [e.data for e in got] == [["A", 2], ["A", 3]]
