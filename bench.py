@@ -1,0 +1,147 @@
+"""Benchmark: events/sec on the 4-state pattern over a 1M-key partitioned
+stream (BASELINE.json target metric), run on whatever jax.devices()[0] is
+(the real TPU chip under the driver).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline: the reference is a JVM library; no JVM exists in this image
+(BASELINE.md), so the stand-in baseline is a measured pure-Python per-event
+NFA interpreter that mimics the reference's execution model (one event at a
+time through per-key pending-state lists, StreamPreStateProcessor-style).
+Auxiliary numbers go to stderr.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+N_KEYS = 1 << 20          # 1M partition keys
+BATCH = 1 << 15           # 32768 events per micro-batch
+SLOTS = 4
+SWEEPS = 3                # timed sweeps over all keys x 4 stages
+
+QL = f"""
+@app:playback
+define stream TradeStream (key long, price float, volume int);
+partition with (key of TradeStream)
+begin
+  @capacity(keys='{N_KEYS}', slots='{SLOTS}')
+  @info(name='flagship')
+  from every e1=TradeStream[volume == 1]
+       -> e2=TradeStream[volume == 2 and price >= e1.price]
+       -> e3=TradeStream[volume == 3]
+       -> e4=TradeStream[volume == 4 and price >= e3.price]
+  select e1.key as k, e1.price as p1, e2.price as p2, e4.price as p4
+  insert into Matches;
+end;
+"""
+
+
+def run_tpu():
+    from siddhi_tpu import SiddhiManager
+
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(QL)
+    matches = [0]
+    rt.add_batch_callback(
+        "flagship",
+        lambda ts, b: matches.__setitem__(
+            0, matches[0] + int((b["valid"] & (b["kind"] == 0)).sum())))
+    rt.start()
+    h = rt.get_input_handler("TradeStream")
+
+    blocks = N_KEYS // BATCH
+    key_block = {b: np.arange(b * BATCH, (b + 1) * BATCH, dtype=np.int64)
+                 for b in range(blocks)}
+    vol = {s: np.full((BATCH,), s, np.int32) for s in (1, 2, 3, 4)}
+    price = {s: np.full((BATCH,), float(s), np.float32) for s in (1, 2, 3, 4)}
+
+    clock = [1000]
+
+    def send(block, stage):
+        clock[0] += 1
+        h.send_columns([key_block[block], price[stage], vol[stage]],
+                       timestamps=np.full((BATCH,), clock[0], np.int64))
+
+    # warmup / compile
+    for stage in (1, 2, 3, 4):
+        send(0, stage)
+    warm_matches = matches[0]
+    print(f"warmup done, matches={warm_matches}", file=sys.stderr)
+
+    lat = []
+    total = 0
+    t0 = time.perf_counter()
+    for _ in range(SWEEPS):
+        for block in range(blocks):
+            for stage in (1, 2, 3, 4):
+                tb = time.perf_counter()
+                send(block, stage)
+                lat.append(time.perf_counter() - tb)
+                total += BATCH
+    dt = time.perf_counter() - t0
+    eps = total / dt
+    lat_ms = np.array(sorted(lat)) * 1000
+    print(f"tpu: {total} events in {dt:.2f}s -> {eps:,.0f} ev/s; "
+          f"matches={matches[0]}; batch p50={lat_ms[len(lat)//2]:.2f}ms "
+          f"p99={lat_ms[int(len(lat)*0.99)]:.2f}ms", file=sys.stderr)
+    expected = SWEEPS * blocks * BATCH  # one match per key per sweep
+    if matches[0] - warm_matches != expected:
+        print(f"WARNING: match count {matches[0]-warm_matches} != "
+              f"{expected}", file=sys.stderr)
+    manager.shutdown()
+    return eps
+
+
+def run_python_baseline(n_events=400_000):
+    """Per-event interpreter in the reference's style: pending-state lists
+    per key, one event at a time (no JVM in this image; see BASELINE.md)."""
+    import collections
+
+    pending = collections.defaultdict(list)
+    seeds_on = True
+    matches = 0
+    nkeys = n_events // 16 or 1
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, nkeys, n_events).tolist()
+    vols = rng.integers(1, 5, n_events).tolist()
+    prices = rng.random(n_events).tolist()
+
+    t0 = time.perf_counter()
+    for key, vol, price in zip(keys, vols, prices):
+        lst = pending[key]
+        out = []
+        for slot in lst:
+            pos = slot[0]
+            if pos == 1 and vol == 2 and price >= slot[1][1]:
+                out.append((2, slot[1], (key, price)))
+            elif pos == 2 and vol == 3:
+                out.append((3, slot[1], slot[2], (key, price)))
+            elif pos == 3 and vol == 4 and price >= slot[3][1]:
+                matches += 1
+            else:
+                out.append(slot)
+        if vol == 1:
+            out.append((1, (key, price)))
+        pending[key] = out
+    dt = time.perf_counter() - t0
+    eps = n_events / dt
+    print(f"python per-event baseline: {eps:,.0f} ev/s "
+          f"({matches} matches)", file=sys.stderr)
+    return eps
+
+
+def main():
+    baseline = run_python_baseline()
+    eps = run_tpu()
+    print(json.dumps({
+        "metric": "pattern_4state_1Mkeys_events_per_sec",
+        "value": round(eps),
+        "unit": "events/sec",
+        "vs_baseline": round(eps / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -313,17 +313,34 @@ def timer_batch(schema: Schema, timestamp: int, capacity: int = 8) -> EventBatch
 
 def unpack(schema: Schema, batch: EventBatch,
            want_kinds: Tuple[int, ...] = (CURRENT,)) -> List[Tuple[int, Event]]:
-    """Decode a device batch back to host [(kind, Event)] preserving order."""
-    ts = np.asarray(batch.ts)
+    """Decode a device batch back to host [(kind, Event)] preserving order.
+    Vectorized: one boolean reduction + per-column .tolist()."""
     kind = np.asarray(batch.kind)
     valid = np.asarray(batch.valid)
-    cols = [np.asarray(c) for c in batch.cols]
+    keep = valid & (kind != TIMER) & (kind != RESET)
+    if want_kinds is not None:
+        sel = np.zeros_like(keep)
+        for k in want_kinds:
+            sel |= kind == k
+        keep &= sel
+    idx = np.nonzero(keep)[0]
+    if idx.size == 0:
+        return []
+    ts_l = np.asarray(batch.ts)[idx].tolist()
+    kind_l = kind[idx].tolist()
+    col_ls = [np.asarray(c)[idx].tolist() for c in batch.cols]
+    decoders = []
+    for t in schema.types:
+        tu = t.upper()
+        if tu == "STRING":
+            decoders.append(schema.interner.lookup)
+        elif tu == "OBJECT":
+            decoders.append(schema.objects.lookup)
+        else:
+            decoders.append(None)
     out: List[Tuple[int, Event]] = []
-    for i in range(ts.shape[0]):
-        if not valid[i] or kind[i] == TIMER or kind[i] == RESET:
-            continue
-        if want_kinds is not None and int(kind[i]) not in want_kinds:
-            continue
-        data = [schema.decode_value(t, cols[j][i]) for j, t in enumerate(schema.types)]
-        out.append((int(kind[i]), Event(int(ts[i]), data)))
+    for i in range(len(idx)):
+        data = [c[i] if d is None else d(c[i])
+                for c, d in zip(col_ls, decoders)]
+        out.append((kind_l[i], Event(ts_l[i], data)))
     return out

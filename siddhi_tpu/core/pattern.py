@@ -489,6 +489,14 @@ class PatternExec:
     # -- spawn ----------------------------------------------------------------
     def _spawn(self, st: PatternState, fork, seed_spawn, seed_pos, seed_count,
                seed_side, seed_fork_also, stream_id, ev_cols, ev_ts, a0):
+        """Allocate free slots for fork/seed candidates.
+
+        Scatter-free formulation (TPU scatters serialize; gathers don't):
+        instead of scattering candidates into target slots, each destination
+        slot PULLS its candidate.  Slot j (if free) has free-rank r_j; the
+        candidate with allocation-rank r_j lands there.  The rank->candidate
+        inverse is a one-hot contraction over the tiny NC=P+2 axis, then all
+        payload moves are take_along_axis gathers."""
         K, P = st.active.shape
         spec = self.spec
 
@@ -502,29 +510,30 @@ class PatternExec:
         else:
             cand_valid = jnp.concatenate([fork, seed_spawn[:, None]], axis=1)
 
-        rank = jnp.cumsum(cand_valid.astype(jnp.int32), axis=1) - 1
-        free = jnp.logical_not(st.active)
-        free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1
-        slot_ids = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (K, P))
-        krow = jnp.arange(K)[:, None]
-        free_idx = jnp.full((K, P), P, jnp.int32).at[
-            krow, jnp.where(free, free_rank, P)
-        ].set(slot_ids, mode="drop")
-        nfree = jnp.sum(free.astype(jnp.int32), axis=1)
-        ok = jnp.logical_and(cand_valid, rank < nfree[:, None])
-        tgt = jnp.take_along_axis(free_idx, jnp.clip(rank, 0, P - 1), axis=1)
-        tgt = jnp.where(ok, tgt, P)          # P == drop
+        rank = jnp.cumsum(cand_valid.astype(jnp.int32), axis=1) - 1  # [K,NC]
+        free = jnp.logical_not(st.active)                            # [K,P]
+        free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1   # [K,P]
+        nfree = jnp.sum(free.astype(jnp.int32), axis=1)              # [K]
+        ncand = jnp.sum(cand_valid.astype(jnp.int32), axis=1)
+
+        # destination slot j takes candidate c iff free[j] and
+        # rank[c] == free_rank[j] (and candidate exists)
+        hot = jnp.logical_and(
+            jnp.logical_and(cand_valid[:, None, :],
+                            rank[:, None, :] == free_rank[:, :, None]),
+            free[:, :, None])                                        # [K,P,NC]
+        has_cand = jnp.any(hot, axis=2)                              # [K,P]
+        take = jnp.argmax(hot, axis=2).astype(jnp.int32)             # [K,P]
 
         st = st._replace(dropped=st.dropped + jnp.sum(
-            jnp.logical_and(cand_valid, jnp.logical_not(ok))
-            .astype(jnp.int64)))
+            jnp.maximum(ncand - nfree, 0).astype(jnp.int64)))
 
-        def scat(dst, vals):
-            return dst.at[krow, tgt].set(vals, mode="drop")
+        def pull(cand_field, old_field):
+            got = jnp.take_along_axis(cand_field, take, axis=1)
+            return jnp.where(has_cand, got, old_field)
 
-        # payloads
+        # candidate payloads [K,NC]
         fork_pos = st.pos + 1
-        fork_start = st.start_ts
         if seed_fork_also:
             # first seed candidate: advancing slot (pos 1); second: collector
             cpos = jnp.concatenate(
@@ -548,57 +557,59 @@ class PatternExec:
         clmask = jnp.concatenate(
             [jnp.zeros((K, P), jnp.int32)] + [seed_lmask] * extra, axis=1)
         cstart = jnp.concatenate(
-            [fork_start] + [ev_ts[:, None]] * extra, axis=1)
+            [st.start_ts] + [ev_ts[:, None]] * extra, axis=1)
         centry = jnp.broadcast_to(ev_ts[:, None], (K, NC))
 
         st = st._replace(
-            active=scat(st.active, ok),
-            pos=scat(st.pos, cpos),
-            count=scat(st.count, ccount),
-            lmask=scat(st.lmask, clmask),
-            start_ts=scat(st.start_ts, cstart),
-            entry_ts=scat(st.entry_ts, centry),
+            active=jnp.logical_or(st.active, has_cand),
+            pos=pull(cpos, st.pos),
+            count=pull(ccount, st.count),
+            lmask=pull(clmask, st.lmask),
+            start_ts=pull(cstart, st.start_ts),
+            entry_ts=pull(centry, st.entry_ts),
         )
 
         # captures: forks inherit the source slot (post-capture state, which
         # already includes this event); seeds get the incoming event at atom0
         newcaps = {}
-        src_slot = jnp.concatenate(
-            [jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (K, P))] +
-            [jnp.zeros((K, 1), jnp.int32)] * extra, axis=1)  # [K,NC]
         is_seed_cand = jnp.concatenate(
             [jnp.zeros((K, P), jnp.bool_)] +
-            [jnp.ones((K, 1), jnp.bool_)] * extra, axis=1)
+            [jnp.ones((K, 1), jnp.bool_)] * extra, axis=1)       # [K,NC]
+        seed_taken = jnp.take_along_axis(is_seed_cand, take, axis=1)  # [K,P]
+        # fork candidate c (< P) sources from slot c; pull source slot per dst
+        src_of_cand = jnp.concatenate(
+            [jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (K, P))] +
+            [jnp.zeros((K, 1), jnp.int32)] * extra, axis=1)
+        src_slot = jnp.take_along_axis(src_of_cand, take, axis=1)     # [K,P]
+        fork_taken = jnp.logical_and(has_cand, jnp.logical_not(seed_taken))
         for a in spec.all_atoms():
             if a.absent:
                 continue
             ck = a.ckey
             ts_c, cols_c = st.caps[ck]
             D = ts_c.shape[2]
-            drange = jnp.arange(D)[None, None, :]
             seed_has = (a.pos == 0 and a.stream_id == stream_id)
+            first_d = (jnp.arange(D) == 0)[None, None, :]
+            seed_m = jnp.logical_and(seed_taken[:, :, None],
+                                     jnp.ones((1, 1, D), jnp.bool_))
 
-            def payload(c, incoming):
-                src = jnp.take_along_axis(c, src_slot[:, :, None], axis=1)
+            def merge(c, incoming):
+                inherited = jnp.take_along_axis(c, src_slot[:, :, None],
+                                                axis=1)      # [K,P,D]
+                out = jnp.where(fork_taken[:, :, None], inherited, c)
                 if seed_has:
-                    iv = jnp.broadcast_to(incoming[:, None, None], src.shape)
-                    first_d = drange == 0
-                    src = jnp.where(
-                        jnp.logical_and(is_seed_cand[:, :, None], first_d),
-                        iv, jnp.where(is_seed_cand[:, :, None],
-                                      jnp.zeros_like(src), src))
+                    iv = jnp.broadcast_to(incoming[:, None, None],
+                                          (K, P, D)).astype(c.dtype)
+                    out = jnp.where(
+                        jnp.logical_and(seed_m, first_d), iv,
+                        jnp.where(seed_m, jnp.zeros_like(out), out))
                 else:
-                    src = jnp.where(is_seed_cand[:, :, None],
-                                    jnp.zeros_like(src), src)
-                return src
+                    out = jnp.where(seed_m, jnp.zeros_like(out), out)
+                return out
 
-            nts = ts_c.at[krow[:, :, None], tgt[:, :, None], drange].set(
-                payload(ts_c, ev_ts), mode="drop")
-            ncols = tuple(
-                c.at[krow[:, :, None], tgt[:, :, None], drange].set(
-                    payload(c, ev_cols[j]), mode="drop")
-                for j, c in enumerate(cols_c))
-            newcaps[ck] = (nts, ncols)
+            newcaps[ck] = (merge(ts_c, ev_ts),
+                           tuple(merge(c, ev_cols[j])
+                                 for j, c in enumerate(cols_c)))
         return st._replace(caps=newcaps)
 
     # -- env ------------------------------------------------------------------

@@ -87,6 +87,12 @@ class InputHandler:
                     for d in data]
         return [ev.Event(now, list(data))]
 
+    def send_columns(self, cols: Sequence, timestamps=None) -> None:
+        """Columnar high-throughput ingestion: `cols` is a sequence of numpy
+        arrays (one per attribute, equal length; strings pre-encoded as
+        interner ids).  Bypasses per-event Python staging."""
+        self._runtime._route_columns(self.stream_id, cols, timestamps)
+
 
 class QueryRuntime:
     """Host wrapper around one planned query: staging, group slots, routing."""
@@ -99,6 +105,7 @@ class QueryRuntime:
         self.state = jax.tree.map(
             lambda x: jax.numpy.array(x, copy=True), planned.init_state())
         self.callbacks: List[Callable] = []
+        self.batch_callbacks: List[Callable] = []
         self.next_wakeup: int = _NO_WAKEUP_INT
 
     @property
@@ -147,6 +154,7 @@ class PatternQueryRuntime:
             lambda x: jax.numpy.array(x, copy=True),
             planned.init_state(planned.key_capacity))
         self.callbacks: List[Callable] = []
+        self.batch_callbacks: List[Callable] = []
         self.next_wakeup: int = _NO_WAKEUP_INT
         self.slot_allocator = slot_allocator  # shared per partition
 
@@ -158,6 +166,9 @@ class PatternQueryRuntime:
                        now: int) -> None:
         p = self.planned
         B = staged.ts.shape[0]
+        if p.partition_positions and p.mesh is not None:
+            self._process_sharded(stream_id, staged, now)
+            return
         if p.partition_positions:
             from .keyslots import group_events_by_key
             pos = p.partition_positions[stream_id]
@@ -188,6 +199,49 @@ class PatternQueryRuntime:
         _emit_output(self, out, now)
         self._maybe_schedule(wake)
 
+    def _process_sharded(self, stream_id: str, staged: ev.StagedBatch,
+                         now: int) -> None:
+        """Multi-chip path: route each key to its shard (slot % n), build the
+        stacked [n*Kb, E] layout, run the shard_map step."""
+        from .keyslots import group_events_by_key
+        p = self.planned
+        n = p.mesh.devices.size
+        B = staged.ts.shape[0]
+        pos = p.partition_positions[stream_id]
+        slots = self.slot_allocator.slots_for(
+            [staged.cols[i] for i in pos], staged.valid)
+        dev = slots % n
+        local = slots // n
+        groups = []
+        for d in range(n):
+            mask = (dev == d) & staged.valid & (slots >= 0)
+            groups.append(group_events_by_key(
+                np.where(mask, local, -1), mask))
+        Kb = max(g[0].shape[0] for g in groups)
+        E = max(g[1].shape[1] for g in groups)
+        key_idx = np.full((n, Kb), -1, np.int32)
+        sel = np.full((n, Kb, E), -1, np.int32)
+        for d, (ki, s, kv) in enumerate(groups):
+            key_idx[d, :ki.shape[0]] = ki
+            sel[d, :s.shape[0], :s.shape[1]] = s
+        kvalid = sel >= 0
+        csel = np.clip(sel, 0, B - 1)
+        flat = lambda a: a.reshape((n * Kb,) + a.shape[2:])
+        cols = tuple(
+            jax.numpy.asarray(flat(c[csel])).astype(d_)
+            for c, d_ in zip(staged.cols, p.in_schemas[stream_id].dtypes))
+        pstate, sel_state = self.state
+        pstate, sel_state, out, wake = p.steps[stream_id](
+            pstate, sel_state, cols,
+            jax.numpy.asarray(flat(staged.ts[csel])),
+            jax.numpy.asarray(flat(kvalid)),
+            jax.numpy.asarray(flat(csel.astype(np.int64))),
+            jax.numpy.asarray(flat(key_idx)),
+            jax.numpy.asarray(now, jax.numpy.int64))
+        self.state = (pstate, sel_state)
+        _emit_output(self, out, now)
+        self._maybe_schedule(wake)
+
     def on_timer(self, now: int) -> None:
         p = self.planned
         if p.timer_step is None:
@@ -209,11 +263,22 @@ class PatternQueryRuntime:
 
 
 def _emit_output(qr, out, now: int) -> None:
-    """Shared output emission: unpack device output rows, fan out to query
-    callbacks and the target junction."""
+    """Shared output emission: fan out to columnar batch callbacks first
+    (zero-decode path), then unpack to host events only if someone needs
+    them (Event callbacks or downstream routing)."""
     ots, okind, ovalid, ocols = out
     p = qr.planned
-    if not np.any(np.asarray(ovalid)):
+    ovalid_np = np.asarray(ovalid)
+    if not ovalid_np.any():
+        return
+    if qr.batch_callbacks:
+        cols_np = {n: np.asarray(c)
+                   for n, c in zip(p.out_schema.names, ocols)}
+        payload = {"ts": np.asarray(ots), "kind": np.asarray(okind),
+                   "valid": ovalid_np, "cols": cols_np}
+        for bcb in qr.batch_callbacks:
+            bcb(now, payload)
+    if not qr.callbacks and not p.output_target:
         return
     batch = ev.EventBatch(ots, okind, ovalid, ocols)
     pairs = ev.unpack(p.out_schema, batch,
@@ -331,9 +396,10 @@ class SiddhiAppRuntime:
     """reference: CORE/SiddhiAppRuntimeImpl.java:99"""
 
     def __init__(self, app: SiddhiApp, manager: "SiddhiManager",
-                 name: Optional[str] = None):
+                 name: Optional[str] = None, mesh=None):
         self.app = app
         self.manager = manager
+        self.mesh = mesh  # jax.sharding.Mesh with a 'shard' axis, or None
         self.name = name or app.name or "SiddhiApp"
         self.interner = manager.interner
         self.objects = ev.ObjectRegistry()
@@ -433,12 +499,19 @@ class SiddhiAppRuntime:
                 raise CompileError(f"undefined partitioned stream {sid!r}")
             positions[sid] = [schema.position(pt.expression.attribute_name)]
 
-        # capacity annotation: @capacity(keys='..', slots='..') on partition
+        # capacity annotation: @capacity(keys='..', slots='..') on the
+        # partition or any of its queries
         keys_cap, nfa_slots = 4096, 8
-        for ann in part.annotations:
+        all_anns = list(part.annotations)
+        for q in part.query_list:
+            all_anns.extend(q.annotations)
+        for ann in all_anns:
             if ann.name.lower() == "capacity":
                 keys_cap = int(ann.element("keys", keys_cap))
                 nfa_slots = int(ann.element("slots", nfa_slots))
+        if self.mesh is not None:
+            n = self.mesh.devices.size
+            keys_cap = ((keys_cap + n - 1) // n) * n
 
         shared_allocator = SlotAllocator(keys_cap, name="partition")
 
@@ -456,7 +529,7 @@ class SiddhiAppRuntime:
                 planned = plan_pattern_query(
                     q, qname, self.schemas, self.interner,
                     key_capacity=keys_cap, slots=nfa_slots,
-                    partition_positions=ppos)
+                    partition_positions=ppos, mesh=self.mesh)
                 runtime = PatternQueryRuntime(planned, self,
                                               slot_allocator=shared_allocator)
                 self.query_runtimes[qname] = runtime
@@ -528,6 +601,11 @@ class SiddhiAppRuntime:
             raise KeyError(f"undefined stream {stream_id!r}")
         return InputHandler(stream_id, self)
 
+    def add_batch_callback(self, query_name: str, cb) -> None:
+        """High-throughput query callback receiving columnar numpy batches
+        (ts, kind, valid, cols dict) without per-event decoding."""
+        self.query_runtimes[query_name].batch_callbacks.append(cb)
+
     def add_callback(self, name: str, cb) -> None:
         """Stream name -> StreamCallback; query name -> QueryCallback."""
         if name in self.junctions and name not in self.query_runtimes:
@@ -536,6 +614,37 @@ class SiddhiAppRuntime:
             self.query_runtimes[name].callbacks.append(_wrap_query_callback(cb))
         else:
             raise KeyError(f"no stream or query named {name!r}")
+
+    def _route_columns(self, stream_id: str, cols, timestamps) -> None:
+        junction = self.junctions.get(stream_id)
+        if junction is None:
+            raise KeyError(f"undefined stream {stream_id!r}")
+        n = len(cols[0])
+        cap = ev.bucket_size(max(n, 1))
+        schema = junction.schema
+        if timestamps is None:
+            ts0 = self.timestamp_millis()
+            ts = np.full((cap,), ts0, np.int64)
+        else:
+            ts = np.zeros((cap,), np.int64)
+            ts[:n] = timestamps
+        valid = np.zeros((cap,), np.bool_)
+        valid[:n] = True
+        kind = np.zeros((cap,), np.int32)
+        padded = []
+        for c, t in zip(cols, schema.types):
+            a = np.zeros((cap,), ev.np_dtype(t))
+            a[:n] = c
+            padded.append(a)
+        staged = ev.StagedBatch(ts, kind, valid, padded, n)
+        if self.playback and n:
+            self._playback_time = max(self._playback_time, int(ts[:n].max()))
+        now = self.timestamp_millis()
+        with self._lock:
+            if self.playback:
+                self._scheduler.drain_playback(now)
+            for q in junction.queries:
+                q.process_staged(staged, now)
 
     def _route(self, stream_id: str, events: List[ev.Event]) -> None:
         junction = self.junctions.get(stream_id)
@@ -596,11 +705,12 @@ class SiddhiManager:
         self._persistence: Dict[str, List[bytes]] = {}
 
     def create_siddhi_app_runtime(
-            self, app: Union[str, SiddhiApp]) -> SiddhiAppRuntime:
+            self, app: Union[str, SiddhiApp],
+            mesh=None) -> SiddhiAppRuntime:
         if isinstance(app, str):
             from ..compiler import SiddhiCompiler
             app = SiddhiCompiler.parse(app)
-        runtime = SiddhiAppRuntime(app, self)
+        runtime = SiddhiAppRuntime(app, self, mesh=mesh)
         self.runtimes[runtime.name] = runtime
         return runtime
 
