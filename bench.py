@@ -17,12 +17,13 @@ import time
 import numpy as np
 
 N_KEYS = 1 << 20          # 1M partition keys
-BATCH = 1 << 15           # 32768 events per micro-batch
+BATCH = 1 << 17           # 131072 events per micro-batch
 SLOTS = 4
-SWEEPS = 3                # timed sweeps over all keys x 4 stages
+SWEEPS = 4                # timed sweeps over all keys x 4 stages
 
 QL = f"""
 @app:playback
+@async
 define stream TradeStream (key long, price float, volume int);
 partition with (key of TradeStream)
 begin
@@ -70,6 +71,7 @@ def run_tpu():
     warm_matches = matches[0]
     print(f"warmup done, matches={warm_matches}", file=sys.stderr)
 
+    rt.flush()
     lat = []
     total = 0
     t0 = time.perf_counter()
@@ -80,6 +82,7 @@ def run_tpu():
                 send(block, stage)
                 lat.append(time.perf_counter() - tb)
                 total += BATCH
+    rt.flush()            # all async deliveries done before the clock stops
     dt = time.perf_counter() - t0
     eps = total / dt
     lat_ms = np.array(sorted(lat)) * 1000

@@ -52,7 +52,7 @@ _DTYPES = {
 
 NULL_ID = -1  # interned id representing null string
 
-_BUCKETS = (8, 32, 128, 512, 2048, 8192, 32768, 131072, 524288)
+_BUCKETS = (8, 32, 128, 512, 2048, 8192, 32768, 131072, 262144, 524288)
 
 
 def bucket_size(n: int) -> int:

@@ -107,21 +107,28 @@ def _bucket(n: int, buckets) -> int:
     return buckets[-1]
 
 
-_KB_BUCKETS = (1, 8, 64, 512, 4096, 32768, 262144, 1048576)
+_KB_BUCKETS = (1, 8, 64, 512, 4096, 16384, 65536, 131072,
+               262144, 524288, 1048576)
 _E_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096, 16384)
 
 
-def group_events_by_key(slots: np.ndarray, valid: np.ndarray):
+def group_events_by_key(slots: np.ndarray, valid: np.ndarray,
+                        pad: int = 2**30):
     """Arrange a batch into the per-key [Kb, E] device layout.
 
     Returns (key_idx [Kb] int32, sel [Kb, E] int32 original-batch indices
     (-1 = padding), kvalid [Kb, E] bool).  Kb/E are padded to buckets to
     bound recompilation.  Events of one key keep their batch order along E
-    (sequential NFA semantics per key)."""
+    (sequential NFA semantics per key).
+
+    Padding key rows get index `pad` (= state capacity): the device gather
+    clamps them to a real row (their events are invalid, so the scan is a
+    no-op there) and the scatter-back DROPS them as out-of-bounds — a pad row
+    must never alias a live key's slot, or its stale state would clobber it."""
     vmask = valid & (slots >= 0)
     idx = np.nonzero(vmask)[0]
     if idx.size == 0:
-        key_idx = np.zeros((1,), np.int32)
+        key_idx = np.full((1,), pad, np.int32)
         sel = np.full((1, 1), -1, np.int32)
         return key_idx, sel, np.zeros((1, 1), np.bool_)
     s = slots[idx]
@@ -132,13 +139,8 @@ def group_events_by_key(slots: np.ndarray, valid: np.ndarray):
                                      return_counts=True)
     E = _bucket(int(counts.max()), _E_BUCKETS)
     Kb = _bucket(len(uniq), _KB_BUCKETS)
-    key_idx = np.zeros((Kb,), np.int32)
+    key_idx = np.full((Kb,), pad, np.int32)
     key_idx[:len(uniq)] = uniq.astype(np.int32)
-    # duplicate-gather guard: pad rows reuse key 0's slot; their events are
-    # invalid so the scan is a no-op, but scatter-back of duplicate key rows
-    # would be nondeterministic — point padding rows at a reserved dummy slot
-    if len(uniq) < Kb:
-        key_idx[len(uniq):] = -1  # caller maps -1 to a scratch row
     within = np.arange(len(s_sorted)) - np.repeat(starts, counts)
     sel = np.full((Kb, E), -1, np.int32)
     group_rank = np.repeat(np.arange(len(uniq)), counts)

@@ -193,12 +193,16 @@ class PatternState(NamedTuple):
 
 class PatternExec:
     def __init__(self, spec: PatternSpec, schemas: Dict[str, ev.Schema],
-                 interner: ev.StringInterner, slots: int = 8):
+                 interner: ev.StringInterner, slots: int = 8,
+                 emit_refs: Optional[set] = None):
         self.spec = spec
         self.schemas = schemas
         self.P = slots
         self.S = spec.n_states
         self.interner = interner
+        # emission pruning: only captures referenced by the query's selector
+        # are materialized into per-match output rows (None = all)
+        self.emit_refs = emit_refs
 
         # selector-facing scope: every non-absent atom ref is a source
         self.scope = Scope()
@@ -447,6 +451,8 @@ class PatternExec:
                                 "count": emit_count}
         for a in spec.all_atoms():
             if a.absent:
+                continue
+            if self.emit_refs is not None and a.ref not in self.emit_refs:
                 continue
             ck = a.ckey
             ts_c, cols_c = st.caps[ck]

@@ -142,7 +142,8 @@ def plan_pattern_query(
     for sid in spec.stream_ids:
         if sid not in schemas:
             raise CompileError(f"undefined stream {sid!r} in pattern")
-    pexec = PatternExec(spec, schemas, interner, slots=slots)
+    pexec = PatternExec(spec, schemas, interner, slots=slots,
+                        emit_refs=_used_refs(query, spec))
 
     out_target = query.output_stream.target_id if query.output_stream else ""
     # per-key aggregation: the selector's group slots are the partition keys
@@ -181,14 +182,14 @@ def plan_pattern_query(
 
             # scatter back: 2 wide scatters (see StatePacker docstring)
             nb32, nb64, nscal = packer.pack(sub)
-            b32 = b32.at[key_idx].set(nb32, unique_indices=True,
-                                      indices_are_sorted=True)
-            b64 = b64.at[key_idx].set(nb64, unique_indices=True,
-                                      indices_are_sorted=True)
+            # out-of-bounds (padding) rows are dropped by scatter semantics
+            b32 = b32.at[key_idx].set(nb32, mode="drop")
+            b64 = b64.at[key_idx].set(nb64, mode="drop")
 
+            cap = key_idx.shape[0] if partition_positions else None
             sel_state, out, wake = _emit_matches(
                 pexec, sel, spec, emits, ord_, sel_state, sub, now,
-                key_idx=key_idx)
+                key_idx=key_idx, compact_cap=cap)
             return (b32, b64, nscal), sel_state, out, wake
 
         return step
@@ -248,6 +249,32 @@ def _first_schema(spec: PatternSpec, schemas) -> ev.Schema:
     return schemas[spec.stream_ids[0]]
 
 
+def _used_refs(query: Query, spec: PatternSpec) -> set:
+    """Refs whose captures the selector can touch (emission pruning)."""
+    from ..query_api.expression import Variable, walk
+    refs = {a.ref for a in spec.all_atoms() if not a.absent}
+    sel = query.selector
+    if sel.is_select_all:
+        return refs      # select * touches everything
+    used = set()
+    exprs = [oa.expression for oa in sel.selection_list]
+    if sel.having_expression is not None:
+        exprs.append(sel.having_expression)
+    exprs.extend(sel.group_by_list)
+    exprs.extend(ob.variable for ob in sel.order_by_list)
+    unqualified = False
+    for e in exprs:
+        for node in walk(e):
+            if isinstance(node, Variable):
+                if node.stream_id is not None and node.stream_id in refs:
+                    used.add(node.stream_id)
+                elif node.stream_id is None:
+                    unqualified = True
+    if unqualified:
+        return refs      # can't prove which source an unqualified attr hits
+    return used
+
+
 def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
                 sel: SelectorExec):
     """Shard the pattern step over the mesh 'shard' axis.
@@ -281,6 +308,7 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
                         for s in scalars)
         ps, ss, out, wake = body((b32, b64, scalars), sel_state, cols, ts,
                                  valid, ord_, key_idx, now)
+        out = (lax.psum(out[0], "shard"),) + out[1:]
         nb32, nb64, nscal = ps
         # re-replicate scalar counters: old + psum(local delta)
         nscal = tuple(
@@ -293,12 +321,13 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
     sharded = jax.shard_map(
         local, mesh=mesh,
         in_specs=(pspec, sspec, bspec, bspec, bspec, bspec, bspec, P()),
-        out_specs=(pspec, sspec, (bspec, bspec, bspec, bspec), P()))
+        out_specs=(pspec, sspec, (P(), bspec, bspec, bspec, bspec), P()))
     return jax.jit(sharded, donate_argnums=(0, 1))
 
 
 def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,
-                  emits, ord_, sel_state, pstate, now, key_idx=None):
+                  emits, ord_, sel_state, pstate, now, key_idx=None,
+                  compact_cap=None):
     """Flatten scan emissions [E,K,P+1] into selector Rows + env."""
     mask = emits["mask"]                       # [E,K,P+1]
     E, K, P1 = mask.shape
@@ -315,7 +344,7 @@ def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,
 
     env: Dict[str, Any] = {"__ts__": rows_ts, "__now__": now}
     for a in spec.all_atoms():
-        if a.absent:
+        if a.absent or a.ckey not in emits:
             continue
         cap_ts, cap_cols = emits[a.ckey]       # [E,K,P+1,D]
         D = cap_ts.shape[-1]
@@ -345,6 +374,20 @@ def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,
         cols=(),
     )
     sel_state, out = sel.process(sel_state, rows, env)
+
+    # device-side output compaction: move valid rows to the front and trim to
+    # `compact_cap` so the host pulls O(matches) bytes, not O(E*K*(P+1)).
+    # The leading count scalar lets the drainer skip empty outputs with an
+    # 8-byte read.
+    ots, okind, ovalid, ocols = out
+    n_valid = jnp.sum(ovalid.astype(jnp.int64))
+    if compact_cap is not None and compact_cap < ots.shape[0]:
+        order = jnp.argsort(jnp.logical_not(ovalid), stable=True)
+        take = order[:compact_cap]
+        out = (ots[take], okind[take], ovalid[take],
+               tuple(c[take] for c in ocols))
+        n_valid = jnp.minimum(n_valid, compact_cap)
+    out = (n_valid,) + out
 
     # next wakeup: earliest absent deadline
     wake = jnp.asarray(NO_WAKEUP, jnp.int64)

@@ -174,7 +174,8 @@ class PatternQueryRuntime:
             pos = p.partition_positions[stream_id]
             slots = self.slot_allocator.slots_for(
                 [staged.cols[i] for i in pos], staged.valid)
-            key_idx_np, sel, kvalid = group_events_by_key(slots, staged.valid)
+            key_idx_np, sel, kvalid = group_events_by_key(
+                slots, staged.valid, pad=p.key_capacity)
             csel = np.clip(sel, 0, B - 1)
             cols = tuple(
                 jax.numpy.asarray(c[csel]).astype(d)
@@ -216,10 +217,11 @@ class PatternQueryRuntime:
         for d in range(n):
             mask = (dev == d) & staged.valid & (slots >= 0)
             groups.append(group_events_by_key(
-                np.where(mask, local, -1), mask))
+                np.where(mask, local, -1), mask,
+                pad=p.key_capacity // n))
         Kb = max(g[0].shape[0] for g in groups)
         E = max(g[1].shape[1] for g in groups)
-        key_idx = np.full((n, Kb), -1, np.int32)
+        key_idx = np.full((n, Kb), p.key_capacity // n, np.int32)
         sel = np.full((n, Kb, E), -1, np.int32)
         for d, (ki, s, kv) in enumerate(groups):
             key_idx[d, :ki.shape[0]] = ki
@@ -263,14 +265,34 @@ class PatternQueryRuntime:
 
 
 def _emit_output(qr, out, now: int) -> None:
+    """Emission entry: async mode (@async) defers the device->host sync to a
+    background drainer thread so the producer keeps dispatching device work
+    (the reference's Disruptor-decoupled delivery, StreamJunction.java:276);
+    sync mode delivers inline."""
+    if getattr(qr, "async_emit", False) and qr.app._drainer is not None:
+        qr.app._drainer.enqueue(qr, out, now)
+        return
+    _emit_output_sync(qr, out, now)
+
+
+def _emit_output_sync(qr, out, now: int) -> None:
     """Shared output emission: fan out to columnar batch callbacks first
     (zero-decode path), then unpack to host events only if someone needs
-    them (Event callbacks or downstream routing)."""
-    ots, okind, ovalid, ocols = out
+    them (Event callbacks or downstream routing).
+
+    Pattern outputs carry a leading device-computed valid-count scalar so an
+    empty batch costs one 8-byte read, not a bulk row transfer."""
+    if len(out) == 5:
+        n_valid, ots, okind, ovalid, ocols = out
+        if int(n_valid) == 0:
+            return
+        ovalid_np = np.asarray(ovalid)
+    else:
+        ots, okind, ovalid, ocols = out
+        ovalid_np = np.asarray(ovalid)
+        if not ovalid_np.any():
+            return
     p = qr.planned
-    ovalid_np = np.asarray(ovalid)
-    if not ovalid_np.any():
-        return
     if qr.batch_callbacks:
         cols_np = {n: np.asarray(c)
                    for n, c in zip(p.out_schema.names, ocols)}
@@ -323,6 +345,47 @@ class StreamJunction:
             staged = ev.pack_np(self.schema, events)
             for q in self.queries:
                 q.process_staged(staged, now)
+
+
+class _EmissionDrainer:
+    """Background thread pulling device outputs and delivering callbacks.
+    Bounded queue gives backpressure (reference: Disruptor ring buffer
+    capacity, @async(buffer.size))."""
+
+    def __init__(self, capacity: int = 64):
+        import queue
+        self._q = queue.Queue(maxsize=capacity)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="siddhi-drain")
+        self._stop = object()
+        self._started = False
+
+    def start(self):
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    def enqueue(self, qr, out, now):
+        self.start()
+        self._q.put((qr, out, now))
+
+    def flush(self):
+        self._q.join()
+
+    def stop(self):
+        if self._started:
+            self._q.join()
+
+    def _run(self):
+        while True:
+            qr, out, now = self._q.get()
+            try:
+                _emit_output_sync(qr, out, now)
+            except Exception:  # noqa: BLE001 — drainer must survive
+                import traceback
+                traceback.print_exc()
+            finally:
+                self._q.task_done()
 
 
 class _Scheduler:
@@ -405,6 +468,7 @@ class SiddhiAppRuntime:
         self.objects = ev.ObjectRegistry()
         self._lock = threading.RLock()
         self._scheduler = _Scheduler(self)
+        self._drainer = _EmissionDrainer()
         self._started = False
         # playback: event-driven time (reference: @app:playback,
         # CORE/util/timestamp/TimestampGeneratorImpl.java:118)
@@ -466,9 +530,15 @@ class SiddhiAppRuntime:
             q, name, self.app.stream_definition_map, self.schemas,
             self.interner)
         runtime = QueryRuntime(planned, self)
+        runtime.async_emit = self._async_enabled(q)
         self.query_runtimes[name] = runtime
         self.junctions[planned.input_stream_id].subscribe_query(runtime)
         self._define_output_for(planned, name)
+
+    def _async_enabled(self, q) -> bool:
+        if self.app.get_annotation("async") is not None:
+            return True
+        return q.get_annotation("async") is not None
 
     def _add_partition(self, part: Partition, qi: int) -> int:
         """Partitions: key-scoped state clones (reference:
@@ -532,6 +602,7 @@ class SiddhiAppRuntime:
                     partition_positions=ppos, mesh=self.mesh)
                 runtime = PatternQueryRuntime(planned, self,
                                               slot_allocator=shared_allocator)
+                runtime.async_emit = self._async_enabled(q)
                 self.query_runtimes[qname] = runtime
                 for sid in planned.spec.stream_ids:
                     class _Sub:
@@ -587,8 +658,13 @@ class SiddhiAppRuntime:
 
     def shutdown(self) -> None:
         if self._started:
+            self._drainer.stop()
             self._scheduler.stop()
             self._started = False
+
+    def flush(self) -> None:
+        """Wait until all asynchronously emitted output has been delivered."""
+        self._drainer.flush()
 
     def timestamp_millis(self) -> int:
         if self.playback:
