@@ -55,6 +55,7 @@ class PlannedQuery:
     slot_allocator: Optional[SlotAllocator]
     batch_capacity: int
     needs_timer: bool
+    in_deps: List[str] = dataclasses.field(default_factory=list)
 
 
 def _env_for(scope_key: str, cols, ts):
@@ -78,6 +79,21 @@ def plan_single_query(
     if sid not in schemas:
         raise CompileError(f"undefined stream {sid!r}")
     in_schema = schemas[sid]
+
+    # `in` operator table dependencies (reference: InConditionExpressionExecutor)
+    from ..query_api.expression import In as _In, walk as _walk
+    in_deps: List[str] = []
+    def _scan_in(e):
+        for node in _walk(e):
+            if isinstance(node, _In) and node.source_id not in in_deps:
+                in_deps.append(node.source_id)
+    for h in ist.stream_handlers:
+        if isinstance(h, Filter):
+            _scan_in(h.expression)
+    for oa in query.selector.selection_list:
+        _scan_in(oa.expression)
+    if query.selector.having_expression is not None:
+        _scan_in(query.selector.having_expression)
 
     scope = Scope()
     scope.interner = interner
@@ -138,9 +154,14 @@ def plan_single_query(
     # ---- the fused step -----------------------------------------------------
     wproc = window_proc
 
-    def step(state, ts, kind, valid, cols, gslot, now):
+    def step(state, ts, kind, valid, cols, gslot, now, in_tabs=()):
         wstate, astate = state
         env = {sid: cols, "__ts__": ts, "__now__": now}
+        for dep, (tcol0, tvalid) in zip(in_deps, in_tabs):
+            def probe(vals, _tc=tcol0, _tv=tvalid):
+                return jnp.any(jnp.logical_and(
+                    vals[:, None] == _tc[None, :], _tv[None, :]), axis=1)
+            env["__in__:" + dep] = probe
         keep = valid
         is_current = kind == ev.CURRENT
         for f in pre_filters:
@@ -152,6 +173,9 @@ def plan_single_query(
         wstate, wout = wproc.process(wstate, rows, now)
         orows = wout.rows
         env2 = {sid: orows.cols, "__ts__": orows.ts, "__now__": now}
+        for k, v in env.items():
+            if k.startswith("__in__:"):
+                env2[k] = v
         if post_filters:
             keep2 = orows.valid
             oc = orows.kind == ev.CURRENT
@@ -186,4 +210,5 @@ def plan_single_query(
         slot_allocator=allocator,
         batch_capacity=batch_capacity,
         needs_timer=wproc.needs_timer,
+        in_deps=in_deps,
     )

@@ -120,9 +120,13 @@ class QueryRuntime:
         else:
             gslot = np.zeros((staged.ts.shape[0],), np.int32)
         batch = staged.to_device(p.in_schema)
+        in_tabs = tuple(
+            (self.app.tables[d].cols[0], self.app.tables[d].valid)
+            for d in p.in_deps)
         self.state, out, wake = p.step(
             self.state, batch.ts, batch.kind, batch.valid, batch.cols,
-            jax.numpy.asarray(gslot), jax.numpy.asarray(now, jax.numpy.int64))
+            jax.numpy.asarray(gslot), jax.numpy.asarray(now, jax.numpy.int64),
+            in_tabs)
         self._emit(out, now)
         if p.needs_timer:
             w = int(wake)
@@ -311,6 +315,9 @@ def _emit_output_sync(qr, out, now: int) -> None:
     expired = [e for k, e in pairs if k == ev.EXPIRED]
     for cb in qr.callbacks:
         cb(now, current or None, expired or None)
+    if getattr(qr, "table_op", None) is not None:
+        _apply_table_op(qr, ots, okind, ovalid, ocols, now)
+        return
     if p.output_target:
         sel = p.output_event_type
         if sel == "CURRENT_EVENTS":
@@ -321,6 +328,87 @@ def _emit_output_sync(qr, out, now: int) -> None:
             routed = [e for _, e in pairs]
         if routed:
             qr.app._route(p.output_target, routed)
+
+
+def _apply_table_op(qr, ots, okind, ovalid, ocols, now) -> None:
+    """Table write operations from query output (reference: CORE/query/output/
+    callback/{InsertIntoTable,UpdateTable,DeleteTable,UpdateOrInsertTable}
+    Callback.java)."""
+    op, table, cond, set_fns, key = qr.table_op
+    want = okind == 0  # CURRENT rows drive table ops
+    valid = jax.numpy.logical_and(ovalid, jax.numpy.asarray(np.asarray(want)))
+    batch = ev.EventBatch(ots, okind, valid, ocols)
+    if op == "insert":
+        staged = ev.StagedBatch(
+            np.asarray(ots), np.asarray(okind), np.asarray(valid),
+            [np.asarray(c) for c in ocols], int(np.asarray(valid).sum()))
+        table.insert(batch, staged)
+    elif op == "delete":
+        table.delete_where(cond, key, batch)
+    elif op == "update":
+        table.update_where(cond, key, batch, set_fns)
+    elif op == "upsert":
+        staged = ev.StagedBatch(
+            np.asarray(ots), np.asarray(okind), np.asarray(valid),
+            [np.asarray(c) for c in ocols], int(np.asarray(valid).sum()))
+        table.update_where(cond, key, batch, set_fns, upsert=True,
+                           staged=staged)
+
+
+class JoinQueryRuntime:
+    """Host wrapper for join queries: routes each side's batches to the
+    side-specific jitted step, passing table snapshots for table sides."""
+
+    def __init__(self, planned, app: "SiddhiAppRuntime"):
+        self.planned = planned
+        self.app = app
+        self.state = jax.tree.map(
+            lambda x: jax.numpy.array(x, copy=True), planned.init_state())
+        self.callbacks: List[Callable] = []
+        self.batch_callbacks: List[Callable] = []
+        self.next_wakeup: int = _NO_WAKEUP_INT
+        self.table_op = None
+
+    @property
+    def name(self):
+        return self.planned.name
+
+    def _other_table(self, is_left):
+        p = self.planned
+        other = p.right if is_left else p.left
+        if other.is_table:
+            t = self.app.tables[other.stream_id]
+            return (t.cols, t.ts, t.valid)
+        return (jax.numpy.zeros((1,)),) * 3
+
+    def process_staged(self, is_left: bool, staged: ev.StagedBatch,
+                       now: int) -> None:
+        p = self.planned
+        side = p.left if is_left else p.right
+        step = p.step_left if is_left else p.step_right
+        if step is None:
+            return
+        batch = staged.to_device(side.schema)
+        self.state, out, wake = step(
+            self.state, batch.ts, batch.kind, batch.valid, batch.cols,
+            self._other_table(is_left),
+            jax.numpy.asarray(now, jax.numpy.int64))
+        _emit_output(self, out, now)
+        if p.needs_timer:
+            w = int(wake)
+            self.next_wakeup = w
+            if w < _NO_WAKEUP_INT:
+                self.app._scheduler.notify_at(w, self)
+
+    def on_timer(self, now: int) -> None:
+        p = self.planned
+        for is_left, side in ((True, p.left), (False, p.right)):
+            if side.window is not None and side.window.needs_timer:
+                staged = ev.pack_np(side.schema, [], capacity=8)
+                staged.ts[0] = now
+                staged.kind[0] = ev.TIMER
+                staged.valid[0] = True
+                self.process_staged(is_left, staged, now)
 
 
 class StreamJunction:
@@ -482,6 +570,13 @@ class SiddhiAppRuntime:
         for sid, sdef in app.stream_definition_map.items():
             self._define_stream_runtime(sdef)
 
+        # tables (reference: CORE/table/InMemoryTable.java)
+        from .table import TableRuntime
+        self.tables: Dict[str, TableRuntime] = {}
+        for tid, tdef in app.table_definition_map.items():
+            schema = ev.Schema(tdef, self.interner)
+            self.tables[tid] = TableRuntime(tdef, schema)
+
         # plan queries
         self.query_runtimes: Dict[str, QueryRuntime] = {}
         qi = 0
@@ -508,7 +603,10 @@ class SiddhiAppRuntime:
         return f"query{i + 1}"
 
     def _add_query(self, q: Query, name: str):
-        from ..query_api.query import StateInputStream
+        from ..query_api.query import JoinInputStream, StateInputStream
+        if isinstance(q.input_stream, JoinInputStream):
+            self._add_join_query(q, name)
+            return
         if isinstance(q.input_stream, StateInputStream):
             from .pattern_planner import plan_pattern_query
             planned = plan_pattern_query(q, name, self.schemas, self.interner)
@@ -524,7 +622,7 @@ class SiddhiAppRuntime:
                         self._qr.process_staged(self._sid, staged, now)
 
                 self.junctions[sid].subscribe_query(_Sub(runtime, sid))
-            self._define_output_for(planned, name)
+            self._wire_output(runtime, q, planned, name)
             return
         planned = plan_single_query(
             q, name, self.app.stream_definition_map, self.schemas,
@@ -533,7 +631,84 @@ class SiddhiAppRuntime:
         runtime.async_emit = self._async_enabled(q)
         self.query_runtimes[name] = runtime
         self.junctions[planned.input_stream_id].subscribe_query(runtime)
+        self._wire_output(runtime, q, planned, name)
+
+    def _wire_output(self, runtime, q: Query, planned, name: str):
+        """Route query output: stream (define if missing), table op, or
+        window insert."""
+        from ..query_api.query import (
+            DeleteStream,
+            UpdateOrInsertStream,
+            UpdateStream,
+        )
+        runtime.table_op = None
+        tgt = planned.output_target
+        out_stream = q.output_stream
+        if tgt and tgt in self.tables:
+            table = self.tables[tgt]
+            out_key = "__out__"
+            scope_schema = planned.out_schema
+            if isinstance(out_stream, (DeleteStream, UpdateStream,
+                                       UpdateOrInsertStream)):
+                cond_expr = (out_stream.on_delete_expression
+                             if isinstance(out_stream, DeleteStream)
+                             else out_stream.on_update_expression)
+                from .executor import Scope, compile_expression
+                scope = Scope()
+                scope.interner = self.interner
+                scope.add_source(out_key, scope_schema)
+                # table attrs must be qualified (T.attr); unqualified names
+                # resolve to the query output side, as in the reference
+                scope.add_source(tgt, table.schema, default=False)
+                cond = compile_expression(cond_expr, scope)
+                set_fns = []
+                us = getattr(out_stream, "update_set", None)
+                if us is None and not isinstance(out_stream, DeleteStream):
+                    # default set: overwrite all same-named columns
+                    from ..query_api.query import UpdateSet, Variable
+                    for n in table.schema.names:
+                        if n in scope_schema.names:
+                            from ..query_api.expression import Variable as V
+                            e = compile_expression(V(n, stream_id=out_key),
+                                                   scope)
+                            set_fns.append((table.schema.position(n), e.fn))
+                elif us is not None:
+                    for sa in us.set_attribute_list:
+                        pos = table.schema.position(
+                            sa.table_variable.attribute_name)
+                        e = compile_expression(sa.value_expression, scope)
+                        set_fns.append((pos, e.fn))
+                op = ("delete" if isinstance(out_stream, DeleteStream) else
+                      "upsert" if isinstance(out_stream, UpdateOrInsertStream)
+                      else "update")
+                runtime.table_op = (op, table, cond, set_fns, out_key)
+            else:
+                if len(table.schema.names) != len(planned.out_schema.names):
+                    raise CompileError(
+                        f"query {name!r} output arity does not match table "
+                        f"{tgt!r}")
+                runtime.table_op = ("insert", table, None, [], out_key)
+            return
         self._define_output_for(planned, name)
+
+    def _add_join_query(self, q: Query, name: str):
+        from .join import plan_join_query
+        planned = plan_join_query(q, name, self.schemas, self.tables,
+                                  self.interner)
+        runtime = JoinQueryRuntime(planned, self)
+        runtime.async_emit = self._async_enabled(q)
+        self.query_runtimes[name] = runtime
+        for side, is_left in ((planned.left, True), (planned.right, False)):
+            if not side.is_table:
+                class _JSub:
+                    def __init__(self, qr, left):
+                        self._qr, self._left = qr, left
+
+                    def process_staged(self, staged, now):
+                        self._qr.process_staged(self._left, staged, now)
+                self.junctions[side.stream_id].subscribe_query(
+                    _JSub(runtime, is_left))
+        self._wire_output(runtime, q, planned, name)
 
     def _async_enabled(self, q) -> bool:
         if self.app.get_annotation("async") is not None:

@@ -1,0 +1,222 @@
+"""In-memory tables: device-resident columnar event stores.
+
+Reference behavior (what): CORE/table/InMemoryTable.java:58 +
+IndexEventHolder (CORE/table/holder/IndexEventHolder.java:60 — primary key +
+index maps), operators under CORE/util/collection/* (find/contains/update/
+delete/update-or-insert with compiled conditions), and EventHolderPasser
+(@PrimaryKey/@Index).
+
+TPU-native design (how): a table is a fixed-capacity struct-of-arrays block
+on device.  @PrimaryKey rows map to dense slots through the host
+SlotAllocator (O(new keys) python, vectorized lookups), so keyed
+insert/update/upsert are row scatters; conditions compile to masked [B, C]
+broadcasts (stream rows x table rows) evaluated on device — the reference's
+per-event TreeMap probes become one fused comparison kernel.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..query_api.definition import TableDefinition
+from ..query_api.expression import Expression, Variable
+from ..query_api.query import UpdateSet
+from . import event as ev
+from .executor import CompileError, CompiledExpr, Scope, compile_expression
+from .keyslots import SlotAllocator
+
+
+class TableRuntime:
+    def __init__(self, definition: TableDefinition, schema: ev.Schema,
+                 capacity: int = 4096):
+        self.definition = definition
+        self.schema = schema
+        cap_ann = definition.get_annotation("capacity")
+        if cap_ann:
+            capacity = int(cap_ann.element("rows", capacity))
+        self.capacity = capacity
+        self._lock = threading.RLock()
+
+        pk = definition.get_annotation("PrimaryKey")
+        self.pkey_positions: Optional[List[int]] = None
+        self.allocator: Optional[SlotAllocator] = None
+        if pk is not None:
+            names = [v for v in pk.elements.values()]
+            self.pkey_positions = [schema.position(n) for n in names]
+            self.allocator = SlotAllocator(capacity,
+                                           name=f"table:{definition.id}")
+        # device state
+        self.cols = tuple(
+            jnp.full((capacity,), ev.default_value(t), dtype=d)
+            for t, d in zip(schema.types, schema.dtypes))
+        self.ts = jnp.zeros((capacity,), jnp.int64)
+        self.valid = jnp.zeros((capacity,), jnp.bool_)
+        self._append_ptr = 0  # non-keyed append position (host-tracked)
+        self._free_rows: List[int] = []
+
+        self._jit_write = jax.jit(self._write_impl, donate_argnums=(0, 1, 2))
+        self._jit_masked_delete = jax.jit(self._masked_delete_impl,
+                                          donate_argnums=(0,))
+        self._jit_masked_update = None  # built per update-set signature
+
+    # -- row-slot resolution ---------------------------------------------------
+    def _slots_for_batch(self, staged_cols: Sequence[np.ndarray],
+                         valid: np.ndarray, insert: bool) -> np.ndarray:
+        """Target row per batch event (primary-key tables)."""
+        key_cols = [staged_cols[i] for i in self.pkey_positions]
+        if insert:
+            return self.allocator.slots_for(key_cols, valid)
+        # lookup-only: unknown keys -> -1
+        slots = []
+        snapshot = self.allocator
+        out = snapshot.slots_for(key_cols, valid)  # may allocate; acceptable
+        return out
+
+    def _append_slots(self, n: int) -> np.ndarray:
+        out = np.empty((n,), np.int32)
+        for i in range(n):
+            if self._free_rows:
+                out[i] = self._free_rows.pop()
+            else:
+                if self._append_ptr >= self.capacity:
+                    raise RuntimeError(
+                        f"table {self.definition.id!r} capacity "
+                        f"{self.capacity} exhausted; use "
+                        f"@capacity(rows='...')")
+                out[i] = self._append_ptr
+                self._append_ptr += 1
+        return out
+
+    # -- device ops ------------------------------------------------------------
+    @staticmethod
+    def _write_impl(cols, ts, valid, new_cols, new_ts, slots, row_valid):
+        tgt = jnp.where(row_valid, slots, jnp.iinfo(jnp.int32).max)
+        cols = tuple(c.at[tgt].set(nc, mode="drop")
+                     for c, nc in zip(cols, new_cols))
+        ts = ts.at[tgt].set(new_ts, mode="drop")
+        valid = valid.at[tgt].set(True, mode="drop")
+        return cols, ts, valid
+
+    @staticmethod
+    def _masked_delete_impl(valid, kill):
+        return jnp.logical_and(valid, jnp.logical_not(kill))
+
+    # -- public API ------------------------------------------------------------
+    def insert(self, batch: ev.EventBatch, staged: ev.StagedBatch) -> None:
+        """Insert CURRENT rows (keyed: upsert on primary key; else append)."""
+        with self._lock:
+            n = int(np.sum(staged.valid))
+            if n == 0:
+                return
+            if self.pkey_positions is not None:
+                slots = self._slots_for_batch(staged.cols, staged.valid, True)
+            else:
+                slots = np.full((staged.valid.shape[0],), -1, np.int32)
+                slots[staged.valid] = self._append_slots(n)
+            self.cols, self.ts, self.valid = self._jit_write(
+                self.cols, self.ts, self.valid, batch.cols, batch.ts,
+                jnp.asarray(slots), jnp.asarray(staged.valid))
+
+    def compile_condition(self, cond: Expression, other_schema: ev.Schema,
+                          other_key: str, interner) -> CompiledExpr:
+        """Compile `on` condition over (stream rows [B,1], table rows [1,C])."""
+        scope = Scope()
+        scope.interner = interner
+        scope.add_source(self.definition.id, self.schema)
+        scope.add_source(other_key, other_schema)
+        return compile_expression(cond, scope)
+
+    def match_matrix(self, compiled: CompiledExpr, other_key: str,
+                     batch: ev.EventBatch):
+        """[B, C] boolean matches (pure; caller jits)."""
+        env = {
+            self.definition.id: tuple(c[None, :] for c in self.cols),
+            other_key: tuple(c[:, None] for c in batch.cols),
+            "__ts__": batch.ts[:, None],
+        }
+        m = compiled.fn(env)
+        m = jnp.logical_and(m, self.valid[None, :])
+        m = jnp.logical_and(m, batch.valid[:, None])
+        return m
+
+    def delete_where(self, compiled: CompiledExpr, other_key: str,
+                     batch: ev.EventBatch, staged=None) -> None:
+        with self._lock:
+            m = self.match_matrix(compiled, other_key, batch)
+            kill = jnp.any(m, axis=0)
+            self.valid = self._jit_masked_delete(self.valid, kill)
+            self._reclaim(kill)
+
+    def _reclaim(self, kill) -> None:
+        if self.pkey_positions is not None:
+            killed = np.nonzero(np.asarray(kill))[0]
+            if killed.size:
+                self.allocator.purge(killed.tolist())
+        else:
+            killed = np.nonzero(np.asarray(kill))[0]
+            self._free_rows.extend(int(x) for x in killed)
+
+    def update_where(self, compiled: CompiledExpr, other_key: str,
+                     batch: ev.EventBatch,
+                     set_fns: List[Tuple[int, Callable]],
+                     upsert: bool = False,
+                     staged: Optional[ev.StagedBatch] = None,
+                     insert_map: Optional[List[int]] = None) -> None:
+        """set_fns: [(table_col_pos, fn(env)->[B] value)], applied from the
+        LAST matching stream row per table row (batch order semantics)."""
+        with self._lock:
+            m = self.match_matrix(compiled, other_key, batch)   # [B, C]
+            hit = jnp.any(m, axis=0)                            # [C]
+            # last matching stream row per table row
+            B = m.shape[0]
+            rowid = jnp.arange(B)[:, None]
+            src = jnp.max(jnp.where(m, rowid, -1), axis=0)      # [C]
+            src_c = jnp.clip(src, 0, B - 1)
+            env = {
+                other_key: tuple(c[src_c] for c in batch.cols),
+                self.definition.id: self.cols,
+                "__ts__": batch.ts[src_c],
+            }
+            new_cols = list(self.cols)
+            for pos, fn in set_fns:
+                val = fn(env)
+                new_cols[pos] = jnp.where(hit, val.astype(self.cols[pos].dtype),
+                                          self.cols[pos])
+            self.cols = tuple(new_cols)
+            if upsert and staged is not None:
+                matched_any = np.asarray(jnp.any(m, axis=1))    # [B]
+                miss = staged.valid & ~matched_any
+                if miss.any():
+                    sub_staged = ev.StagedBatch(
+                        staged.ts, staged.kind, miss,
+                        [staged.cols[i] for i in insert_map]
+                        if insert_map else staged.cols, int(miss.sum()))
+                    sub_batch = ev.EventBatch(
+                        batch.ts, batch.kind, jnp.asarray(miss),
+                        tuple(batch.cols[i] for i in insert_map)
+                        if insert_map else batch.cols)
+                    self.insert(sub_batch, sub_staged)
+
+    def contains_fn(self, compiled: CompiledExpr, other_key: str):
+        """Probe for the `in` operator: fn(batch)->[B] bool."""
+        def probe(batch: ev.EventBatch):
+            m = self.match_matrix(compiled, other_key, batch)
+            return jnp.any(m, axis=1)
+        return probe
+
+    def snapshot_rows(self) -> List[ev.Event]:
+        with self._lock:
+            batch = ev.EventBatch(self.ts, jnp.zeros_like(self.ts,
+                                                          dtype=jnp.int32),
+                                  self.valid, self.cols)
+            return [e for _, e in ev.unpack(self.schema, batch)]
+
+    # find for on-demand queries / joins
+    def all_rows_batch(self) -> ev.EventBatch:
+        return ev.EventBatch(self.ts,
+                             jnp.zeros(self.ts.shape, jnp.int32),
+                             self.valid, self.cols)

@@ -1,0 +1,192 @@
+"""Table + join tests (modeled on TEST/query/table/* and
+TEST/query/join/JoinTestCase behavioral cases)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def collect(rt, name):
+    got = {"in": [], "out": []}
+    def cb(ts, i, o):
+        if i:
+            got["in"].extend(i)
+        if o:
+            got["out"].extend(o)
+    rt.add_callback(name, cb)
+    return got
+
+
+class TestTables:
+    def test_insert_and_on_demand_like_query(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream StockStream (symbol string, price float, volume long);
+            define table StockTable (symbol string, price float, volume long);
+            from StockStream select * insert into StockTable;
+        """)
+        rt.start()
+        h = rt.get_input_handler("StockStream")
+        h.send(["WSO2", 55.6, 100])
+        h.send(["IBM", 75.6, 10])
+        rows = rt.tables["StockTable"].snapshot_rows()
+        assert sorted(e.data[0] for e in rows) == ["IBM", "WSO2"]
+
+    def test_primary_key_upsert_semantics(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream S (symbol string, price float);
+            @PrimaryKey('symbol')
+            define table T (symbol string, price float);
+            from S select * insert into T;
+        """)
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send(["A", 1.0])
+        h.send(["B", 2.0])
+        h.send(["A", 3.0])   # overwrites A's row
+        rows = {e.data[0]: e.data[1] for e in
+                rt.tables["T"].snapshot_rows()}
+        assert rows == {"A": pytest.approx(3.0), "B": pytest.approx(2.0)}
+
+    def test_delete(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream S (symbol string, price float);
+            define stream DeleteStream (symbol string);
+            define table T (symbol string, price float);
+            from S select * insert into T;
+            from DeleteStream delete T on T.symbol == symbol;
+        """)
+        rt.start()
+        rt.get_input_handler("S").send([["A", 1.0], ["B", 2.0], ["C", 3.0]])
+        rt.get_input_handler("DeleteStream").send(["B"])
+        rows = sorted(e.data[0] for e in rt.tables["T"].snapshot_rows())
+        assert rows == ["A", "C"]
+
+    def test_update(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream S (symbol string, price float);
+            define stream U (symbol string, newPrice float);
+            define table T (symbol string, price float);
+            from S select * insert into T;
+            from U select symbol, newPrice
+            update T set T.price = newPrice on T.symbol == symbol;
+        """)
+        rt.start()
+        rt.get_input_handler("S").send([["A", 1.0], ["B", 2.0]])
+        rt.get_input_handler("U").send(["A", 9.5])
+        rows = {e.data[0]: e.data[1] for e in rt.tables["T"].snapshot_rows()}
+        assert rows == {"A": pytest.approx(9.5), "B": pytest.approx(2.0)}
+
+    def test_update_or_insert(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream U (symbol string, price float);
+            define table T (symbol string, price float);
+            from U update or insert into T
+              set T.price = price on T.symbol == symbol;
+        """)
+        rt.start()
+        h = rt.get_input_handler("U")
+        h.send(["A", 1.0])     # miss -> insert
+        h.send(["A", 2.0])     # hit -> update
+        h.send(["B", 7.0])     # miss -> insert
+        rows = {e.data[0]: e.data[1] for e in rt.tables["T"].snapshot_rows()}
+        assert rows == {"A": pytest.approx(2.0), "B": pytest.approx(7.0)}
+
+    def test_in_operator(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream S (symbol string, volume int);
+            define stream TableFeed (symbol string);
+            define table Allowed (symbol string);
+            from TableFeed select symbol insert into Allowed;
+            @info(name='query1')
+            from S[symbol in Allowed] select symbol, volume insert into Out;
+        """)
+        got = collect(rt, "query1")
+        rt.start()
+        rt.get_input_handler("TableFeed").send([["IBM"], ["WSO2"]])
+        h = rt.get_input_handler("S")
+        h.send(["IBM", 10])
+        h.send(["GOOG", 20])
+        h.send(["WSO2", 30])
+        assert [e.data for e in got["in"]] == [["IBM", 10], ["WSO2", 30]]
+
+
+class TestJoins:
+    def test_windowed_join(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream A (symbol string, price float);
+            define stream B (symbol string, volume int);
+            @info(name='query1')
+            from A#window.length(10) as l
+              join B#window.length(10) as r
+              on l.symbol == r.symbol
+            select l.symbol as symbol, l.price as price, r.volume as volume
+            insert into Out;
+        """)
+        got = collect(rt, "query1")
+        rt.start()
+        ha, hb = rt.get_input_handler("A"), rt.get_input_handler("B")
+        ha.send(["IBM", 75.0])
+        ha.send(["WSO2", 55.0])
+        hb.send(["IBM", 100])     # matches IBM in A's window
+        hb.send(["GOOG", 5])      # no match
+        ha.send(["IBM", 76.0])    # matches IBM in B's window
+        datas = [e.data for e in got["in"]]
+        assert ["IBM", pytest.approx(75.0), 100] in datas
+        assert ["IBM", pytest.approx(76.0), 100] in datas
+        assert len(datas) == 2
+
+    def test_left_outer_join(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream A (symbol string, price float);
+            define stream B (symbol string, volume int);
+            @info(name='query1')
+            from A#window.length(10) as l
+              left outer join B#window.length(10) as r
+              on l.symbol == r.symbol
+            select l.symbol as symbol, r.symbol as rsym
+            insert into Out;
+        """)
+        got = collect(rt, "query1")
+        rt.start()
+        rt.get_input_handler("A").send(["IBM", 75.0])   # no match -> nulls
+        rt.get_input_handler("B").send(["IBM", 10])
+        rt.get_input_handler("A").send(["IBM", 76.0])   # match
+        datas = [e.data for e in got["in"]]
+        assert ["IBM", None] in datas
+        assert ["IBM", "IBM"] in datas
+
+    def test_stream_table_join(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream CheckStream (symbol string);
+            define stream FeedStream (symbol string, price float);
+            define table StockTable (symbol string, price float);
+            from FeedStream select * insert into StockTable;
+            @info(name='query1')
+            from CheckStream#window.length(1) as c
+              join StockTable
+              on c.symbol == StockTable.symbol
+            select c.symbol as symbol, StockTable.price as price
+            insert into Out;
+        """)
+        got = collect(rt, "query1")
+        rt.start()
+        rt.get_input_handler("FeedStream").send([["IBM", 11.0],
+                                                 ["WSO2", 22.0]])
+        rt.get_input_handler("CheckStream").send(["WSO2"])
+        assert [e.data for e in got["in"]] == [["WSO2", pytest.approx(22.0)]]
+
+    def test_unidirectional_join(self, manager):
+        rt = manager.create_siddhi_app_runtime("""
+            define stream A (symbol string);
+            define stream B (symbol string);
+            @info(name='query1')
+            from A#window.length(5) unidirectional
+              join B#window.length(5)
+              on A.symbol == B.symbol
+            select A.symbol as s insert into Out;
+        """)
+        got = collect(rt, "query1")
+        rt.start()
+        rt.get_input_handler("B").send(["X"])     # must NOT trigger
+        assert got["in"] == []
+        rt.get_input_handler("A").send(["X"])     # triggers, matches B's X
+        assert [e.data for e in got["in"]] == [["X"]]
