@@ -72,6 +72,7 @@ def plan_single_query(
     group_slots: int = 4096,
     window_capacity_hint: int = 2048,
     partition_positions: Optional[List[int]] = None,
+    named_window_input: bool = False,
 ) -> PlannedQuery:
     ist = query.input_stream
     assert isinstance(ist, SingleInputStream)
@@ -101,7 +102,12 @@ def plan_single_query(
 
     # ---- handlers: filters before/after the (single) window ---------------
     pre_filters, post_filters = [], []
-    window_proc: WindowProcessor = NoWindow(in_schema, [], batch_capacity)
+    if named_window_input:
+        from .window import PassAllWindow
+        window_proc: WindowProcessor = PassAllWindow(
+            in_schema, [], batch_capacity)
+    else:
+        window_proc = NoWindow(in_schema, [], batch_capacity)
     seen_window = False
     for h in ist.stream_handlers:
         if isinstance(h, Filter):
@@ -110,6 +116,9 @@ def plan_single_query(
                 raise CompileError("filter expression must be boolean")
             (post_filters if seen_window else pre_filters).append(c)
         elif isinstance(h, Window):
+            if named_window_input:
+                raise CompileError(
+                    "cannot apply a window to a named-window input")
             if seen_window:
                 raise CompileError("only one window per input stream")
             seen_window = True
@@ -164,6 +173,10 @@ def plan_single_query(
             env["__in__:" + dep] = probe
         keep = valid
         is_current = kind == ev.CURRENT
+        if named_window_input:
+            # expired rows must pass the same filters so signed aggregation
+            # stays balanced (reference: filter sits after the shared window)
+            is_current = jnp.logical_or(is_current, kind == ev.EXPIRED)
         for f in pre_filters:
             m = f.fn(env)
             keep = jnp.logical_and(keep,

@@ -411,6 +411,99 @@ class JoinQueryRuntime:
                 self.process_staged(is_left, staged, now)
 
 
+class NamedWindowRuntime:
+    """A shared window instance (reference: CORE/window/Window.java:65 —
+    `define window W (...) <window>(...) output <type> events`).  Queries
+    insert into it; reader queries subscribe to its CURRENT/EXPIRED output.
+
+    TPU design: one jitted step wrapping the window processor; output rows are
+    staged once to numpy (kinds preserved) and fanned out to subscribers."""
+
+    def __init__(self, wdef, schema: ev.Schema, app: "SiddhiAppRuntime"):
+        import jax.numpy as jnp
+        from .window import Rows, create_window
+
+        self.definition = wdef
+        self.schema = schema
+        self.app = app
+        w = wdef.window
+        if w is None:
+            raise CompileError(
+                f"window definition {wdef.id!r} needs a window function")
+        self.wproc = create_window(
+            (w.namespace + ":" if w.namespace else "") + w.name,
+            schema, w.parameters, batch_capacity=512)
+        self.needs_timer = self.wproc.needs_timer
+        self.output_event_type = wdef.output_event_type or "ALL_EVENTS"
+        self.subscribers: List = []      # QueryRuntime-likes (process_staged)
+        self.stream_callbacks: List[Callable] = []
+        self.next_wakeup: int = _NO_WAKEUP_INT
+        wproc = self.wproc
+
+        def step(state, ts, kind, valid, cols, now):
+            rows = Rows(ts=ts, kind=kind, valid=valid,
+                        seq=jnp.zeros_like(ts),
+                        gslot=jnp.full(ts.shape, -1, jnp.int32), cols=cols)
+            state, wout = wproc.process(state, rows, now)
+            o = wout.rows
+            return state, (o.ts, o.kind, o.valid, o.cols), wout.next_wakeup
+
+        self._step = jax.jit(step, donate_argnums=(0,))
+        self.state = jax.tree.map(
+            lambda x: jax.numpy.array(x, copy=True), wproc.init_state())
+
+    @property
+    def name(self):
+        return self.definition.id
+
+    def process_staged(self, staged: ev.StagedBatch, now: int) -> None:
+        batch = staged.to_device(self.schema)
+        self.state, out, wake = self._step(
+            self.state, batch.ts, batch.kind, batch.valid, batch.cols,
+            jax.numpy.asarray(now, jax.numpy.int64))
+        self._fanout(out, now)
+        if self.needs_timer:
+            w = int(wake)
+            self.next_wakeup = w
+            if w < _NO_WAKEUP_INT:
+                self.app._scheduler.notify_at(w, self)
+
+    def on_timer(self, now: int) -> None:
+        staged = ev.pack_np(self.schema, [], capacity=8)
+        staged.ts[0] = now
+        staged.kind[0] = ev.TIMER
+        staged.valid[0] = True
+        self.process_staged(staged, now)
+
+    def _fanout(self, out, now: int) -> None:
+        ots, okind, ovalid, ocols = out
+        ovalid_np = np.asarray(ovalid)
+        if not ovalid_np.any():
+            return
+        okind_np = np.asarray(okind)
+        sel = self.output_event_type
+        if sel == "CURRENT_EVENTS":
+            keep = okind_np == ev.CURRENT
+        elif sel == "EXPIRED_EVENTS":
+            keep = okind_np == ev.EXPIRED
+        else:
+            keep = (okind_np == ev.CURRENT) | (okind_np == ev.EXPIRED)
+        ovalid_np = ovalid_np & keep
+        if not ovalid_np.any():
+            return
+        staged = ev.StagedBatch(
+            np.asarray(ots), okind_np, ovalid_np,
+            [np.asarray(c) for c in ocols], int(ovalid_np.sum()))
+        for cb in self.stream_callbacks:
+            batch = ev.EventBatch(staged.ts, staged.kind, ovalid_np,
+                                  tuple(staged.cols))
+            pairs = ev.unpack(self.schema, batch,
+                              want_kinds=(ev.CURRENT, ev.EXPIRED))
+            cb([e for _, e in pairs])
+        for q in self.subscribers:
+            q.process_staged(staged, now)
+
+
 class StreamJunction:
     """Per-stream pub/sub hub (reference: CORE/stream/StreamJunction.java:61).
     Packs each published chunk to numpy once; subscribers share the staging."""
@@ -577,6 +670,13 @@ class SiddhiAppRuntime:
             schema = ev.Schema(tdef, self.interner)
             self.tables[tid] = TableRuntime(tdef, schema)
 
+        # named windows (reference: CORE/window/Window.java:65)
+        self.named_windows: Dict[str, NamedWindowRuntime] = {}
+        for wid, wdef in getattr(app, "window_definition_map", {}).items():
+            schema = ev.Schema(wdef, self.interner)
+            self.schemas[wid] = schema
+            self.named_windows[wid] = NamedWindowRuntime(wdef, schema, self)
+
         # plan queries
         self.query_runtimes: Dict[str, QueryRuntime] = {}
         qi = 0
@@ -624,13 +724,18 @@ class SiddhiAppRuntime:
                 self.junctions[sid].subscribe_query(_Sub(runtime, sid))
             self._wire_output(runtime, q, planned, name)
             return
+        in_sid = q.input_stream.unique_stream_id
+        from_window = in_sid in self.named_windows
         planned = plan_single_query(
             q, name, self.app.stream_definition_map, self.schemas,
-            self.interner)
+            self.interner, named_window_input=from_window)
         runtime = QueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
         self.query_runtimes[name] = runtime
-        self.junctions[planned.input_stream_id].subscribe_query(runtime)
+        if from_window:
+            self.named_windows[in_sid].subscribers.append(runtime)
+        else:
+            self.junctions[planned.input_stream_id].subscribe_query(runtime)
         self._wire_output(runtime, q, planned, name)
 
     def _wire_output(self, runtime, q: Query, planned, name: str):
@@ -811,6 +916,13 @@ class SiddhiAppRuntime:
     def _define_output_for(self, planned, name: str):
         # define the output stream if missing
         tgt = planned.output_target
+        if tgt and tgt in self.named_windows:
+            nw = self.named_windows[tgt]
+            if len(nw.schema.names) != len(planned.out_schema.names):
+                raise CompileError(
+                    f"query {name!r} output arity does not match window "
+                    f"{tgt!r}")
+            return
         if tgt and tgt not in self.junctions:
             sdef = StreamDefinition(tgt)
             for a in planned.out_schema.definition.attribute_list:
@@ -859,7 +971,10 @@ class SiddhiAppRuntime:
 
     def add_callback(self, name: str, cb) -> None:
         """Stream name -> StreamCallback; query name -> QueryCallback."""
-        if name in self.junctions and name not in self.query_runtimes:
+        if name in self.named_windows:
+            self.named_windows[name].stream_callbacks.append(
+                _wrap_stream_callback(cb))
+        elif name in self.junctions and name not in self.query_runtimes:
             self.junctions[name].subscribe_callback(_wrap_stream_callback(cb))
         elif name in self.query_runtimes:
             self.query_runtimes[name].callbacks.append(_wrap_query_callback(cb))
@@ -898,6 +1013,17 @@ class SiddhiAppRuntime:
                 q.process_staged(staged, now)
 
     def _route(self, stream_id: str, events: List[ev.Event]) -> None:
+        if stream_id in self.named_windows:
+            nw = self.named_windows[stream_id]
+            if self.playback and events:
+                self._playback_time = max(self._playback_time,
+                                          max(e.timestamp for e in events))
+            now = self.timestamp_millis()
+            with self._lock:
+                if self.playback:
+                    self._scheduler.drain_playback(now)
+                nw.process_staged(ev.pack_np(nw.schema, events), now)
+            return
         junction = self.junctions.get(stream_id)
         if junction is None:
             raise KeyError(f"undefined stream {stream_id!r}")
@@ -926,8 +1052,12 @@ class SiddhiAppRuntime:
                     "state": host_state,
                     "slots": alloc.snapshot() if alloc else None,
                 }
+            windows = {
+                wid: jax.tree.map(lambda x: np.asarray(x), nw.state)
+                for wid, nw in self.named_windows.items()}
             payload = {
                 "states": states,
+                "windows": windows,
                 "interner": list(self.interner._to_str),
             }
             return pickle.dumps(payload)
@@ -945,6 +1075,11 @@ class SiddhiAppRuntime:
                     lambda x: jax.numpy.asarray(x), data["state"])
                 if data["slots"] is not None and qr.planned.slot_allocator:
                     qr.planned.slot_allocator.restore(data["slots"])
+            for wid, wstate in payload.get("windows", {}).items():
+                nw = self.named_windows.get(wid)
+                if nw is not None:
+                    nw.state = jax.tree.map(
+                        lambda x: jax.numpy.asarray(x), wstate)
 
 
 class SiddhiManager:

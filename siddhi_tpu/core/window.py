@@ -177,6 +177,35 @@ class NoWindow(WindowProcessor):
                                   jnp.asarray(NO_WAKEUP, jnp.int64))
 
 
+class PassAllWindow(WindowProcessor):
+    """Pass-through for queries reading a named window (reference:
+    CORE/window/Window.java:65 — the window publishes CURRENT+EXPIRED events
+    to subscribing queries, which must not re-window them).  Both kinds are
+    forwarded with fresh sequence numbers so the selector's signed
+    aggregation (add on CURRENT, subtract on EXPIRED) sees them in order."""
+
+    name = "(named-window input)"
+
+    @property
+    def out_capacity(self):
+        return self.batch_capacity
+
+    def init_state(self):
+        return jnp.asarray(0, jnp.int64)  # seq counter
+
+    def process(self, state, rows: Rows, now):
+        seq0 = state
+        is_data = jnp.logical_and(
+            rows.valid,
+            jnp.logical_or(rows.kind == ev.CURRENT, rows.kind == ev.EXPIRED))
+        ord_ = jnp.cumsum(is_data.astype(jnp.int64)) - 1
+        seq = jnp.where(is_data, seq0 + ord_, BIG_SEQ)
+        out = Rows(rows.ts, rows.kind, is_data, seq, rows.gslot, rows.cols)
+        nseq = seq0 + jnp.sum(is_data.astype(jnp.int64))
+        return nseq, WindowOutput(sort_rows(out), None,
+                                  jnp.asarray(NO_WAKEUP, jnp.int64))
+
+
 class LengthWindow(WindowProcessor):
     """Sliding length window (reference: LengthWindowProcessor).
 
