@@ -411,6 +411,39 @@ class JoinQueryRuntime:
                 self.process_staged(is_left, staged, now)
 
 
+class TriggerRuntime:
+    """Event generator into a stream named after the trigger (reference:
+    CORE/trigger/{PeriodicTrigger,CronTrigger,StartTrigger}.java).  Rides the
+    app scheduler: each firing publishes one event `[triggered_time]` and
+    reschedules itself."""
+
+    def __init__(self, tdef, app: "SiddhiAppRuntime"):
+        self.definition = tdef
+        self.app = app
+        self.stream_id = tdef.id
+        self._cron = None
+        if tdef.at is not None and tdef.at.lower() != "start":
+            from ..utils.cron import CronExpression
+            self._cron = CronExpression(tdef.at)
+
+    def start(self, now: int) -> None:
+        d = self.definition
+        if d.at is not None and d.at.lower() == "start":
+            self.app._scheduler.notify_at(now, self)
+        elif d.at_every is not None:
+            self.app._scheduler.notify_at(now + d.at_every, self)
+        elif self._cron is not None:
+            self.app._scheduler.notify_at(self._cron.next_fire(now), self)
+
+    def on_timer(self, now: int) -> None:
+        self.app._route(self.stream_id, [ev.Event(now, [now])])
+        d = self.definition
+        if d.at_every is not None:
+            self.app._scheduler.notify_at(now + d.at_every, self)
+        elif self._cron is not None:
+            self.app._scheduler.notify_at(self._cron.next_fire(now), self)
+
+
 class NamedWindowRuntime:
     """A shared window instance (reference: CORE/window/Window.java:65 —
     `define window W (...) <window>(...) output <type> events`).  Queries
@@ -677,6 +710,17 @@ class SiddhiAppRuntime:
             self.schemas[wid] = schema
             self.named_windows[wid] = NamedWindowRuntime(wdef, schema, self)
 
+        # triggers define a stream `<id> (triggered_time long)` (reference:
+        # QAPI/definition/TriggerDefinition -> DefinitionParserHelper)
+        self.triggers: Dict[str, TriggerRuntime] = {}
+        for tid, tdef in app.trigger_definition_map.items():
+            if tid not in self.schemas:
+                sdef = StreamDefinition(tid).attribute(
+                    "triggered_time", "LONG")
+                app.stream_definition_map[tid] = sdef
+                self._define_stream_runtime(sdef)
+            self.triggers[tid] = TriggerRuntime(tdef, self)
+
         # plan queries
         self.query_runtimes: Dict[str, QueryRuntime] = {}
         qi = 0
@@ -942,6 +986,9 @@ class SiddhiAppRuntime:
         if not self._started:
             self._scheduler.start()
             self._started = True
+            now = self.timestamp_millis()
+            for tr in self.triggers.values():
+                tr.start(now)
 
     def shutdown(self) -> None:
         if self._started:

@@ -1,0 +1,83 @@
+"""Triggers: start / periodic / cron event generators.
+
+Reference behavior: CORE/trigger/{StartTrigger,PeriodicTrigger,CronTrigger}
+and TEST/trigger/TriggerTestCase — a trigger defines a stream
+`<name> (triggered_time long)` and injects events on its schedule.
+"""
+import time
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.utils.cron import CronExpression
+
+
+def _wait_for(pred, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_start_trigger():
+    ql = """
+    define trigger Init at 'start';
+    @info(name='q')
+    from Init select triggered_time insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    assert _wait_for(lambda: len(got) >= 1)
+    assert isinstance(got[0].data[0], int)
+    manager.shutdown()
+
+
+def test_periodic_trigger():
+    ql = """
+    define trigger Tick at every 100 milliseconds;
+    @info(name='q')
+    from Tick select triggered_time insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    assert _wait_for(lambda: len(got) >= 3)
+    manager.shutdown()
+
+
+def test_cron_next_fire():
+    # every 5 seconds
+    c = CronExpression("*/5 * * * * ?")
+    base = 1_700_000_000_000  # some epoch ms
+    t1 = c.next_fire(base)
+    assert (t1 // 1000) % 5 == 0
+    assert t1 > base
+    t2 = c.next_fire(t1)
+    assert t2 - t1 == 5000
+
+    # daily at 08:30:00
+    c2 = CronExpression("0 30 8 * * ?")
+    t = c2.next_fire(base)
+    import datetime
+    dt = datetime.datetime.fromtimestamp(t / 1000)
+    assert (dt.hour, dt.minute, dt.second) == (8, 30, 0)
+
+
+def test_cron_trigger_fires():
+    ql = """
+    define trigger Sec at '* * * * * ?';
+    @info(name='q')
+    from Sec select triggered_time insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    assert _wait_for(lambda: len(got) >= 1, timeout=3.0)
+    manager.shutdown()
