@@ -1,0 +1,158 @@
+"""Output rate limiting (reference: CORE/query/output/ratelimit/* — 17
+limiter classes: {All,First,Last}Per{Event,Time} (+GroupBy variants) and
+snapshot limiters).
+
+The device step always computes the full output batch; limiting is a host
+concern on the emission path (events are already host-side there), matching
+the reference's placement between QuerySelector and OutputCallback.
+`output snapshot every t` re-emits the latest row per group at each tick,
+with the group key recovered from the projected group-by attributes when
+they appear in the output (the common `select g, agg(x) ... group by g`
+shape); otherwise the whole latest row stands in.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from . import event as ev
+
+
+class OutputRateLimiter:
+    """Base: `process` receives (kind, Event) pairs in emission order and
+    forwards whatever is due to `deliver`."""
+
+    needs_timer = False
+
+    def __init__(self, deliver: Callable[[List[Tuple[int, ev.Event]], int], None]):
+        self.deliver = deliver
+
+    def process(self, pairs: List[Tuple[int, ev.Event]], now: int) -> None:
+        raise NotImplementedError
+
+    def on_timer(self, now: int) -> None:  # pragma: no cover - overridden
+        pass
+
+
+class PerEventsLimiter(OutputRateLimiter):
+    """`output [all|first|last] every N events` (reference:
+    ratelimit/event/*PerEventOutputRateLimiter.java).  Counts CURRENT
+    output events; at each full window of N, ALL flushes the buffer, FIRST
+    emits only the window's first event, LAST only its Nth."""
+
+    def __init__(self, deliver, n: int, behavior: str):
+        super().__init__(deliver)
+        self.n = n
+        self.behavior = behavior
+        self._buf: List[Tuple[int, ev.Event]] = []
+        self._count = 0
+        self._first_sent = False
+
+    def process(self, pairs, now):
+        out: List[Tuple[int, ev.Event]] = []
+        for kind, e in pairs:
+            if self.behavior == "ALL":
+                self._buf.append((kind, e))
+                self._count += 1
+                if self._count == self.n:
+                    out.extend(self._buf)
+                    self._buf.clear()
+                    self._count = 0
+            elif self.behavior == "FIRST":
+                if not self._first_sent:
+                    out.append((kind, e))
+                    self._first_sent = True
+                self._count += 1
+                if self._count == self.n:
+                    self._count = 0
+                    self._first_sent = False
+            else:  # LAST
+                self._count += 1
+                if self._count == self.n:
+                    out.append((kind, e))
+                    self._count = 0
+        if out:
+            self.deliver(out, now)
+
+
+class PerTimeLimiter(OutputRateLimiter):
+    """`output [all|first|last] every <t>` (reference: ratelimit/time/*).
+    Scheduler-driven: every t ms the buffered (ALL), first (FIRST) or most
+    recent (LAST) output is flushed."""
+
+    needs_timer = True
+
+    def __init__(self, deliver, interval_ms: int, behavior: str):
+        super().__init__(deliver)
+        self.interval = interval_ms
+        self.behavior = behavior
+        self._buf: List[Tuple[int, ev.Event]] = []
+        self._schedule: Optional[Callable[[int], None]] = None
+
+    def process(self, pairs, now):
+        if self.behavior == "FIRST":
+            # emit immediately the first event of each interval
+            if not self._buf and pairs:
+                self.deliver([pairs[0]], now)
+                self._buf = [pairs[0]]       # marks "sent this interval"
+        elif self.behavior == "LAST":
+            if pairs:
+                self._buf = [pairs[-1]]
+        else:
+            self._buf.extend(pairs)
+
+    def on_timer(self, now: int) -> None:
+        if self.behavior == "FIRST":
+            self._buf = []
+        elif self._buf:
+            self.deliver(self._buf, now)
+            self._buf = []
+        if self._schedule is not None:
+            self._schedule(now + self.interval)
+
+
+class SnapshotLimiter(OutputRateLimiter):
+    """`output snapshot every <t>` (reference: ratelimit/snapshot/*): at each
+    tick, re-emit the latest CURRENT row per group."""
+
+    needs_timer = True
+
+    def __init__(self, deliver, interval_ms: int,
+                 group_positions: Optional[List[int]] = None):
+        super().__init__(deliver)
+        self.interval = interval_ms
+        self.group_positions = group_positions
+        self._latest = {}
+        self._schedule: Optional[Callable[[int], None]] = None
+
+    def _key(self, e: ev.Event):
+        if self.group_positions:
+            return tuple(e.data[i] for i in self.group_positions)
+        return ()
+
+    def process(self, pairs, now):
+        for kind, e in pairs:
+            if kind == ev.CURRENT:
+                self._latest[self._key(e)] = e
+
+    def on_timer(self, now: int) -> None:
+        if self._latest:
+            self.deliver([(ev.CURRENT, e) for e in self._latest.values()],
+                         now)
+        if self._schedule is not None:
+            self._schedule(now + self.interval)
+
+
+def create_rate_limiter(output_rate, deliver,
+                        group_positions=None) -> Optional[OutputRateLimiter]:
+    if output_rate is None:
+        return None
+    if output_rate.type == "EVENTS":
+        return PerEventsLimiter(deliver, int(output_rate.value),
+                                output_rate.behavior)
+    if output_rate.type == "TIME":
+        return PerTimeLimiter(deliver, int(output_rate.value),
+                              output_rate.behavior)
+    if output_rate.type == "SNAPSHOT":
+        return SnapshotLimiter(deliver, int(output_rate.value),
+                               group_positions)
+    raise ValueError(f"unknown output rate type {output_rate.type!r}")

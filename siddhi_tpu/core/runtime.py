@@ -311,13 +311,28 @@ def _emit_output_sync(qr, out, now: int) -> None:
                       want_kinds=(ev.CURRENT, ev.EXPIRED))
     if not pairs:
         return
+    if getattr(qr, "table_op", None) is not None:
+        current = [e for k, e in pairs if k == ev.CURRENT]
+        expired = [e for k, e in pairs if k == ev.EXPIRED]
+        for cb in qr.callbacks:
+            cb(now, current or None, expired or None)
+        _apply_table_op(qr, ots, okind, ovalid, ocols, now)
+        return
+    limiter = getattr(qr, "rate_limiter", None)
+    if limiter is not None:
+        limiter.process(pairs, now)
+        return
+    _deliver_pairs(qr, pairs, now)
+
+
+def _deliver_pairs(qr, pairs, now: int) -> None:
+    """Terminal delivery: query callbacks + downstream routing (reference:
+    OutputCallback implementations, CORE/query/output/callback/*)."""
+    p = qr.planned
     current = [e for k, e in pairs if k == ev.CURRENT]
     expired = [e for k, e in pairs if k == ev.EXPIRED]
     for cb in qr.callbacks:
         cb(now, current or None, expired or None)
-    if getattr(qr, "table_op", None) is not None:
-        _apply_table_op(qr, ots, okind, ovalid, ocols, now)
-        return
     if p.output_target:
         sel = p.output_event_type
         if sel == "CURRENT_EVENTS":
@@ -723,6 +738,7 @@ class SiddhiAppRuntime:
 
         # plan queries
         self.query_runtimes: Dict[str, QueryRuntime] = {}
+        self._timed_limiters: List = []
         qi = 0
         for element in app.execution_element_list:
             if isinstance(element, Query):
@@ -782,9 +798,35 @@ class SiddhiAppRuntime:
             self.junctions[planned.input_stream_id].subscribe_query(runtime)
         self._wire_output(runtime, q, planned, name)
 
+    def _attach_rate_limiter(self, q: Query, runtime) -> None:
+        """`output [all|first|last] every ... | snapshot every t` (reference:
+        OutputParser.constructOutputRateLimiter, OutputParser.java:282)."""
+        from .ratelimit import create_rate_limiter
+        runtime.rate_limiter = None
+        if q.output_rate is None:
+            return
+        group_positions = None
+        if q.output_rate.type == "SNAPSHOT" and q.selector.group_by_list:
+            from ..query_api.expression import Variable as V
+            gb_names = {v.attribute_name for v in q.selector.group_by_list}
+            group_positions = [
+                i for i, oa in enumerate(q.selector.selection_list)
+                if isinstance(oa.expression, V)
+                and oa.expression.attribute_name in gb_names] or None
+        lim = create_rate_limiter(
+            q.output_rate,
+            lambda pairs, now, _rt=runtime: _deliver_pairs(_rt, pairs, now),
+            group_positions)
+        runtime.rate_limiter = lim
+        if lim is not None and lim.needs_timer:
+            lim._schedule = lambda ts, _l=lim: \
+                self._scheduler.notify_at(ts, _l)
+            self._timed_limiters.append(lim)
+
     def _wire_output(self, runtime, q: Query, planned, name: str):
         """Route query output: stream (define if missing), table op, or
         window insert."""
+        self._attach_rate_limiter(q, runtime)
         from ..query_api.query import (
             DeleteStream,
             UpdateOrInsertStream,
@@ -936,6 +978,7 @@ class SiddhiAppRuntime:
                         def process_staged(self, staged, now):
                             self._qr.process_staged(self._sid, staged, now)
                     self.junctions[sid].subscribe_query(_Sub(runtime, sid))
+                self._attach_rate_limiter(q, runtime)
                 self._define_output_for(planned, qname)
             else:
                 ist = q.input_stream
@@ -954,6 +997,7 @@ class SiddhiAppRuntime:
                 runtime = QueryRuntime(planned, self)
                 self.query_runtimes[qname] = runtime
                 self.junctions[sid].subscribe_query(runtime)
+                self._attach_rate_limiter(q, runtime)
                 self._define_output_for(planned, qname)
         return qi
 
@@ -989,6 +1033,8 @@ class SiddhiAppRuntime:
             now = self.timestamp_millis()
             for tr in self.triggers.values():
                 tr.start(now)
+            for lim in self._timed_limiters:
+                self._scheduler.notify_at(now + lim.interval, lim)
 
     def shutdown(self) -> None:
         if self._started:

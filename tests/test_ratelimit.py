@@ -1,0 +1,110 @@
+"""Output rate limiting (reference: CORE/query/output/ratelimit/* and
+TEST/query/ratelimit/*TestCase)."""
+import time
+
+from siddhi_tpu import SiddhiManager
+
+
+def _collect(rt, qname):
+    got = []
+    rt.add_callback(qname, lambda ts, ins, outs: got.extend(ins or []))
+    return got
+
+
+def test_output_all_every_3_events():
+    ql = """
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, v output all every 3 events insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(7):
+        h.send([str(i), i])
+    rt.flush()
+    # two full windows of 3 flushed; the 7th stays buffered
+    assert [e.data[1] for e in got] == [0, 1, 2, 3, 4, 5]
+    manager.shutdown()
+
+
+def test_output_first_every_3_events():
+    ql = """
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, v output first every 3 events insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(7):
+        h.send([str(i), i])
+    rt.flush()
+    assert [e.data[1] for e in got] == [0, 3, 6]
+    manager.shutdown()
+
+
+def test_output_last_every_3_events():
+    ql = """
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, v output last every 3 events insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(7):
+        h.send([str(i), i])
+    rt.flush()
+    assert [e.data[1] for e in got] == [2, 5]
+    manager.shutdown()
+
+
+def test_output_all_every_time():
+    ql = """
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, v output all every 150 milliseconds insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(5):
+        h.send([str(i), i])
+    deadline = time.time() + 3.0
+    while time.time() < deadline and len(got) < 5:
+        time.sleep(0.02)
+    assert [e.data[1] for e in got] == [0, 1, 2, 3, 4]
+    manager.shutdown()
+
+
+def test_output_snapshot_every_time_grouped():
+    ql = """
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, sum(v) as total group by k
+    output snapshot every 150 milliseconds
+    insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("In")
+    h.send(["a", 1])
+    h.send(["b", 10])
+    h.send(["a", 2])
+    deadline = time.time() + 3.0
+    while time.time() < deadline and len(got) < 2:
+        time.sleep(0.02)
+    snap = {e.data[0]: e.data[1] for e in got[:2]}
+    assert snap == {"a": 3, "b": 10}
+    manager.shutdown()
