@@ -96,15 +96,17 @@ def test_output_snapshot_every_time_grouped():
     """
     manager = SiddhiManager()
     rt = manager.create_siddhi_app_runtime(ql)
-    got = _collect(rt, "q")
+    batches = []
+    rt.add_callback("q", lambda ts, ins, outs: batches.append(ins or []))
     rt.start()
     h = rt.get_input_handler("In")
     h.send(["a", 1])
     h.send(["b", 10])
     h.send(["a", 2])
     deadline = time.time() + 3.0
-    while time.time() < deadline and len(got) < 2:
+    while time.time() < deadline and not any(len(b) == 2 for b in batches):
         time.sleep(0.02)
-    snap = {e.data[0]: e.data[1] for e in got[:2]}
+    full = [b for b in batches if len(b) == 2][0]
+    snap = {e.data[0]: e.data[1] for e in full}
     assert snap == {"a": 3, "b": 10}
     manager.shutdown()
