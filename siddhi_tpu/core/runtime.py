@@ -129,7 +129,10 @@ class QueryRuntime:
             in_tabs)
         self._emit(out, now)
         if p.needs_timer:
-            w = int(wake)
+            if getattr(p.window, "host_scheduled", False):
+                w = p.window.host_next_wakeup(now)
+            else:
+                w = int(wake)
             self.next_wakeup = w
             if w < _NO_WAKEUP_INT:
                 self.app._scheduler.notify_at(w, self)

@@ -696,6 +696,9 @@ WINDOW_TYPES = {
     "timeBatch": TimeBatchWindow,
 }
 
+from . import window_ext as _window_ext  # noqa: E402  (registry extension)
+_window_ext.register(WINDOW_TYPES)
+
 
 def create_window(name: str, schema: ev.Schema, params, batch_capacity: int,
                   capacity_hint: int = 2048) -> WindowProcessor:

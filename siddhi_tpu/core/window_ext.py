@@ -1,0 +1,847 @@
+"""Extended window processors: externalTime, externalTimeBatch, timeLength,
+delay, batch, sort, cron, session, frequent, lossyFrequent.
+
+Reference behavior (what): CORE/query/processor/stream/window/
+{ExternalTime,ExternalTimeBatch,TimeLength,Delay,Batch,Sort,Cron,Session,
+Frequent,LossyFrequent}WindowProcessor.java.
+
+TPU-native design (how): same columnar fixed-capacity buffer model as
+window.py — whole micro-batches in, vectorized merge/sort/compact, output
+rows carrying explicit sequence numbers.  The frequency-counting windows
+(Misra-Gries / lossy counting) are inherently per-event sequential, so they
+run as a `lax.scan` over the batch with a tiny counter state — still compiled,
+still on device, just not width-parallel (they are tail features, not the
+hot path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..query_api.expression import Constant, Variable
+from . import event as ev
+from .window import (
+    BIG_SEQ,
+    NO_WAKEUP,
+    Buffer,
+    Rows,
+    WindowOutput,
+    WindowProcessor,
+    concat_rows,
+    empty_buffer,
+    sort_rows,
+    _param_int,
+)
+
+
+def _param_var_position(params, i, schema, what="window"):
+    if i >= len(params) or not isinstance(params[i], Variable):
+        raise ValueError(f"{what} parameter {i} must be an attribute name")
+    return schema.position(params[i].attribute_name)
+
+
+def _scatter_buffer(schema, capacity, cand_valid, cand_rank, cand_ts,
+                    cand_add, cand_expts, cand_gslot, cand_cols) -> Buffer:
+    """Compact candidates into a fresh buffer by rank."""
+    tgt = jnp.where(cand_valid, cand_rank, capacity).astype(jnp.int32)
+    fresh = empty_buffer(schema, capacity)
+    return Buffer(
+        ts=fresh.ts.at[tgt].set(cand_ts, mode="drop"),
+        add_seq=fresh.add_seq.at[tgt].set(cand_add, mode="drop"),
+        expire_seq=fresh.expire_seq,
+        expire_ts=fresh.expire_ts.at[tgt].set(cand_expts, mode="drop"),
+        alive=jnp.zeros((capacity,), jnp.bool_).at[tgt].set(
+            cand_valid, mode="drop"),
+        gslot=fresh.gslot.at[tgt].set(cand_gslot, mode="drop"),
+        cols=tuple(f.at[tgt].set(c, mode="drop")
+                   for f, c in zip(fresh.cols, cand_cols)),
+    )
+
+
+class ExternalTimeWindow(WindowProcessor):
+    """Sliding window over an event-time attribute (reference:
+    ExternalTimeWindowProcessor.java): entry expires when a later event's
+    timestamp attribute passes entry_ts + t.  No wall-clock timers — expiry
+    is driven purely by arrivals, so out-of-band time does not advance it."""
+
+    name = "externalTime"
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
+        super().__init__(schema, params, batch_capacity)
+        self.ts_pos = _param_var_position(params, 0, schema, "externalTime")
+        self.time_ms = _param_int(params, 1)
+        self.capacity = max(capacity_hint, 2 * batch_capacity)
+
+    @property
+    def out_capacity(self):
+        return 2 * (self.capacity + self.batch_capacity)
+
+    def init_state(self):
+        return (empty_buffer(self.schema, self.capacity),
+                jnp.asarray(0, jnp.int64))
+
+    def process(self, state, rows: Rows, now):
+        buf, seq0 = state
+        C, B, t = self.capacity, rows.capacity, self.time_ms
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        ets = rows.cols[self.ts_pos].astype(jnp.int64)
+        ext_now = jnp.max(jnp.where(is_cur, ets, -BIG_SEQ))
+
+        # candidates: old entries then arrivals; event-time stored in expire_ts
+        cand_ts = jnp.concatenate([buf.ts, rows.ts])
+        cand_ets = jnp.concatenate([buf.expire_ts - t, ets])  # entry event-ts
+        cand_alive = jnp.concatenate([buf.alive, is_cur])
+        cand_add = jnp.concatenate(
+            [buf.add_seq, jnp.full((B,), 0, jnp.int64)])
+        cand_gslot = jnp.concatenate([buf.gslot, rows.gslot])
+        cand_cols = tuple(jnp.concatenate([bc, rc])
+                          for bc, rc in zip(buf.cols, rows.cols))
+        cand_expts = cand_ets + t
+        due = jnp.logical_and(cand_alive, cand_expts <= ext_now)
+
+        # emission merge: EXPIRED at key 2*expire_ts, CURRENT at 2*ts+1
+        cur_key = jnp.where(is_cur, ets * 2 + 1, BIG_SEQ)
+        exp_key = jnp.where(due, cand_expts * 2, BIG_SEQ)
+        em_key = jnp.concatenate([exp_key, cur_key])
+        order = jnp.argsort(em_key, stable=True)
+        rank = jnp.zeros((C + 2 * B,), jnp.int64).at[order].set(
+            jnp.arange(C + 2 * B, dtype=jnp.int64))
+        exp_rows = Rows(
+            ts=cand_expts, kind=jnp.full((C + B,), ev.EXPIRED, jnp.int32),
+            valid=due, seq=seq0 + rank[:C + B], gslot=cand_gslot,
+            cols=cand_cols)
+        cur_rows = Rows(
+            ts=rows.ts, kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+            valid=is_cur, seq=seq0 + rank[C + B:], gslot=rows.gslot,
+            cols=rows.cols)
+        out = sort_rows(concat_rows(exp_rows, cur_rows))
+
+        # fix arrival add_seq now that ranks exist
+        cand_add = jnp.concatenate([buf.add_seq, seq0 + rank[C + B:]])
+
+        # survivors, oldest-first by event time then add order
+        keep = jnp.logical_and(cand_alive, jnp.logical_not(due))
+        keep_key = jnp.where(keep, cand_ets * (C + 2 * B) + 0, BIG_SEQ)
+        # tie-break by original position to keep stability
+        keep_key = keep_key + jnp.arange(C + B, dtype=jnp.int64) % (C + 2 * B)
+        korder = jnp.argsort(keep_key)
+        total = jnp.sum(keep.astype(jnp.int64))
+        drop = jnp.maximum(total - C, 0)
+        sel = jnp.clip(jnp.arange(C, dtype=jnp.int64) + drop,
+                       0, C + B - 1)
+        pos = korder[sel.astype(jnp.int32)]
+        svalid = (jnp.arange(C, dtype=jnp.int64) + drop) < total
+        nbuf = Buffer(
+            ts=cand_ts[pos],
+            add_seq=jnp.where(svalid, cand_add[pos], BIG_SEQ),
+            expire_seq=jnp.full((C,), BIG_SEQ, jnp.int64),
+            expire_ts=jnp.where(svalid, cand_expts[pos], BIG_SEQ),
+            alive=svalid, gslot=cand_gslot[pos],
+            cols=tuple(c[pos] for c in cand_cols),
+        )
+        nem = jnp.sum(due.astype(jnp.int64)) + jnp.sum(is_cur.astype(jnp.int64))
+        return ((nbuf, seq0 + nem),
+                WindowOutput(out, nbuf, jnp.asarray(NO_WAKEUP, jnp.int64)))
+
+
+class ExternalTimeBatchWindow(WindowProcessor):
+    """Tumbling window over an event-time attribute (reference:
+    ExternalTimeBatchWindowProcessor.java): slices [start+k*t, start+(k+1)*t)
+    of the timestamp attribute; a slice flushes when an arrival's event time
+    crosses its end.  Like TimeBatchWindow, slices that would flush empty in
+    the same micro-batch collapse into the batch's single flush."""
+
+    name = "externalTimeBatch"
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
+        super().__init__(schema, params, batch_capacity)
+        self.ts_pos = _param_var_position(params, 0, schema,
+                                          "externalTimeBatch")
+        self.time_ms = _param_int(params, 1)
+        self.start = _param_int(params, 2, default=-1) if len(params) > 2 \
+            else -1
+        self.capacity = max(capacity_hint, 2 * batch_capacity)
+
+    @property
+    def out_capacity(self):
+        return 2 * self.capacity + 2 * self.batch_capacity + 2
+
+    def init_state(self):
+        return (
+            empty_buffer(self.schema, self.capacity),   # pending slice
+            empty_buffer(self.schema, self.capacity),   # previous slice
+            jnp.asarray(self.start, jnp.int64),         # slice start (-1 unset)
+            jnp.asarray(0, jnp.int64),                  # seq counter
+        )
+
+    def process(self, state, rows: Rows, now):
+        pend, prev, start0, seq0 = state
+        t = self.time_ms
+        C, B = self.capacity, rows.capacity
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        any_cur = jnp.any(is_cur)
+        ets = rows.cols[self.ts_pos].astype(jnp.int64)
+        first_ts = jnp.min(jnp.where(is_cur, ets, BIG_SEQ))
+        last_ts = jnp.max(jnp.where(is_cur, ets, -BIG_SEQ))
+        start = jnp.where(start0 >= 0, start0, first_ts)
+
+        nflush = jnp.where(any_cur, jnp.maximum(last_ts - start, 0) // t, 0)
+        flush = nflush > 0
+        boundary = start + jnp.where(flush, nflush, 1) * t
+        new_start = jnp.where(flush, start + nflush * t, start)
+
+        to_pend = jnp.logical_and(is_cur, ets < boundary)
+        to_next = jnp.logical_and(is_cur, jnp.logical_not(to_pend))
+
+        pend_rank = jnp.cumsum(pend.alive.astype(jnp.int64)) - 1
+        fill0 = jnp.sum(pend.alive.astype(jnp.int64))
+        arr_rank = fill0 + jnp.cumsum(to_pend.astype(jnp.int64)) - 1
+
+        exp_rows = Rows(
+            ts=prev.ts, kind=jnp.full((C,), ev.EXPIRED, jnp.int32),
+            valid=jnp.logical_and(prev.alive, flush),
+            seq=seq0 + jnp.cumsum(prev.alive.astype(jnp.int64)) - 1,
+            gslot=prev.gslot, cols=prev.cols)
+        reset_rows = Rows(
+            ts=jnp.full((1,), 0, jnp.int64) + now,
+            kind=jnp.full((1,), ev.RESET, jnp.int32),
+            valid=jnp.reshape(flush, (1,)),
+            seq=jnp.full((1,), seq0 + C, jnp.int64),
+            gslot=jnp.full((1,), -1, jnp.int32),
+            cols=tuple(jnp.full((1,), ev.default_value(t_), d)
+                       for t_, d in zip(self.schema.types,
+                                        self.schema.dtypes)))
+        cur_rows = Rows(
+            ts=jnp.concatenate([pend.ts, rows.ts]),
+            kind=jnp.full((C + B,), ev.CURRENT, jnp.int32),
+            valid=jnp.concatenate([
+                jnp.logical_and(pend.alive, flush),
+                jnp.logical_and(to_pend, flush)]),
+            seq=seq0 + C + 1 + jnp.concatenate([pend_rank, arr_rank]),
+            gslot=jnp.concatenate([pend.gslot, rows.gslot]),
+            cols=tuple(jnp.concatenate([pc, rc])
+                       for pc, rc in zip(pend.cols, rows.cols)))
+        out = sort_rows(concat_rows(concat_rows(exp_rows, cur_rows),
+                                    reset_rows))
+
+        keep_pend = jnp.logical_and(pend.alive, jnp.logical_not(flush))
+        arr_keep = jnp.where(flush, to_next, to_pend)
+        base_fill = jnp.sum(keep_pend.astype(jnp.int64))
+        cand_valid = jnp.concatenate([keep_pend, arr_keep])
+        cand_rank = jnp.concatenate([
+            pend_rank, base_fill + jnp.cumsum(arr_keep.astype(jnp.int64)) - 1])
+        cand_ts = jnp.concatenate([pend.ts, rows.ts])
+        cand_gslot = jnp.concatenate([pend.gslot, rows.gslot])
+        cand_cols = tuple(jnp.concatenate([pc, rc])
+                          for pc, rc in zip(pend.cols, rows.cols))
+        big = jnp.full(cand_ts.shape, BIG_SEQ, jnp.int64)
+        npend = _scatter_buffer(self.schema, C, cand_valid, cand_rank,
+                                cand_ts, big, big, cand_gslot, cand_cols)
+
+        fvalid = jnp.concatenate([pend.alive, to_pend])
+        frank = jnp.concatenate([pend_rank, arr_rank])
+        fprev = _scatter_buffer(self.schema, C, fvalid, frank, cand_ts, big,
+                                big, cand_gslot, cand_cols)
+        nprev = jax.tree.map(lambda a, b: jnp.where(flush, a, b), fprev, prev)
+
+        nseq = jnp.where(flush, seq0 + 2 * C + B + 2, seq0)
+        nstart = jnp.where(jnp.logical_or(start0 >= 0, any_cur), new_start,
+                           jnp.asarray(-1, jnp.int64))
+        return ((npend, nprev, nstart, nseq),
+                WindowOutput(out, None, jnp.asarray(NO_WAKEUP, jnp.int64)))
+
+
+class TimeLengthWindow(WindowProcessor):
+    """Sliding window bounded by both time and count (reference:
+    TimeLengthWindowProcessor.java): an entry leaves after t ms, or earlier
+    if more than n newer entries arrive.  Time expiry and length eviction
+    both emit EXPIRED rows; time expiries are stamped with their expiry time,
+    length evictions with the evicting arrival's time."""
+
+    name = "timeLength"
+    needs_timer = True
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
+        super().__init__(schema, params, batch_capacity)
+        self.time_ms = _param_int(params, 0)
+        self.length = _param_int(params, 1)
+        self.capacity = self.length
+
+    @property
+    def out_capacity(self):
+        return 2 * (self.capacity + self.batch_capacity)
+
+    def init_state(self):
+        return (empty_buffer(self.schema, self.capacity),
+                jnp.asarray(0, jnp.int64))
+
+    def process(self, state, rows: Rows, now):
+        buf, seq0 = state
+        C, B, t, n = self.capacity, rows.capacity, self.time_ms, self.length
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        ncur = jnp.sum(is_cur.astype(jnp.int64))
+        k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
+
+        # ---- phase 1: time expiry of old entries ---------------------------
+        time_due = jnp.logical_and(buf.alive, buf.expire_ts <= now)
+        # ---- phase 2: length eviction among survivors + arrivals -----------
+        keep_old = jnp.logical_and(buf.alive, jnp.logical_not(time_due))
+        count0 = jnp.sum(keep_old.astype(jnp.int64))
+        old_key = jnp.where(keep_old, buf.add_seq, BIG_SEQ)
+        old_order = jnp.argsort(old_key)           # alive survivors by age
+        # the k-th arrival evicts virtual survivor (count0 + k - n)
+        evict_pos = count0 + k - n
+        has_evict = jnp.logical_and(is_cur, evict_pos >= 0)
+
+        comb_ts = jnp.concatenate([buf.ts[old_order], rows.ts])
+        comb_expts = jnp.concatenate([buf.expire_ts[old_order], rows.ts + t])
+        comb_gslot = jnp.concatenate([buf.gslot[old_order], rows.gslot])
+        comb_cols = tuple(jnp.concatenate([bc[old_order], rc])
+                          for bc, rc in zip(buf.cols, rows.cols))
+
+        def phys(v):
+            return jnp.clip(jnp.where(v < count0, v, C + v - count0),
+                            0, C + B - 1).astype(jnp.int32)
+
+        # emission merge: time-expiries by expire_ts, then per-arrival
+        # (evicted, current) pairs.  Use key = 4*time + priority.
+        te_key = jnp.where(time_due, buf.expire_ts * 4, BIG_SEQ)
+        ev_key = jnp.where(has_evict, rows.ts * 4 + 1, BIG_SEQ)
+        cu_key = jnp.where(is_cur, rows.ts * 4 + 2, BIG_SEQ)
+        # within equal arrival ts, order by k via small epsilon on rank sort
+        em_key = jnp.concatenate([te_key, ev_key, cu_key])
+        order = jnp.argsort(em_key, stable=True)
+        rank = jnp.zeros((C + 2 * B,), jnp.int64).at[order].set(
+            jnp.arange(C + 2 * B, dtype=jnp.int64))
+
+        te_rows = Rows(
+            ts=buf.expire_ts, kind=jnp.full((C,), ev.EXPIRED, jnp.int32),
+            valid=time_due, seq=seq0 + rank[:C], gslot=buf.gslot,
+            cols=buf.cols)
+        evict_phys = phys(evict_pos)
+        ev_rows = Rows(
+            ts=rows.ts, kind=jnp.full((B,), ev.EXPIRED, jnp.int32),
+            valid=has_evict, seq=seq0 + rank[C:C + B],
+            gslot=comb_gslot[evict_phys],
+            cols=tuple(c[evict_phys] for c in comb_cols))
+        cu_rows = Rows(
+            ts=rows.ts, kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+            valid=is_cur, seq=seq0 + rank[C + B:], gslot=rows.gslot,
+            cols=rows.cols)
+        out = sort_rows(concat_rows(concat_rows(te_rows, ev_rows), cu_rows))
+
+        # ---- new buffer: last n of (survivors + arrivals) ------------------
+        total = count0 + ncur
+        start = jnp.maximum(total - n, 0)
+        take = jnp.arange(C, dtype=jnp.int64) + start
+        tvalid = take < total
+        tpos = phys(take)
+        comb_add = jnp.concatenate([buf.add_seq[old_order],
+                                    seq0 + rank[C + B:]])
+        nbuf = Buffer(
+            ts=comb_ts[tpos], add_seq=jnp.where(tvalid, comb_add[tpos],
+                                                BIG_SEQ),
+            expire_seq=jnp.full((C,), BIG_SEQ, jnp.int64),
+            expire_ts=jnp.where(tvalid, comb_expts[tpos], BIG_SEQ),
+            alive=tvalid, gslot=comb_gslot[tpos],
+            cols=tuple(c[tpos] for c in comb_cols))
+        nem = (jnp.sum(time_due.astype(jnp.int64)) +
+               jnp.sum(has_evict.astype(jnp.int64)) + ncur)
+        wake = jnp.min(jnp.where(nbuf.alive, nbuf.expire_ts, NO_WAKEUP))
+        return ((nbuf, seq0 + nem), WindowOutput(out, nbuf, wake))
+
+
+class DelayWindow(WindowProcessor):
+    """Delay window (reference: DelayWindowProcessor.java): events are held
+    for t ms and released downstream as CURRENT when the delay elapses."""
+
+    name = "delay"
+    needs_timer = True
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
+        super().__init__(schema, params, batch_capacity)
+        self.time_ms = _param_int(params, 0)
+        self.capacity = max(capacity_hint, 2 * batch_capacity)
+
+    @property
+    def out_capacity(self):
+        return self.capacity + self.batch_capacity
+
+    def init_state(self):
+        return (empty_buffer(self.schema, self.capacity),
+                jnp.asarray(0, jnp.int64))
+
+    def process(self, state, rows: Rows, now):
+        buf, seq0 = state
+        C, B, t = self.capacity, rows.capacity, self.time_ms
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+
+        cand_ts = jnp.concatenate([buf.ts, rows.ts])
+        cand_rel = jnp.concatenate([buf.expire_ts, rows.ts + t])
+        cand_alive = jnp.concatenate([buf.alive, is_cur])
+        cand_gslot = jnp.concatenate([buf.gslot, rows.gslot])
+        cand_cols = tuple(jnp.concatenate([bc, rc])
+                          for bc, rc in zip(buf.cols, rows.cols))
+        release = jnp.logical_and(cand_alive, cand_rel <= now)
+
+        rel_key = jnp.where(release, cand_rel, BIG_SEQ)
+        order = jnp.argsort(rel_key, stable=True)
+        rank = jnp.zeros((C + B,), jnp.int64).at[order].set(
+            jnp.arange(C + B, dtype=jnp.int64))
+        out = sort_rows(Rows(
+            ts=cand_ts, kind=jnp.full((C + B,), ev.CURRENT, jnp.int32),
+            valid=release, seq=seq0 + rank, gslot=cand_gslot,
+            cols=cand_cols))
+
+        keep = jnp.logical_and(cand_alive, jnp.logical_not(release))
+        krank = jnp.cumsum(keep.astype(jnp.int64)) - 1
+        big = jnp.full((C + B,), BIG_SEQ, jnp.int64)
+        nbuf = _scatter_buffer(self.schema, C, keep, krank, cand_ts, big,
+                               cand_rel, cand_gslot, cand_cols)
+        nem = jnp.sum(release.astype(jnp.int64))
+        wake = jnp.min(jnp.where(nbuf.alive, nbuf.expire_ts, NO_WAKEUP))
+        return ((nbuf, seq0 + nem), WindowOutput(out, nbuf, wake))
+
+
+class ChunkBatchWindow(WindowProcessor):
+    """`batch()` (reference: BatchWindowProcessor.java): each processed
+    micro-batch is the window; the previous batch is replayed as EXPIRED
+    ahead of the new CURRENT chunk."""
+
+    name = "batch"
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=1024):
+        super().__init__(schema, params, batch_capacity)
+        self.capacity = batch_capacity
+
+    @property
+    def out_capacity(self):
+        return self.capacity + self.batch_capacity + 1
+
+    def init_state(self):
+        return (empty_buffer(self.schema, self.capacity),
+                jnp.asarray(0, jnp.int64))
+
+    def process(self, state, rows: Rows, now):
+        prev, seq0 = state
+        C, B = self.capacity, rows.capacity
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        any_cur = jnp.any(is_cur)
+        ncur = jnp.sum(is_cur.astype(jnp.int64))
+        nprev_n = jnp.sum(prev.alive.astype(jnp.int64))
+
+        exp_rows = Rows(
+            ts=prev.ts, kind=jnp.full((C,), ev.EXPIRED, jnp.int32),
+            valid=jnp.logical_and(prev.alive, any_cur),
+            seq=seq0 + jnp.cumsum(prev.alive.astype(jnp.int64)) - 1,
+            gslot=prev.gslot, cols=prev.cols)
+        k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
+        cur_rows = Rows(
+            ts=rows.ts, kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+            valid=is_cur, seq=seq0 + nprev_n + 1 + k, gslot=rows.gslot,
+            cols=rows.cols)
+        reset_rows = Rows(
+            ts=jnp.full((1,), 0, jnp.int64) + now,
+            kind=jnp.full((1,), ev.RESET, jnp.int32),
+            valid=jnp.reshape(any_cur, (1,)),
+            seq=jnp.full((1,), seq0 + nprev_n, jnp.int64),
+            gslot=jnp.full((1,), -1, jnp.int32),
+            cols=tuple(jnp.full((1,), ev.default_value(t_), d)
+                       for t_, d in zip(self.schema.types,
+                                        self.schema.dtypes)))
+        out = sort_rows(concat_rows(concat_rows(exp_rows, cur_rows),
+                                    reset_rows))
+
+        big = jnp.full((B,), BIG_SEQ, jnp.int64)
+        nprev = _scatter_buffer(self.schema, C, is_cur, k, rows.ts, big, big,
+                                rows.gslot, rows.cols)
+        nprev = jax.tree.map(lambda a, b: jnp.where(any_cur, a, b),
+                             nprev, prev)
+        nseq = jnp.where(any_cur, seq0 + nprev_n + 1 + ncur, seq0)
+        return ((nprev, nseq),
+                WindowOutput(out, None, jnp.asarray(NO_WAKEUP, jnp.int64)))
+
+
+class SortWindow(WindowProcessor):
+    """Sort window (reference: SortWindowProcessor.java): retains the n
+    smallest (asc, default) or largest (desc) events by the key attribute;
+    when full, the event at the losing end is evicted as EXPIRED."""
+
+    name = "sort"
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=1024):
+        super().__init__(schema, params, batch_capacity)
+        self.length = _param_int(params, 0)
+        self.key_pos = _param_var_position(params, 1, schema, "sort")
+        self.descending = False
+        if len(params) > 2:
+            p = params[2]
+            if isinstance(p, Constant) and str(p.value).lower() == "desc":
+                self.descending = True
+        if len(params) > 3:
+            raise ValueError("sort window supports a single sort key in "
+                             "this build")
+        self.capacity = self.length
+
+    @property
+    def out_capacity(self):
+        return 2 * self.batch_capacity + self.capacity
+
+    def init_state(self):
+        return (empty_buffer(self.schema, self.capacity),
+                jnp.asarray(0, jnp.int64))
+
+    def process(self, state, rows: Rows, now):
+        buf, seq0 = state
+        C, B, n = self.capacity, rows.capacity, self.length
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        ncur = jnp.sum(is_cur.astype(jnp.int64))
+        k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
+
+        cand_ts = jnp.concatenate([buf.ts, rows.ts])
+        cand_alive = jnp.concatenate([buf.alive, is_cur])
+        cand_gslot = jnp.concatenate([buf.gslot, rows.gslot])
+        cand_cols = tuple(jnp.concatenate([bc, rc])
+                          for bc, rc in zip(buf.cols, rows.cols))
+        key = cand_cols[self.key_pos]
+        if self.descending:
+            key = -key
+
+        # keep the n best (smallest key); evict the rest as EXPIRED
+        skey = jnp.where(cand_alive, key.astype(jnp.float64)
+                         if key.dtype in (jnp.float32, jnp.float64)
+                         else key.astype(jnp.int64), jnp.inf
+                         if key.dtype in (jnp.float32, jnp.float64)
+                         else BIG_SEQ)
+        order = jnp.argsort(skey, stable=True)
+        pos_rank = jnp.zeros((C + B,), jnp.int64).at[order].set(
+            jnp.arange(C + B, dtype=jnp.int64))
+        total = jnp.sum(cand_alive.astype(jnp.int64))
+        keep = jnp.logical_and(cand_alive,
+                               pos_rank < jnp.minimum(total, n))
+        evict = jnp.logical_and(cand_alive, jnp.logical_not(keep))
+
+        cur_rows = Rows(
+            ts=rows.ts, kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+            valid=is_cur, seq=seq0 + k, gslot=rows.gslot, cols=rows.cols)
+        erank = jnp.cumsum(evict.astype(jnp.int64)) - 1
+        exp_rows = Rows(
+            ts=cand_ts, kind=jnp.full((C + B,), ev.EXPIRED, jnp.int32),
+            valid=evict, seq=seq0 + ncur + erank, gslot=cand_gslot,
+            cols=cand_cols)
+        out = sort_rows(concat_rows(cur_rows, exp_rows))
+
+        krank = jnp.cumsum(keep.astype(jnp.int64)) - 1
+        big = jnp.full((C + B,), BIG_SEQ, jnp.int64)
+        nbuf = _scatter_buffer(self.schema, C, keep, krank, cand_ts, big,
+                               big, cand_gslot, cand_cols)
+        nem = ncur + jnp.sum(evict.astype(jnp.int64))
+        return ((nbuf, seq0 + nem),
+                WindowOutput(out, nbuf, jnp.asarray(NO_WAKEUP, jnp.int64)))
+
+
+class CronWindow(WindowProcessor):
+    """Cron batch window (reference: CronWindowProcessor.java): accumulates
+    events and flushes the batch at cron-scheduled times.  The cron schedule
+    cannot be evaluated inside the compiled step, so the host scheduler
+    computes fire times (`host_next_wakeup`) and the device flushes whenever
+    a TIMER row arrives."""
+
+    name = "cron"
+    needs_timer = True
+    host_scheduled = True
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
+        super().__init__(schema, params, batch_capacity)
+        if not params or not isinstance(params[0], Constant):
+            raise ValueError("cron window needs a cron expression string")
+        from ..utils.cron import CronExpression
+        self.cron = CronExpression(str(params[0].value))
+        self.capacity = max(capacity_hint, 2 * batch_capacity)
+
+    def host_next_wakeup(self, now: int) -> int:
+        return self.cron.next_fire(now)
+
+    @property
+    def out_capacity(self):
+        return 2 * self.capacity + self.batch_capacity + 1
+
+    def init_state(self):
+        return (
+            empty_buffer(self.schema, self.capacity),   # pending
+            empty_buffer(self.schema, self.capacity),   # previous
+            jnp.asarray(0, jnp.int64),
+        )
+
+    def process(self, state, rows: Rows, now):
+        pend, prev, seq0 = state
+        C, B = self.capacity, rows.capacity
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        flush = jnp.any(jnp.logical_and(rows.valid, rows.kind == ev.TIMER))
+
+        pend_rank = jnp.cumsum(pend.alive.astype(jnp.int64)) - 1
+        fill0 = jnp.sum(pend.alive.astype(jnp.int64))
+        k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
+
+        exp_rows = Rows(
+            ts=prev.ts, kind=jnp.full((C,), ev.EXPIRED, jnp.int32),
+            valid=jnp.logical_and(prev.alive, flush),
+            seq=seq0 + jnp.cumsum(prev.alive.astype(jnp.int64)) - 1,
+            gslot=prev.gslot, cols=prev.cols)
+        reset_rows = Rows(
+            ts=jnp.full((1,), 0, jnp.int64) + now,
+            kind=jnp.full((1,), ev.RESET, jnp.int32),
+            valid=jnp.reshape(flush, (1,)),
+            seq=jnp.full((1,), seq0 + C, jnp.int64),
+            gslot=jnp.full((1,), -1, jnp.int32),
+            cols=tuple(jnp.full((1,), ev.default_value(t_), d)
+                       for t_, d in zip(self.schema.types,
+                                        self.schema.dtypes)))
+        cur_rows = Rows(
+            ts=pend.ts, kind=jnp.full((C,), ev.CURRENT, jnp.int32),
+            valid=jnp.logical_and(pend.alive, flush),
+            seq=seq0 + C + 1 + pend_rank, gslot=pend.gslot, cols=pend.cols)
+        out = sort_rows(concat_rows(concat_rows(exp_rows, cur_rows),
+                                    reset_rows))
+
+        # new pending: arrivals append; if flush, pending cleared first
+        keep_pend = jnp.logical_and(pend.alive, jnp.logical_not(flush))
+        base = jnp.where(flush, 0, fill0)
+        cand_valid = jnp.concatenate([keep_pend, is_cur])
+        cand_rank = jnp.concatenate([pend_rank, base + k])
+        cand_ts = jnp.concatenate([pend.ts, rows.ts])
+        cand_gslot = jnp.concatenate([pend.gslot, rows.gslot])
+        cand_cols = tuple(jnp.concatenate([pc, rc])
+                          for pc, rc in zip(pend.cols, rows.cols))
+        big = jnp.full(cand_ts.shape, BIG_SEQ, jnp.int64)
+        npend = _scatter_buffer(self.schema, C, cand_valid, cand_rank,
+                                cand_ts, big, big, cand_gslot, cand_cols)
+        nprev = jax.tree.map(lambda a, b: jnp.where(flush, a, b), pend, prev)
+        nseq = jnp.where(flush, seq0 + 2 * C + 1, seq0)
+        return ((npend, nprev, nseq),
+                WindowOutput(out, None, jnp.asarray(NO_WAKEUP, jnp.int64)))
+
+
+class SessionWindow(WindowProcessor):
+    """Session window, single-session form (reference:
+    SessionWindowProcessor.java — the largest reference window, 696 LoC).
+    Events pass through as CURRENT and accumulate in the live session; when
+    `gap` elapses with no arrivals the whole session is expired together.
+    The per-key variant (`session(gap, key)`) belongs to the partitioned
+    path and is not yet wired here."""
+
+    name = "session"
+    needs_timer = True
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
+        super().__init__(schema, params, batch_capacity)
+        self.gap_ms = _param_int(params, 0)
+        if len(params) > 1:
+            raise ValueError(
+                "session(gap, key) per-key sessions land with the "
+                "partitioned window phase; use `partition with` for now")
+        self.capacity = max(capacity_hint, 2 * batch_capacity)
+
+    @property
+    def out_capacity(self):
+        return self.capacity + self.batch_capacity
+
+    def init_state(self):
+        return (
+            empty_buffer(self.schema, self.capacity),
+            jnp.asarray(-1, jnp.int64),   # last event ts (-1: no session)
+            jnp.asarray(0, jnp.int64),
+        )
+
+    def process(self, state, rows: Rows, now):
+        buf, last0, seq0 = state
+        C, B, gap = self.capacity, rows.capacity, self.gap_ms
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        any_cur = jnp.any(is_cur)
+        ncur = jnp.sum(is_cur.astype(jnp.int64))
+        k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
+
+        # session expires if gap passed before this batch's first arrival
+        expire_now = jnp.logical_and(last0 >= 0, last0 + gap <= now)
+
+        brank = jnp.cumsum(buf.alive.astype(jnp.int64)) - 1
+        exp_rows = Rows(
+            ts=buf.ts, kind=jnp.full((C,), ev.EXPIRED, jnp.int32),
+            valid=jnp.logical_and(buf.alive, expire_now),
+            seq=seq0 + brank, gslot=buf.gslot, cols=buf.cols)
+        nexp = jnp.where(expire_now,
+                         jnp.sum(buf.alive.astype(jnp.int64)), 0)
+        cur_rows = Rows(
+            ts=rows.ts, kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+            valid=is_cur, seq=seq0 + nexp + k, gslot=rows.gslot,
+            cols=rows.cols)
+        out = sort_rows(concat_rows(exp_rows, cur_rows))
+
+        keep = jnp.logical_and(buf.alive, jnp.logical_not(expire_now))
+        fill0 = jnp.sum(keep.astype(jnp.int64))
+        cand_valid = jnp.concatenate([keep, is_cur])
+        cand_rank = jnp.concatenate([brank, fill0 + k])
+        cand_ts = jnp.concatenate([buf.ts, rows.ts])
+        cand_gslot = jnp.concatenate([buf.gslot, rows.gslot])
+        cand_cols = tuple(jnp.concatenate([bc, rc])
+                          for bc, rc in zip(buf.cols, rows.cols))
+        big = jnp.full(cand_ts.shape, BIG_SEQ, jnp.int64)
+        nbuf = _scatter_buffer(self.schema, C, cand_valid, cand_rank,
+                               cand_ts, big, big, cand_gslot, cand_cols)
+        last_arr = jnp.max(jnp.where(is_cur, rows.ts, -1))
+        nlast = jnp.where(any_cur, jnp.maximum(last_arr, 0),
+                          jnp.where(expire_now, -1, last0))
+        nseq = seq0 + nexp + ncur
+        wake = jnp.where(nlast >= 0, nlast + gap, NO_WAKEUP)
+        return ((nbuf, nlast, nseq), WindowOutput(out, nbuf, wake))
+
+
+class FrequentWindow(WindowProcessor):
+    """Misra-Gries frequent window (reference: FrequentWindowProcessor.java):
+    keeps the latest event per key for up to n keys; a miss with full
+    counters decrements all counts and evicts keys reaching zero.  Per-event
+    sequential by nature — runs as a compiled lax.scan over the batch."""
+
+    name = "frequent"
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=1024):
+        super().__init__(schema, params, batch_capacity)
+        self.n = _param_int(params, 0)
+        if len(params) > 1:
+            self.key_positions = [
+                _param_var_position(params, i, schema, "frequent")
+                for i in range(1, len(params))]
+        else:
+            self.key_positions = list(range(len(schema.names)))
+
+    @property
+    def out_capacity(self):
+        return self.batch_capacity * (self.n + 1)
+
+    def init_state(self):
+        n = self.n
+        return (
+            jnp.zeros((n,), jnp.int64),                 # counts (0 = free)
+            jnp.full((n, len(self.key_positions)), 0, jnp.int64),  # keys
+            empty_buffer(self.schema, n),               # stored events
+            jnp.asarray(0, jnp.int64),
+        )
+
+    def _key_of(self, cols):
+        return jnp.stack(
+            [_as_i64_key(cols[p]) for p in self.key_positions], axis=-1)
+
+    def process(self, state, rows: Rows, now):
+        counts0, keys0, buf0, seq0 = state
+        n = self.n
+        B = rows.capacity
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        ev_keys = self._key_of(rows.cols)     # [B, K]
+
+        def step(carry, x):
+            counts, keys, bts, bgslot, bcols = carry
+            valid, key, ts, gslot, cols = x
+            match = jnp.logical_and(
+                counts > 0, jnp.all(keys == key[None, :], axis=1))
+            hit = jnp.any(match)
+            midx = jnp.argmax(match)
+            free = counts == 0
+            has_free = jnp.any(free)
+            fidx = jnp.argmax(free)
+
+            # case 1 hit: count+1, replace stored event (old expires)
+            # case 2 free: insert
+            # case 3 full miss: decrement all; evict zeros
+            do_insert = jnp.logical_and(valid, jnp.logical_or(hit, has_free))
+            slot = jnp.where(hit, midx, fidx)
+            dec = jnp.logical_and(valid,
+                                  jnp.logical_not(jnp.logical_or(hit,
+                                                                 has_free)))
+            ncounts = jnp.where(
+                dec, jnp.maximum(counts - 1, 0),
+                counts.at[slot].add(jnp.where(do_insert, 1, 0)))
+            evicted = jnp.logical_and(dec & (counts > 0), ncounts == 0)
+            # replaced stored event on hit -> expired
+            replaced = jnp.logical_and(hit & valid,
+                                       jnp.zeros((n,), jnp.bool_).at[
+                                           midx].set(True))
+            exp_mask = jnp.logical_or(evicted, replaced)
+            exp_ts, exp_gslot, exp_cols = bts, bgslot, bcols
+
+            nkeys = keys.at[slot].set(
+                jnp.where(do_insert, key, keys[slot]))
+            nbts = bts.at[slot].set(jnp.where(do_insert, ts, bts[slot]))
+            nbgslot = bgslot.at[slot].set(
+                jnp.where(do_insert, gslot, bgslot[slot]))
+            nbcols = tuple(
+                bc.at[slot].set(jnp.where(do_insert, c, bc[slot]))
+                for bc, c in zip(bcols, cols))
+            emit_cur = do_insert
+            return ((ncounts, nkeys, nbts, nbgslot, nbcols),
+                    (emit_cur, exp_mask, exp_ts, exp_gslot, exp_cols))
+
+        xs = (is_cur, ev_keys, rows.ts, rows.gslot, rows.cols)
+        carry0 = (counts0, keys0, buf0.ts, buf0.gslot, buf0.cols)
+        (counts, keys, bts, bgslot, bcols), outs = jax.lax.scan(
+            step, carry0, xs)
+        emit_cur, exp_mask, exp_ts, exp_gslot, exp_cols = outs
+
+        # sequence: per event i, expired emissions (n slots) then current
+        base = seq0 + jnp.arange(B, dtype=jnp.int64) * (n + 1)
+        cur_rows = Rows(
+            ts=rows.ts, kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+            valid=emit_cur, seq=base + n, gslot=rows.gslot, cols=rows.cols)
+        exp_rows = Rows(
+            ts=jnp.repeat(rows.ts, n),
+            kind=jnp.full((B * n,), ev.EXPIRED, jnp.int32),
+            valid=exp_mask.reshape(-1),
+            seq=(base[:, None] + jnp.arange(n, dtype=jnp.int64)[None, :]
+                 ).reshape(-1),
+            gslot=exp_gslot.reshape(-1),
+            cols=tuple(c.reshape(-1) for c in exp_cols))
+        out = sort_rows(concat_rows(exp_rows, cur_rows))
+
+        nbuf = Buffer(
+            ts=bts, add_seq=jnp.full((n,), BIG_SEQ, jnp.int64),
+            expire_seq=jnp.full((n,), BIG_SEQ, jnp.int64),
+            expire_ts=jnp.full((n,), BIG_SEQ, jnp.int64),
+            alive=counts > 0, gslot=bgslot, cols=bcols)
+        nseq = seq0 + B * (n + 1)
+        return ((counts, keys, nbuf, nseq),
+                WindowOutput(out, nbuf, jnp.asarray(NO_WAKEUP, jnp.int64)))
+
+
+class LossyFrequentWindow(FrequentWindow):
+    """Lossy-counting window (reference: LossyFrequentWindowProcessor.java).
+    Approximated here with the same Misra-Gries machinery sized at
+    ceil(1/support) counters — both give the classic heavy-hitter guarantee
+    (undercount bounded by N*support)."""
+
+    name = "lossyFrequent"
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=1024):
+        if not params or not isinstance(params[0], Constant):
+            raise ValueError("lossyFrequent needs a support fraction")
+        support = float(params[0].value)
+        if not (0.0 < support < 1.0):
+            raise ValueError("support must be in (0, 1)")
+        n = max(int(1.0 / support), 1)
+        rest = [p for p in params[1:]
+                if not (isinstance(p, Constant)
+                        and isinstance(p.value, float))]
+        fake = [Constant(n, "INT")] + rest
+        super().__init__(schema, fake, batch_capacity, capacity_hint)
+
+
+def _as_i64_key(col):
+    if col.dtype in (jnp.float32, jnp.float64):
+        return jax.lax.bitcast_convert_type(
+            col.astype(jnp.float64), jnp.int64)
+    return col.astype(jnp.int64)
+
+
+def register(window_types: dict) -> None:
+    for cls in (ExternalTimeWindow, ExternalTimeBatchWindow, TimeLengthWindow,
+                DelayWindow, ChunkBatchWindow, SortWindow, CronWindow,
+                SessionWindow, FrequentWindow, LossyFrequentWindow):
+        window_types[cls.name] = cls

@@ -1,0 +1,216 @@
+"""Extended window types (reference: TEST/query/window/
+{ExternalTimeWindow,ExternalTimeBatchWindow,TimeLengthWindow,DelayWindow,
+SortWindow,SessionWindow,FrequentWindow}TestCase behavioral assertions)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def build(ql, qname="q"):
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = {"in": [], "out": []}
+
+    def cb(ts, ins, outs):
+        if ins:
+            got["in"].extend(ins)
+        if outs:
+            got["out"].extend(outs)
+    rt.add_callback(qname, cb)
+    rt.start()
+    return manager, rt, got
+
+
+def test_external_time_sliding():
+    ql = """
+    @app:playback
+    define stream S (eventTime long, v int);
+    @info(name='q')
+    from S#window.externalTime(eventTime, 1000)
+    select v, sum(v) as total
+    insert all events into Out;
+    """
+    manager, rt, got = build(ql)
+    h = rt.get_input_handler("S")
+    h.send([1000, 1], timestamp=1000)
+    h.send([1500, 2], timestamp=1500)
+    # 2500 expires both earlier events (ts <= 2500-1000)
+    h.send([2500, 4], timestamp=2500)
+    rt.flush()
+    totals = [e.data[1] for e in got["in"]]
+    assert totals == [1, 3, 4]
+    assert len(got["out"]) == 2   # two expired
+    manager.shutdown()
+
+
+def test_external_time_batch():
+    ql = """
+    @app:playback
+    define stream S (eventTime long, v int);
+    @info(name='q')
+    from S#window.externalTimeBatch(eventTime, 1000)
+    select sum(v) as total
+    insert into Out;
+    """
+    manager, rt, got = build(ql)
+    h = rt.get_input_handler("S")
+    h.send([1000, 1], timestamp=1000)
+    h.send([1200, 2], timestamp=1200)
+    h.send([2100, 4], timestamp=2100)   # crosses [1000,2000) -> flush {1,2}
+    h.send([3100, 8], timestamp=3100)   # flush {4}
+    rt.flush()
+    totals = [e.data[0] for e in got["in"]]
+    assert totals[:2] == [1, 3]     # batch 1 flush (running per-row sums)
+    assert totals[2] == 4           # batch 2 flush
+    manager.shutdown()
+
+
+def test_time_length_window_length_eviction():
+    ql = """
+    define stream S (k string, v int);
+    @info(name='q')
+    from S#window.timeLength(600000, 2)
+    select k, sum(v) as total
+    insert all events into Out;
+    """
+    manager, rt, got = build(ql)
+    h = rt.get_input_handler("S")
+    h.send(["a", 1])
+    h.send(["b", 2])
+    h.send(["c", 4])     # evicts a
+    rt.flush()
+    totals = [e.data[1] for e in got["in"]]
+    assert totals == [1, 3, 6]
+    assert [e.data[0] for e in got["out"]] == ["a"]
+    manager.shutdown()
+
+
+def test_delay_window_playback():
+    ql = """
+    @app:playback
+    define stream S (k string, v int);
+    @info(name='q')
+    from S#window.delay(1000)
+    select k, v
+    insert into Out;
+    """
+    manager, rt, got = build(ql)
+    h = rt.get_input_handler("S")
+    h.send(["a", 1], timestamp=1000)
+    assert not got["in"]            # still delayed
+    h.send(["b", 2], timestamp=2600)  # advances clock past 1000+1000
+    rt.flush()
+    assert [e.data[0] for e in got["in"]] == ["a"]
+    manager.shutdown()
+
+
+def test_sort_window_keeps_smallest():
+    ql = """
+    define stream S (k string, v int);
+    @info(name='q')
+    from S#window.sort(2, v)
+    select k, v
+    insert all events into Out;
+    """
+    manager, rt, got = build(ql)
+    h = rt.get_input_handler("S")
+    h.send(["a", 50])
+    h.send(["b", 20])
+    h.send(["c", 40])    # evicts a (largest)
+    h.send(["d", 10])    # evicts c
+    rt.flush()
+    assert [e.data[0] for e in got["out"]] == ["a", "c"]
+    manager.shutdown()
+
+
+def test_sort_window_desc():
+    ql = """
+    define stream S (k string, v int);
+    @info(name='q')
+    from S#window.sort(2, v, 'desc')
+    select k, v
+    insert all events into Out;
+    """
+    manager, rt, got = build(ql)
+    h = rt.get_input_handler("S")
+    h.send(["a", 50])
+    h.send(["b", 20])
+    h.send(["c", 40])    # evicts b (smallest)
+    rt.flush()
+    assert [e.data[0] for e in got["out"]] == ["b"]
+    manager.shutdown()
+
+
+def test_batch_window_chunk():
+    ql = """
+    define stream S (k string, v int);
+    @info(name='q')
+    from S#window.batch()
+    select k, v
+    insert all events into Out;
+    """
+    manager, rt, got = build(ql)
+    h = rt.get_input_handler("S")
+    h.send([["a", 1], ["b", 2]])     # one chunk
+    h.send([["c", 3]])               # next chunk expires previous
+    rt.flush()
+    assert [e.data[0] for e in got["in"]] == ["a", "b", "c"]
+    assert [e.data[0] for e in got["out"]] == ["a", "b"]
+    manager.shutdown()
+
+
+def test_session_window_playback():
+    ql = """
+    @app:playback
+    define stream S (k string, v int);
+    @info(name='q')
+    from S#window.session(1000)
+    select k, v
+    insert expired events into Out;
+    """
+    manager, rt, got = build(ql)
+    h = rt.get_input_handler("S")
+    h.send(["a", 1], timestamp=1000)
+    h.send(["b", 2], timestamp=1500)
+    # gap passes; next event first fires the session-expiry timer
+    h.send(["c", 3], timestamp=5000)
+    rt.flush()
+    assert [e.data[0] for e in got["out"]] == ["a", "b"]
+    manager.shutdown()
+
+
+def test_frequent_window():
+    ql = """
+    define stream S (k string, v int);
+    @info(name='q')
+    from S#window.frequent(1, k)
+    select k, v
+    insert all events into Out;
+    """
+    manager, rt, got = build(ql)
+    h = rt.get_input_handler("S")
+    h.send(["a", 1])
+    h.send(["a", 2])     # replaces stored a(1) -> expired
+    h.send(["b", 3])     # miss with full counters -> decrement, no insert
+    rt.flush()
+    ins = [e.data for e in got["in"]]
+    assert ins == [["a", 1], ["a", 2]]
+    assert [e.data for e in got["out"]] == [["a", 1]]
+    manager.shutdown()
+
+
+def test_lossy_frequent_window():
+    ql = """
+    define stream S (k string, v int);
+    @info(name='q')
+    from S#window.lossyFrequent(0.5, k)
+    select k, v
+    insert into Out;
+    """
+    manager, rt, got = build(ql)
+    h = rt.get_input_handler("S")
+    for _ in range(3):
+        h.send(["x", 1])
+    rt.flush()
+    assert len(got["in"]) >= 1
+    manager.shutdown()
