@@ -42,6 +42,7 @@ class JoinSide:
     schema: ev.Schema
     window: Optional[WindowProcessor]   # None => table / named window side
     is_table: bool = False
+    is_aggregation: bool = False
     pre_filters: List[CompiledExpr] = dataclasses.field(default_factory=list)
 
 
@@ -61,12 +62,22 @@ class PlannedJoinQuery:
     init_state: Callable
     batch_capacity: int
     needs_timer: bool
+    within_range: Optional[Tuple[int, int]] = None
+    per_duration: Optional[str] = None
 
 
 def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
-             scope: Scope, window_capacity_hint: int) -> JoinSide:
+             scope: Scope, window_capacity_hint: int,
+             aggregations=None) -> JoinSide:
     sid = sis.stream_id
     key = sis.stream_reference_id or sid
+    if aggregations and sid in aggregations:
+        # aggregation side: columnar snapshot per step (reference:
+        # AggregationRuntime.find via AggregateWindowProcessor adapter)
+        schema = aggregations[sid].make_schema()
+        scope.add_source(key, schema, alias=None)
+        return JoinSide(sid, key, schema, None, is_table=True,
+                        is_aggregation=True)
     is_table = sid in tables
     schema = tables[sid].schema if is_table else schemas[sid]
     scope.add_source(key, schema, alias=None)
@@ -74,17 +85,18 @@ def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
     if not is_table:
         wh = sis.window_handler
         if wh is None:
-            raise CompileError(
-                f"join side {sid!r} needs a window (or must be a table)")
-        win = create_window(
-            (wh.namespace + ":" if wh.namespace else "") + wh.name,
-            schema, wh.parameters, batch_capacity,
-            capacity_hint=window_capacity_hint)
-        if not isinstance(win, type(win)) or win.name not in (
-                "length", "time"):
-            raise CompileError(
-                f"join windows must be sliding (length/time), got "
-                f"{win.name!r}")
+            # windowless stream side: valid when probing a table-like side
+            # (reference: JoinInputStreamParser wraps it in an empty window)
+            win = NoWindow(schema, [], batch_capacity)
+        else:
+            win = create_window(
+                (wh.namespace + ":" if wh.namespace else "") + wh.name,
+                schema, wh.parameters, batch_capacity,
+                capacity_hint=window_capacity_hint)
+            if win.name not in ("length", "time"):
+                raise CompileError(
+                    f"join windows must be sliding (length/time), got "
+                    f"{win.name!r}")
     side = JoinSide(sid, key, schema, win, is_table)
     return side
 
@@ -97,17 +109,29 @@ def plan_join_query(
     interner: ev.StringInterner,
     batch_capacity: int = 512,
     window_capacity_hint: int = 512,
+    aggregations=None,
 ) -> PlannedJoinQuery:
     jis = query.input_stream
     assert isinstance(jis, JoinInputStream)
     scope = Scope()
     scope.interner = interner
     left = _mk_side(jis.left_input_stream, schemas, tables, batch_capacity,
-                    scope, window_capacity_hint)
+                    scope, window_capacity_hint, aggregations)
     right = _mk_side(jis.right_input_stream, schemas, tables, batch_capacity,
-                     scope, window_capacity_hint)
+                     scope, window_capacity_hint, aggregations)
     if left.is_table and right.is_table:
         raise CompileError("cannot join two tables in a streaming query")
+    if not left.is_table and not right.is_table and (
+            isinstance(left.window, NoWindow) or
+            isinstance(right.window, NoWindow)):
+        raise CompileError(
+            "stream-stream joins need a window on each side")
+
+    within_range = per_duration = None
+    if left.is_aggregation or right.is_aggregation:
+        from .aggregation import parse_per, parse_within
+        within_range = parse_within(jis.within)
+        per_duration = parse_per(jis.per)
 
     # side filters ([filter] before window)
     for side, sis in ((left, jis.left_input_stream),
@@ -254,6 +278,7 @@ def plan_join_query(
 
     return PlannedJoinQuery(
         name=name, left=left, right=right, join_type=jt, trigger=trigger,
+        within_range=within_range, per_duration=per_duration,
         out_schema=out_schema,
         output_target=out_target,
         output_event_type=(query.output_stream.output_event_type

@@ -328,6 +328,24 @@ def _emit_output_sync(qr, out, now: int) -> None:
     _deliver_pairs(qr, pairs, now)
 
 
+def _aggregation_view(agg, per: str, within) -> Tuple:
+    """Padded columnar snapshot of an aggregation's buckets for the join
+    device step (reference: AggregateWindowProcessor adapter role)."""
+    ts, cols = agg.snapshot_rows(per, within)
+    n = ts.shape[0]
+    cap = ev.bucket_size(max(n, 1))
+    valid = np.zeros((cap,), np.bool_)
+    valid[:n] = True
+    pts = np.zeros((cap,), np.int64)
+    pts[:n] = ts
+    padded = []
+    for c in cols:
+        a = np.zeros((cap,), c.dtype)
+        a[:n] = c
+        padded.append(jax.numpy.asarray(a))
+    return (tuple(padded), jax.numpy.asarray(pts), jax.numpy.asarray(valid))
+
+
 def _deliver_pairs(qr, pairs, now: int) -> None:
     """Terminal delivery: query callbacks + downstream routing (reference:
     OutputCallback implementations, CORE/query/output/callback/*)."""
@@ -394,6 +412,9 @@ class JoinQueryRuntime:
     def _other_table(self, is_left):
         p = self.planned
         other = p.right if is_left else p.left
+        if other.is_aggregation:
+            agg = self.app.aggregations[other.stream_id]
+            return _aggregation_view(agg, p.per_duration, p.within_range)
         if other.is_table:
             t = self.app.tables[other.stream_id]
             return (t.cols, t.ts, t.valid)
@@ -728,6 +749,22 @@ class SiddhiAppRuntime:
             self.schemas[wid] = schema
             self.named_windows[wid] = NamedWindowRuntime(wdef, schema, self)
 
+        # incremental aggregations (reference: CORE/aggregation/*)
+        from .aggregation import AggregationRuntime
+        self.aggregations: Dict[str, AggregationRuntime] = {}
+        for aid, adef in app.aggregation_definition_map.items():
+            agg = AggregationRuntime(adef, self)
+            self.aggregations[aid] = agg
+
+            class _ASub:
+                def __init__(self, a):
+                    self._a = a
+
+                def process_staged(self, staged, now):
+                    self._a.process_staged(staged, now)
+
+            self.junctions[agg.input_stream_id].subscribe_query(_ASub(agg))
+
         # triggers define a stream `<id> (triggered_time long)` (reference:
         # QAPI/definition/TriggerDefinition -> DefinitionParserHelper)
         self.triggers: Dict[str, TriggerRuntime] = {}
@@ -888,7 +925,8 @@ class SiddhiAppRuntime:
     def _add_join_query(self, q: Query, name: str):
         from .join import plan_join_query
         planned = plan_join_query(q, name, self.schemas, self.tables,
-                                  self.interner)
+                                  self.interner,
+                                  aggregations=self.aggregations)
         runtime = JoinQueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
         self.query_runtimes[name] = runtime
@@ -1151,9 +1189,12 @@ class SiddhiAppRuntime:
             windows = {
                 wid: jax.tree.map(lambda x: np.asarray(x), nw.state)
                 for wid, nw in self.named_windows.items()}
+            aggs = {aid: {d: dict(s) for d, s in a.stores.items()}
+                    for aid, a in self.aggregations.items()}
             payload = {
                 "states": states,
                 "windows": windows,
+                "aggregations": aggs,
                 "interner": list(self.interner._to_str),
             }
             return pickle.dumps(payload)
@@ -1176,6 +1217,10 @@ class SiddhiAppRuntime:
                 if nw is not None:
                     nw.state = jax.tree.map(
                         lambda x: jax.numpy.asarray(x), wstate)
+            for aid, stores in payload.get("aggregations", {}).items():
+                agg = self.aggregations.get(aid)
+                if agg is not None:
+                    agg.stores = {d: dict(s) for d, s in stores.items()}
 
 
 class SiddhiManager:
