@@ -1,0 +1,328 @@
+"""On-demand (store) queries: one-shot reads/writes on tables, named windows
+and aggregations.
+
+Reference behavior (what): CORE/util/parser/OnDemandQueryParser.java:101 and
+CORE/query/{Find,Select,Insert,Update,Delete,UpdateOrInsert}OnDemandQueryRuntime
+— `runtime.query("from T on cond select ...")` executes immediately against
+the store's current contents and returns Event[].
+
+TPU-native design (how): the store's contents are already columnar device/
+host arrays (table rows, window buffer, aggregation bucket snapshot); an
+on-demand query is one vectorized filter + reduce over them — no object
+iteration.  Aggregates here are terminal (one result per group), not
+incremental, so they reduce with plain segmented numpy ops.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..query_api.expression import AttributeFunction, Variable
+from . import event as ev
+from .executor import CompileError, Scope, compile_expression
+
+
+def _store_rows(rt, store_id: str, within, per):
+    """-> (schema, cols [np arrays], valid mask, scope_key)."""
+    if store_id in rt.tables:
+        t = rt.tables[store_id]
+        return (t.schema, [np.asarray(c) for c in t.cols],
+                np.asarray(t.valid))
+    if store_id in rt.named_windows:
+        nw = rt.named_windows[store_id]
+        buf = nw.wproc.current_buffer(nw.state)
+        if buf is None:
+            raise CompileError(
+                f"window type {nw.wproc.name!r} does not expose contents "
+                f"for on-demand queries")
+        return (nw.schema, [np.asarray(c) for c in buf.cols],
+                np.asarray(buf.alive))
+    if store_id in rt.aggregations:
+        from .aggregation import parse_per, parse_within
+        agg = rt.aggregations[store_id]
+        rng = parse_within(within) if within is not None else None
+        if per is None:
+            raise CompileError("aggregation on-demand query needs `per`")
+        ts, cols = agg.snapshot_rows(parse_per(per), rng)
+        return (agg.make_schema(), [np.asarray(c) for c in cols],
+                np.ones((ts.shape[0],), np.bool_))
+    raise CompileError(f"no table/window/aggregation named {store_id!r}")
+
+
+_AGG_FNS = ("sum", "count", "avg", "min", "max", "distinctCount")
+
+
+def _split_selection(selector, schema) -> Tuple[list, bool]:
+    """[(name, expr, agg_fn_or_None)] for each output."""
+    out = []
+    has_agg = False
+    sel_list = selector.selection_list
+    if not sel_list:  # select *
+        return ([(n, Variable(n), None) for n in schema.names], False)
+    for oa in sel_list:
+        e = oa.expression
+        name = oa.rename or (e.attribute_name if isinstance(e, Variable)
+                             else "expr")
+        if isinstance(e, AttributeFunction) and not e.namespace and \
+                e.name in _AGG_FNS:
+            has_agg = True
+            out.append((name, e, e.name))
+        else:
+            out.append((name, e, None))
+    return out, has_agg
+
+
+def execute_on_demand(rt, oq) -> List[ev.Event]:
+    """Entry point used by SiddhiAppRuntime.query()."""
+    if oq.type == "INSERT" and oq.input_store is None:
+        return _insert_constant(rt, oq)
+    store = oq.input_store
+    schema, cols, valid, = _store_rows(rt, store.store_id, store.within,
+                                       store.per)
+    key = store.alias if getattr(store, "alias", None) else store.store_id
+
+    scope = Scope()
+    scope.interner = rt.interner
+    scope.add_source(key, schema)
+
+    env = {key: tuple(np.asarray(c) for c in cols),
+           "__ts__": np.zeros(valid.shape, np.int64),
+           "__now__": np.int64(rt.timestamp_millis())}
+    mask = valid.copy()
+    if store.on_condition is not None:
+        c = compile_expression(store.on_condition, scope)
+        if c.type != "BOOL":
+            raise CompileError("on-condition must be boolean")
+        mask &= np.asarray(c.fn(env)).astype(bool)
+
+    if oq.type == "FIND":
+        return _find(rt, oq, scope, schema, env, mask, key)
+
+    # write ops route the found rows through the table-op machinery
+    sel_events = _find(rt, oq, scope, schema, env, mask, key)
+    tgt = oq.output_stream.target_id
+    if tgt not in rt.tables:
+        if oq.type == "INSERT":
+            raise CompileError(f"no table named {tgt!r}")
+        raise CompileError(f"on-demand {oq.type} target must be a table")
+    _apply_write(rt, oq, sel_events, schema, key)
+    return sel_events
+
+
+def _result_schema(names, types, interner):
+    from ..query_api.definition import StreamDefinition
+    sdef = StreamDefinition("#ondemand")
+    for n, t in zip(names, types):
+        sdef.attribute(n, t)
+    return ev.Schema(sdef, interner)
+
+
+def _find(rt, oq, scope, schema, env, mask, key) -> List[ev.Event]:
+    sel = oq.selector
+    items, has_agg = _split_selection(sel, schema)
+    n_rows = int(mask.sum())
+
+    # group-by columns
+    gb_names = [v.attribute_name for v in (sel.group_by_list or [])]
+    gb_pos = [schema.position(n) for n in gb_names]
+
+    idx = np.nonzero(mask)[0]
+    gcols = [np.asarray(env[key][p])[idx] for p in gb_pos]
+    if gb_pos:
+        stacked = np.stack([c.view(np.int64) if c.dtype.kind == "f"
+                            else c.astype(np.int64) for c in gcols])
+        uniq, inv = np.unique(stacked, axis=1, return_inverse=True)
+        n_groups = uniq.shape[1]
+    else:
+        inv = np.zeros((idx.size,), np.int64)
+        n_groups = 1 if (has_agg and idx.size) or not has_agg else 0
+
+    out_cols = []
+    out_names = []
+    out_types = []
+    for name, expr, agg in items:
+        out_names.append(name)
+        if agg is None:
+            c = compile_expression(expr, scope)
+            raw = np.asarray(c.fn(env))
+            if raw.ndim == 0:
+                raw = np.broadcast_to(raw, mask.shape)
+            vals = raw[idx] if idx.size else \
+                np.zeros((0,), ev.np_dtype(c.type))
+            out_types.append(c.type)
+            if has_agg or gb_pos:
+                # per-group representative (first row of group)
+                rep = np.zeros((n_groups,), vals.dtype if idx.size else
+                               ev.np_dtype(c.type))
+                if idx.size:
+                    first = {}
+                    for r, g in enumerate(inv):
+                        if g not in first:
+                            first[g] = r
+                    for g, r in first.items():
+                        rep[g] = vals[r]
+                out_cols.append(rep)
+            else:
+                out_cols.append(vals)
+            continue
+        # aggregate
+        if agg == "count":
+            vals = np.ones((idx.size,), np.float64)
+            out_types.append("LONG")
+        else:
+            c = compile_expression(expr.parameters[0], scope)
+            vals = np.asarray(c.fn(env), np.float64)[idx] if idx.size else \
+                np.zeros((0,), np.float64)
+            out_types.append("DOUBLE" if agg in ("avg",) else
+                             ("LONG" if c.type in ("INT", "LONG") and
+                              agg in ("sum", "min", "max") else c.type
+                              if agg in ("min", "max") else "DOUBLE"))
+        acc = np.zeros((max(n_groups, 1),), np.float64)
+        if agg in ("sum", "count"):
+            np.add.at(acc, inv, vals)
+        elif agg == "avg":
+            cnt = np.zeros_like(acc)
+            np.add.at(acc, inv, vals)
+            np.add.at(cnt, inv, np.ones_like(vals))
+            acc = np.where(cnt > 0, acc / np.maximum(cnt, 1), 0.0)
+        elif agg == "min":
+            acc[:] = np.inf
+            np.minimum.at(acc, inv, vals)
+        elif agg == "max":
+            acc[:] = -np.inf
+            np.maximum.at(acc, inv, vals)
+        elif agg == "distinctCount":
+            acc = np.zeros((max(n_groups, 1),), np.float64)
+            for g in range(n_groups):
+                acc[g] = np.unique(vals[inv == g]).size
+        out_cols.append(acc[:n_groups])
+
+    res_schema = _result_schema(out_names, out_types, rt.interner)
+    n_out = n_groups if (has_agg or gb_pos) else idx.size
+
+    # having / order by / limit
+    henv = {"#out": tuple(np.asarray(c) for c in out_cols)}
+    keep = np.ones((n_out,), bool)
+    if sel.having_expression is not None:
+        hscope = Scope()
+        hscope.interner = rt.interner
+        hscope.add_source("#out", res_schema)
+        hc = compile_expression(sel.having_expression, hscope)
+        keep &= np.asarray(hc.fn(henv)).astype(bool)[:n_out]
+    sel_idx = np.nonzero(keep)[0]
+    if sel.order_by_list:
+        keys = []
+        for ob in reversed(sel.order_by_list):
+            p = out_names.index(ob.variable.attribute_name)
+            col = np.asarray(out_cols[p])[sel_idx]
+            keys.append(-col if ob.order == "DESC" else col)
+        order = np.lexsort(keys)
+        sel_idx = sel_idx[order]
+    if sel.limit is not None:
+        off = sel.offset or 0
+        sel_idx = sel_idx[off:off + sel.limit]
+    elif sel.offset:
+        sel_idx = sel_idx[sel.offset:]
+
+    now = rt.timestamp_millis()
+    events = []
+    for r in sel_idx:
+        data = []
+        for c, t in zip(out_cols, out_types):
+            v = c[r]
+            data.append(res_schema.decode_value(t, v))
+        events.append(ev.Event(now, data))
+    return events
+
+
+def _insert_constant(rt, oq) -> List[ev.Event]:
+    """`select <constants> insert into T` form."""
+    tgt = oq.output_stream.target_id
+    if tgt not in rt.tables:
+        raise CompileError(f"no table named {tgt!r}")
+    table = rt.tables[tgt]
+    scope = Scope()
+    scope.interner = rt.interner
+    if not oq.selector.selection_list:
+        raise CompileError("constant insert needs an explicit select list")
+    env = {"__ts__": np.zeros((1,), np.int64),
+           "__now__": np.int64(rt.timestamp_millis())}
+    data = []
+    for oa in oq.selector.selection_list:
+        c = compile_expression(oa.expression, scope)
+        v = np.asarray(c.fn(env))
+        data.append(table.schema.decode_value(c.type, v.reshape(()).item()
+                                              if v.shape == () or v.size == 1
+                                              else v.flat[0]))
+    e = ev.Event(rt.timestamp_millis(), data)
+    staged = ev.pack_np(table.schema, [e])
+    batch = staged.to_device(table.schema)
+    table.insert(batch, staged)
+    return [e]
+
+
+def _apply_write(rt, oq, sel_events, store_schema, key) -> None:
+    """UPDATE / DELETE / UPDATE_OR_INSERT / INSERT with a FROM store."""
+    from ..query_api.query import (
+        DeleteStream,
+        UpdateOrInsertStream,
+        UpdateStream,
+    )
+    out_stream = oq.output_stream
+    tgt = out_stream.target_id
+    table = rt.tables[tgt]
+    # build an output-events scope like the streaming table-op path
+    items, _ = _split_selection(oq.selector, store_schema)
+    names = [n for n, _, _ in items]
+    if not sel_events:
+        if oq.type != "INSERT":
+            return
+    # re-stage selected events columnar
+    from ..query_api.definition import StreamDefinition
+    sdef = StreamDefinition("#sel")
+    if sel_events:
+        for n, v in zip(names, sel_events[0].data):
+            t = ("STRING" if isinstance(v, str) else
+                 "DOUBLE" if isinstance(v, float) else "LONG")
+            sdef.attribute(n, t)
+    sschema = ev.Schema(sdef, rt.interner)
+    staged = ev.pack_np(sschema, sel_events)
+    batch = staged.to_device(sschema)
+
+    if oq.type == "INSERT":
+        if len(table.schema.names) != len(names):
+            raise CompileError("insert arity does not match table")
+        tstaged = ev.pack_np(table.schema, sel_events)
+        table.insert(tstaged.to_device(table.schema), tstaged)
+        return
+
+    cscope = Scope()
+    cscope.interner = rt.interner
+    cscope.add_source("#sel", sschema)
+    cscope.add_source(tgt, table.schema, default=False)
+    cond_expr = (out_stream.on_delete_expression
+                 if isinstance(out_stream, DeleteStream)
+                 else out_stream.on_update_expression)
+    cond = compile_expression(cond_expr, cscope)
+    set_fns = []
+    us = getattr(out_stream, "update_set", None)
+    if us is not None:
+        for sa in us.set_attribute_list:
+            pos = table.schema.position(sa.table_variable.attribute_name)
+            e = compile_expression(sa.value_expression, cscope)
+            set_fns.append((pos, e.fn))
+    elif not isinstance(out_stream, DeleteStream):
+        from ..query_api.expression import Variable as V
+        for n in table.schema.names:
+            if n in sschema.names:
+                e = compile_expression(V(n, stream_id="#sel"), cscope)
+                set_fns.append((table.schema.position(n), e.fn))
+
+    if isinstance(out_stream, DeleteStream):
+        table.delete_where(cond, "#sel", batch)
+    elif isinstance(out_stream, UpdateOrInsertStream):
+        table.update_where(cond, "#sel", batch, set_fns, upsert=True,
+                           staged=staged)
+    else:
+        table.update_where(cond, "#sel", batch, set_fns)

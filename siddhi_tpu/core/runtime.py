@@ -1172,6 +1172,19 @@ class SiddhiAppRuntime:
                 self._scheduler.drain_playback(now)
             junction.publish(events, now)
 
+    # -- on-demand (store) queries --------------------------------------------
+    def query(self, q) -> List[ev.Event]:
+        """Execute a one-shot store query against tables/windows/aggregations
+        (reference: SiddhiAppRuntimeImpl.query :304-367)."""
+        from ..query_api.query import OnDemandQuery
+        from .ondemand import execute_on_demand
+        if isinstance(q, str):
+            from ..compiler import SiddhiCompiler
+            q = SiddhiCompiler.parse_on_demand_query(q)
+        assert isinstance(q, OnDemandQuery)
+        with self._lock:
+            return execute_on_demand(self, q)
+
     # -- snapshot/restore ------------------------------------------------------
     def snapshot(self) -> bytes:
         """Full state snapshot (reference: SnapshotService.fullSnapshot

@@ -141,6 +141,14 @@ class WindowProcessor:
     def process(self, state, rows: Rows, now) -> Tuple[Any, WindowOutput]:
         raise NotImplementedError
 
+    def current_buffer(self, state) -> Optional[Buffer]:
+        """Current window contents for on-demand reads/joins (reference:
+        FindableProcessor.find).  Works for every window whose state leads
+        with its Buffer."""
+        if isinstance(state, tuple) and state and isinstance(state[0], Buffer):
+            return state[0]
+        return None
+
 
 def _param_int(params, i, default=None):
     if i >= len(params):

@@ -1,0 +1,134 @@
+"""On-demand (store) queries (reference: TEST/store/* — find/insert/update/
+delete against tables, windows and aggregations)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+T0 = 1590969600000  # 2020-06-01 UTC
+
+
+def _table_rt():
+    ql = """
+    define stream In (symbol string, price double, volume long);
+    define table StockTable (symbol string, price double, volume long);
+    from In select symbol, price, volume insert into StockTable;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    h = rt.get_input_handler("In")
+    h.send(["IBM", 75.5, 100])
+    h.send(["WSO2", 57.6, 200])
+    h.send(["GOOG", 120.0, 50])
+    rt.flush()
+    return manager, rt
+
+
+def test_find_all():
+    manager, rt = _table_rt()
+    events = rt.query("from StockTable select symbol, volume")
+    assert sorted(e.data for e in events) == [
+        ["GOOG", 50], ["IBM", 100], ["WSO2", 200]]
+    manager.shutdown()
+
+
+def test_find_with_condition():
+    manager, rt = _table_rt()
+    events = rt.query(
+        "from StockTable on volume > 80 select symbol, price")
+    rows = sorted(e.data for e in events)
+    assert [r[0] for r in rows] == ["IBM", "WSO2"]
+    # DOUBLE is stored as f32 on device (TPU-native float policy)
+    assert rows[0][1] == pytest.approx(75.5, rel=1e-6)
+    assert rows[1][1] == pytest.approx(57.6, rel=1e-6)
+    manager.shutdown()
+
+
+def test_find_aggregate():
+    manager, rt = _table_rt()
+    events = rt.query(
+        "from StockTable select sum(volume) as total, avg(price) as ap")
+    assert len(events) == 1
+    assert events[0].data[0] == 350
+    assert events[0].data[1] == pytest.approx((75.5 + 57.6 + 120.0) / 3)
+    manager.shutdown()
+
+
+def test_find_group_by_having_order():
+    ql = """
+    define stream In (sym string, v long);
+    define table T (sym string, v long);
+    from In select sym, v insert into T;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    h = rt.get_input_handler("In")
+    for sym, v in [("a", 1), ("a", 2), ("b", 10), ("c", 3), ("c", 4)]:
+        h.send([sym, v])
+    rt.flush()
+    events = rt.query(
+        "from T select sym, sum(v) as total group by sym "
+        "having total > 2 order by total desc")
+    assert [e.data for e in events] == [["b", 10], ["c", 7], ["a", 3]]
+    manager.shutdown()
+
+
+def test_ondemand_delete():
+    manager, rt = _table_rt()
+    rt.query("from StockTable delete StockTable on "
+             "StockTable.symbol == 'IBM'")
+    left = rt.query("from StockTable select symbol")
+    assert sorted(e.data[0] for e in left) == ["GOOG", "WSO2"]
+    manager.shutdown()
+
+
+def test_ondemand_update():
+    manager, rt = _table_rt()
+    rt.query("from StockTable on symbol == 'IBM' "
+             "select symbol, 999.0 as price "
+             "update StockTable set StockTable.price = price "
+             "on StockTable.symbol == symbol")
+    rows = rt.query("from StockTable on symbol == 'IBM' select price")
+    assert rows[0].data[0] == 999.0
+    manager.shutdown()
+
+
+def test_ondemand_window_read():
+    ql = """
+    define stream In (k string, v long);
+    define window W (k string, v long) length(2) output all events;
+    from In select k, v insert into W;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(3):
+        h.send([str(i), i])
+    rt.flush()
+    events = rt.query("from W select k, v")
+    assert sorted(e.data[1] for e in events) == [1, 2]
+    manager.shutdown()
+
+
+def test_ondemand_aggregation_read():
+    ql = """
+    define stream S (k string, v long, ts long);
+    define aggregation A
+    from S select k, sum(v) as total group by k
+    aggregate by ts every seconds...days;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["x", 7, T0])
+    h.send(["x", 3, T0 + 1000])
+    h.send(["y", 5, T0])
+    rt.flush()
+    events = rt.query(
+        'from A within "2020-06-01 00:00:00", "2020-06-02 00:00:00" '
+        'per "days" select k, total')
+    assert sorted(e.data for e in events) == [["x", 10], ["y", 5]]
+    manager.shutdown()
