@@ -776,6 +776,22 @@ class SiddhiAppRuntime:
                 self._define_stream_runtime(sdef)
             self.triggers[tid] = TriggerRuntime(tdef, self)
 
+        # sources & sinks from @source/@sink stream annotations (reference:
+        # DefinitionParserHelper.addEventSource/addEventSink)
+        from ..io.sink import SinkRuntime
+        from ..io.source import SourceRuntime
+        self.sources: List[SourceRuntime] = []
+        self.sinks: List[SinkRuntime] = []
+        for sid, sdef in list(app.stream_definition_map.items()):
+            for ann in sdef.annotations:
+                n = ann.name.lower()
+                if n == "source":
+                    self.sources.append(SourceRuntime(sid, ann, self))
+                elif n == "sink":
+                    sk = SinkRuntime(sid, ann, self)
+                    self.sinks.append(sk)
+                    self.junctions[sid].subscribe_callback(sk)
+
         # plan queries
         self.query_runtimes: Dict[str, QueryRuntime] = {}
         self._timed_limiters: List = []
@@ -1072,6 +1088,10 @@ class SiddhiAppRuntime:
             self._scheduler.start()
             self._started = True
             now = self.timestamp_millis()
+            for sk in self.sinks:
+                sk.start()
+            for src in self.sources:
+                src.start()
             for tr in self.triggers.values():
                 tr.start(now)
             for lim in self._timed_limiters:
@@ -1079,9 +1099,22 @@ class SiddhiAppRuntime:
 
     def shutdown(self) -> None:
         if self._started:
+            for src in self.sources:
+                src.stop()
+            for sk in self.sinks:
+                sk.stop()
             self._drainer.stop()
             self._scheduler.stop()
             self._started = False
+
+    def pause_sources(self) -> None:
+        """reference: SiddhiAppRuntimeImpl pauses Sources around persist."""
+        for src in self.sources:
+            src.pause()
+
+    def resume_sources(self) -> None:
+        for src in self.sources:
+            src.resume()
 
     def flush(self) -> None:
         """Wait until all asynchronously emitted output has been delivered."""

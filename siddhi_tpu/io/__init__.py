@@ -1,0 +1,19 @@
+"""I/O layer: sources, sinks, mappers, in-memory broker.
+
+Reference: CORE/stream/input/source/*, CORE/stream/output/sink/*,
+CORE/util/transport/InMemoryBroker.java.
+"""
+from .broker import InMemoryBroker
+from .mappers import SINK_MAPPERS, SOURCE_MAPPERS
+from .sink import SinkRuntime, register_sink_type
+from .source import SourceRuntime, register_source_type
+
+__all__ = [
+    "InMemoryBroker",
+    "SourceRuntime",
+    "SinkRuntime",
+    "SOURCE_MAPPERS",
+    "SINK_MAPPERS",
+    "register_source_type",
+    "register_sink_type",
+]

@@ -1,0 +1,64 @@
+"""In-process topic broker (reference: CORE/util/transport/
+InMemoryBroker.java:29 — the reference's only built-in "cluster" transport,
+connecting apps in the same process)."""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List
+
+
+class InMemoryBroker:
+    _subscribers: Dict[str, List] = {}
+    _lock = threading.RLock()
+
+    class Subscriber:
+        """Match the reference's subscriber interface: onMessage + topic."""
+
+        def on_message(self, msg: Any) -> None:
+            raise NotImplementedError
+
+        def get_topic(self) -> str:
+            raise NotImplementedError
+
+    @classmethod
+    def subscribe(cls, subscriber) -> None:
+        with cls._lock:
+            cls._subscribers.setdefault(
+                subscriber.get_topic(), []).append(subscriber)
+
+    @classmethod
+    def unsubscribe(cls, subscriber) -> None:
+        with cls._lock:
+            subs = cls._subscribers.get(subscriber.get_topic(), [])
+            if subscriber in subs:
+                subs.remove(subscriber)
+
+    @classmethod
+    def publish(cls, topic: str, msg: Any) -> None:
+        with cls._lock:
+            subs = list(cls._subscribers.get(topic, []))
+        for s in subs:
+            s.on_message(msg)
+
+    @classmethod
+    def clear(cls) -> None:
+        with cls._lock:
+            cls._subscribers.clear()
+
+
+class _FnSubscriber(InMemoryBroker.Subscriber):
+    def __init__(self, topic: str, fn: Callable[[Any], None]):
+        self._topic = topic
+        self._fn = fn
+
+    def on_message(self, msg):
+        self._fn(msg)
+
+    def get_topic(self):
+        return self._topic
+
+
+def subscribe_fn(topic: str, fn: Callable[[Any], None]):
+    sub = _FnSubscriber(topic, fn)
+    InMemoryBroker.subscribe(sub)
+    return sub

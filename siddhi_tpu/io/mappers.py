@@ -1,0 +1,209 @@
+"""Source/sink mappers (reference: CORE/stream/input/source/SourceMapper.java:39,
+CORE/stream/output/sink/SinkMapper.java:44 and the passThrough mapper pair in
+core; json/text/keyvalue mirror the official extension mappers' observable
+behavior).
+
+A SourceMapper turns a transport payload into attribute rows; a SinkMapper
+turns output events into payloads.  `@map(type='...')` selects one;
+`@attributes(...)` remaps source fields; `@payload(...)` templates sink
+output.
+"""
+from __future__ import annotations
+
+import json as _json
+import re
+from typing import Any, Dict, List, Optional
+
+from ..core import event as ev
+
+
+class SourceMapper:
+    def __init__(self, schema: ev.Schema, map_annotation):
+        self.schema = schema
+        self.ann = map_annotation
+        # @attributes(a='path', b='path') or positional
+        self.attribute_paths: Optional[List[str]] = None
+        if map_annotation is not None:
+            for sub in map_annotation.annotations:
+                if sub.name.lower() == "attributes":
+                    paths = []
+                    for name in schema.names:
+                        if name in sub.elements:
+                            paths.append(sub.elements[name])
+                        else:
+                            paths.append(None)
+                    pos = [v for k, v in sub.elements.items() if k is None]
+                    if pos:
+                        paths = list(pos) + paths[len(pos):]
+                    self.attribute_paths = paths
+
+    def map(self, payload: Any, timestamp: int) -> List[ev.Event]:
+        raise NotImplementedError
+
+
+class PassThroughSourceMapper(SourceMapper):
+    """payload is Event / data list / list of those (reference:
+    PassThroughSourceMapper.java)."""
+
+    def map(self, payload, timestamp):
+        if isinstance(payload, ev.Event):
+            return [payload]
+        if isinstance(payload, (list, tuple)):
+            if payload and isinstance(payload[0], (list, tuple, ev.Event)):
+                return [p if isinstance(p, ev.Event)
+                        else ev.Event(timestamp, list(p)) for p in payload]
+            return [ev.Event(timestamp, list(payload))]
+        raise ValueError(f"passThrough cannot map {type(payload).__name__}")
+
+
+class JsonSourceMapper(SourceMapper):
+    """JSON object / array / string payloads keyed by attribute name, with
+    optional `$.path` expressions from @attributes (reference: the
+    siddhi-map-json extension's default mapping)."""
+
+    def _lookup(self, obj: Dict, path: str):
+        cur = obj
+        for part in path.lstrip("$.").split("."):
+            if not isinstance(cur, dict) or part not in cur:
+                return None
+            cur = cur[part]
+        return cur
+
+    def _one(self, obj: Dict, timestamp: int) -> ev.Event:
+        # optional {"event": {...}} envelope, as the reference emits
+        if isinstance(obj, dict) and set(obj.keys()) == {"event"}:
+            obj = obj["event"]
+        data = []
+        for i, name in enumerate(self.schema.names):
+            if self.attribute_paths and self.attribute_paths[i]:
+                data.append(self._lookup(obj, self.attribute_paths[i]))
+            else:
+                data.append(obj.get(name) if isinstance(obj, dict) else None)
+        return ev.Event(timestamp, data)
+
+    def map(self, payload, timestamp):
+        if isinstance(payload, (str, bytes)):
+            payload = _json.loads(payload)
+        if isinstance(payload, list):
+            return [self._one(o, timestamp) for o in payload]
+        return [self._one(payload, timestamp)]
+
+
+class KeyValueSourceMapper(SourceMapper):
+    """dict payloads keyed by attribute name (reference: siddhi-map-keyvalue)."""
+
+    def map(self, payload, timestamp):
+        if not isinstance(payload, dict):
+            raise ValueError("keyvalue mapper needs dict payloads")
+        data = []
+        for i, name in enumerate(self.schema.names):
+            key = (self.attribute_paths[i]
+                   if self.attribute_paths and self.attribute_paths[i]
+                   else name)
+            data.append(payload.get(key))
+        return [ev.Event(timestamp, data)]
+
+
+class TextSourceMapper(SourceMapper):
+    """`attr:value` line format (reference: siddhi-map-text default:
+    `a:"v",\nb:2`)."""
+
+    _LINE = re.compile(r"\s*(\w+)\s*:\s*(.+?)\s*,?\s*$")
+
+    def map(self, payload, timestamp):
+        if isinstance(payload, bytes):
+            payload = payload.decode()
+        fields = {}
+        for line in str(payload).splitlines():
+            m = self._LINE.match(line)
+            if m:
+                v = m.group(2).strip()
+                if v.startswith('"') and v.endswith('"'):
+                    v = v[1:-1]
+                fields[m.group(1)] = v
+        data = []
+        for name, t in zip(self.schema.names, self.schema.types):
+            v = fields.get(name)
+            if v is not None and t in ("INT", "LONG"):
+                v = int(v)
+            elif v is not None and t in ("FLOAT", "DOUBLE"):
+                v = float(v)
+            elif v is not None and t == "BOOL":
+                v = v.lower() == "true"
+            data.append(v)
+        return [ev.Event(timestamp, data)]
+
+
+# ---------------------------------------------------------------------------
+
+
+class SinkMapper:
+    def __init__(self, schema: ev.Schema, map_annotation):
+        self.schema = schema
+        self.ann = map_annotation
+        self.payload_template: Optional[str] = None
+        if map_annotation is not None:
+            for sub in map_annotation.annotations:
+                if sub.name.lower() == "payload":
+                    vals = list(sub.elements.values())
+                    if vals:
+                        self.payload_template = str(vals[0])
+
+    def map(self, events: List[ev.Event]) -> List[Any]:
+        raise NotImplementedError
+
+    def _fill(self, template: str, e: ev.Event) -> str:
+        out = template
+        for name, v in zip(self.schema.names, e.data):
+            out = out.replace("{{" + name + "}}", str(v))
+        return out
+
+
+class PassThroughSinkMapper(SinkMapper):
+    def map(self, events):
+        return list(events)
+
+
+class JsonSinkMapper(SinkMapper):
+    def map(self, events):
+        outs = []
+        for e in events:
+            if self.payload_template:
+                outs.append(self._fill(self.payload_template, e))
+            else:
+                outs.append(_json.dumps({"event": dict(
+                    zip(self.schema.names, e.data))}))
+        return outs
+
+
+class KeyValueSinkMapper(SinkMapper):
+    def map(self, events):
+        return [dict(zip(self.schema.names, e.data)) for e in events]
+
+
+class TextSinkMapper(SinkMapper):
+    def map(self, events):
+        outs = []
+        for e in events:
+            if self.payload_template:
+                outs.append(self._fill(self.payload_template, e))
+            else:
+                outs.append(",\n".join(
+                    f'{n}:"{v}"' if isinstance(v, str) else f"{n}:{v}"
+                    for n, v in zip(self.schema.names, e.data)))
+        return outs
+
+
+SOURCE_MAPPERS = {
+    "passThrough": PassThroughSourceMapper,
+    "json": JsonSourceMapper,
+    "keyvalue": KeyValueSourceMapper,
+    "text": TextSourceMapper,
+}
+
+SINK_MAPPERS = {
+    "passThrough": PassThroughSinkMapper,
+    "json": JsonSinkMapper,
+    "keyvalue": KeyValueSinkMapper,
+    "text": TextSinkMapper,
+}

@@ -1,0 +1,137 @@
+"""Sinks: egress with mappers, log sink, distributed publishing strategies
+(reference: CORE/stream/output/sink/Sink.java:59, LogSink.java,
+InMemorySink.java:115, distributed/RoundRobin:99 + Partitioned:111).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List
+
+from ..core import event as ev
+from .broker import InMemoryBroker
+from .mappers import SINK_MAPPERS, SinkMapper
+
+log = logging.getLogger("siddhi_tpu")
+
+
+class Sink:
+    """Transport SPI: subclass and register with register_sink_type."""
+
+    def init(self, options: Dict[str, Any]):
+        self.options = options
+
+    def connect(self) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        pass
+
+    def publish(self, payload: Any) -> None:
+        raise NotImplementedError
+
+
+class InMemorySink(Sink):
+    def publish(self, payload):
+        InMemoryBroker.publish(self.options.get("topic"), payload)
+
+
+class LogSink(Sink):
+    """reference: CORE/stream/output/sink/LogSink.java:194"""
+
+    def publish(self, payload):
+        prefix = self.options.get("prefix", "")
+        log.info("%s%s", prefix + (" : " if prefix else ""), payload)
+
+
+SINK_TYPES: Dict[str, type] = {"inMemory": InMemorySink, "log": LogSink}
+
+
+def register_sink_type(name: str, cls: type) -> None:
+    SINK_TYPES[name] = cls
+
+
+class SinkRuntime:
+    """Wires one @sink annotation: stream events -> mapper -> transport(s).
+
+    `@sink(..., @distribution(strategy='roundRobin'|'partitioned',
+    partitionKey='attr', @destination(topic='t1'), @destination(topic='t2')))`
+    publishes across destinations (reference: DistributedTransport + its
+    RoundRobin/Partitioned strategies)."""
+
+    def __init__(self, stream_id: str, ann, app):
+        self.stream_id = stream_id
+        self.app = app
+        stype = ann.element("type") or ann.element(None)
+        if stype is None:
+            raise ValueError(f"@sink on {stream_id!r} needs type=")
+        if stype not in SINK_TYPES:
+            raise ValueError(
+                f"unknown sink type {stype!r}; registered: "
+                f"{sorted(SINK_TYPES)}")
+        self.options = {k: v for k, v in ann.elements.items()
+                        if k is not None}
+        map_ann = dist_ann = None
+        for sub in ann.annotations:
+            n = sub.name.lower()
+            if n == "map":
+                map_ann = sub
+            elif n == "distribution":
+                dist_ann = sub
+        mtype = (map_ann.element("type") if map_ann else None) or \
+            "passThrough"
+        if mtype not in SINK_MAPPERS:
+            raise ValueError(f"unknown sink map type {mtype!r}")
+        schema = app.schemas[stream_id]
+        self.mapper: SinkMapper = SINK_MAPPERS[mtype](schema, map_ann)
+
+        self.sinks: List[Sink] = []
+        self.strategy = None
+        self.partition_positions = None
+        self._rr = 0
+        if dist_ann is not None:
+            self.strategy = (dist_ann.element("strategy") or
+                             "roundRobin")
+            key = dist_ann.element("partitionKey")
+            if self.strategy == "partitioned":
+                if key is None:
+                    raise ValueError(
+                        "partitioned distribution needs partitionKey=")
+                self.partition_positions = schema.position(key)
+            for dest in dist_ann.annotations:
+                if dest.name.lower() == "destination":
+                    opts = dict(self.options)
+                    opts.update({k: v for k, v in dest.elements.items()
+                                 if k is not None})
+                    s = SINK_TYPES[stype]()
+                    s.init(opts)
+                    self.sinks.append(s)
+            if not self.sinks:
+                raise ValueError("@distribution needs @destination(...)s")
+        else:
+            s = SINK_TYPES[stype]()
+            s.init(self.options)
+            self.sinks.append(s)
+
+    def start(self) -> None:
+        for s in self.sinks:
+            s.connect()
+
+    def stop(self) -> None:
+        for s in self.sinks:
+            s.disconnect()
+
+    # StreamCallback entry
+    def __call__(self, events: List[ev.Event]) -> None:
+        payloads = self.mapper.map(events)
+        if self.strategy is None or len(self.sinks) == 1:
+            for p in payloads:
+                self.sinks[0].publish(p)
+            return
+        if self.strategy == "roundRobin":
+            for p in payloads:
+                self.sinks[self._rr % len(self.sinks)].publish(p)
+                self._rr += 1
+        else:  # partitioned
+            for e, p in zip(events, payloads):
+                v = e.data[self.partition_positions]
+                self.sinks[hash(v) % len(self.sinks)].publish(p)

@@ -1,0 +1,143 @@
+"""Sources: transport-agnostic ingestion with connect-retry and
+pause/resume (reference: CORE/stream/input/source/Source.java:50 —
+connectWithRetry :155-169, BackoffRetryCounter, InMemorySource.java:63).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import event as ev
+from . import broker as _broker
+from .mappers import SOURCE_MAPPERS, SourceMapper
+
+
+class Source:
+    """Transport SPI: subclass and register with @source_extension."""
+
+    def init(self, options: Dict[str, Any], deliver: Callable[[Any], None]):
+        """`deliver(payload)` pushes one transport payload into the mapper."""
+        self.options = options
+        self.deliver = deliver
+
+    def connect(self) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        pass
+
+    def pause(self) -> None:
+        pass
+
+    def resume(self) -> None:
+        pass
+
+
+class InMemorySource(Source):
+    """reference: CORE/stream/input/source/InMemorySource.java:63"""
+
+    def connect(self):
+        topic = self.options.get("topic")
+        if topic is None:
+            raise ValueError("inMemory source needs a topic")
+        self._sub = _broker.subscribe_fn(topic, self.deliver)
+
+    def disconnect(self):
+        if getattr(self, "_sub", None) is not None:
+            _broker.InMemoryBroker.unsubscribe(self._sub)
+            self._sub = None
+
+
+SOURCE_TYPES: Dict[str, type] = {"inMemory": InMemorySource}
+
+
+def register_source_type(name: str, cls: type) -> None:
+    SOURCE_TYPES[name] = cls
+
+
+class SourceRuntime:
+    """Wires one @source annotation: transport -> mapper -> stream junction.
+    Connection failures retry with exponential backoff on a daemon thread
+    (reference: Source.connectWithRetry + BackoffRetryCounter)."""
+
+    RETRY_SEQUENCE = (0.1, 0.25, 0.5, 1.0, 2.0, 5.0)
+
+    def __init__(self, stream_id: str, ann, app):
+        self.stream_id = stream_id
+        self.app = app
+        self.paused = False
+        self._pause_cv = threading.Condition()
+        self._connected = False
+        self._retry_thread: Optional[threading.Thread] = None
+
+        stype = ann.element("type") or ann.element(None)
+        if stype is None:
+            raise ValueError(f"@source on {stream_id!r} needs type=")
+        if stype not in SOURCE_TYPES:
+            raise ValueError(
+                f"unknown source type {stype!r}; registered: "
+                f"{sorted(SOURCE_TYPES)}")
+        self.options = {k: v for k, v in ann.elements.items()
+                        if k is not None}
+        map_ann = None
+        for sub in ann.annotations:
+            if sub.name.lower() == "map":
+                map_ann = sub
+        mtype = (map_ann.element("type") if map_ann else None) or \
+            "passThrough"
+        if mtype not in SOURCE_MAPPERS:
+            raise ValueError(f"unknown source map type {mtype!r}")
+        schema = app.schemas[stream_id]
+        self.mapper: SourceMapper = SOURCE_MAPPERS[mtype](schema, map_ann)
+        self.source: Source = SOURCE_TYPES[stype]()
+        self.source.init(self.options, self._deliver)
+
+    # -- lifecycle -------------------------------------------------------------
+    def start(self) -> None:
+        try:
+            self.source.connect()
+            self._connected = True
+        except Exception:  # noqa: BLE001 — retry in background
+            self._retry_thread = threading.Thread(
+                target=self._connect_with_retry, daemon=True,
+                name=f"source-retry-{self.stream_id}")
+            self._retry_thread.start()
+
+    def _connect_with_retry(self) -> None:
+        for delay in self.RETRY_SEQUENCE:
+            time.sleep(delay)
+            try:
+                self.source.connect()
+                self._connected = True
+                return
+            except Exception:  # noqa: BLE001
+                continue
+        import logging
+        logging.getLogger("siddhi_tpu").error(
+            "source for %r failed to connect after retries", self.stream_id)
+
+    def stop(self) -> None:
+        self.source.disconnect()
+        self._connected = False
+
+    def pause(self) -> None:
+        with self._pause_cv:
+            self.paused = True
+        self.source.pause()
+
+    def resume(self) -> None:
+        with self._pause_cv:
+            self.paused = False
+            self._pause_cv.notify_all()
+        self.source.resume()
+
+    # -- data path -------------------------------------------------------------
+    def _deliver(self, payload: Any) -> None:
+        with self._pause_cv:
+            while self.paused:
+                self._pause_cv.wait()
+        now = self.app.timestamp_millis()
+        events = self.mapper.map(payload, now)
+        if events:
+            self.app._route(self.stream_id, events)

@@ -1,0 +1,209 @@
+"""Sources, sinks, mappers, in-memory broker (reference:
+TEST/transport/InMemoryTransportTestCase — multiple apps joined by broker
+topics — plus mapper behavior from the official map extensions)."""
+import json
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.io import InMemoryBroker
+from siddhi_tpu.io.source import Source, register_source_type
+
+
+@pytest.fixture(autouse=True)
+def _clear_broker():
+    yield
+    InMemoryBroker.clear()
+
+
+def test_inmemory_source_sink_roundtrip():
+    """Two apps connected by a broker topic."""
+    producer_ql = """
+    define stream In (k string, v int);
+    @sink(type='inMemory', topic='t1')
+    define stream Out (k string, v int);
+    from In[v > 1] select k, v insert into Out;
+    """
+    consumer_ql = """
+    @source(type='inMemory', topic='t1')
+    define stream Rx (k string, v int);
+    @info(name='q')
+    from Rx select k, v insert into Final;
+    """
+    manager = SiddhiManager()
+    prod = manager.create_siddhi_app_runtime(producer_ql)
+    cons = manager.create_siddhi_app_runtime(consumer_ql)
+    got = []
+    cons.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    prod.start()
+    cons.start()
+    h = prod.get_input_handler("In")
+    h.send(["a", 1])
+    h.send(["b", 2])
+    prod.flush()
+    cons.flush()
+    assert [e.data for e in got] == [["b", 2]]
+    manager.shutdown()
+
+
+def test_json_mapper_roundtrip():
+    ql = """
+    @source(type='inMemory', topic='jt', @map(type='json'))
+    define stream Rx (sym string, price double);
+    @sink(type='inMemory', topic='jo', @map(type='json'))
+    define stream Tx (sym string, price double);
+    from Rx select sym, price insert into Tx;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    outs = []
+    from siddhi_tpu.io.broker import subscribe_fn
+    subscribe_fn("jo", outs.append)
+    InMemoryBroker.publish("jt", '{"event": {"sym": "IBM", "price": 5.5}}')
+    rt.flush()
+    assert len(outs) == 1
+    parsed = json.loads(outs[0])
+    assert parsed["event"]["sym"] == "IBM"
+    assert parsed["event"]["price"] == pytest.approx(5.5)
+    manager.shutdown()
+
+
+def test_keyvalue_and_text_mappers():
+    ql = """
+    @source(type='inMemory', topic='kv', @map(type='keyvalue'))
+    define stream A (k string, v long);
+    @source(type='inMemory', topic='tx', @map(type='text'))
+    define stream B (k string, v long);
+    @info(name='qa') from A select k, v insert into OutA;
+    @info(name='qb') from B select k, v insert into OutB;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    ga, gb = [], []
+    rt.add_callback("qa", lambda ts, ins, outs: ga.extend(ins or []))
+    rt.add_callback("qb", lambda ts, ins, outs: gb.extend(ins or []))
+    rt.start()
+    InMemoryBroker.publish("kv", {"k": "x", "v": 7})
+    InMemoryBroker.publish("tx", 'k:"y",\nv:9')
+    rt.flush()
+    assert [e.data for e in ga] == [["x", 7]]
+    assert [e.data for e in gb] == [["y", 9]]
+    manager.shutdown()
+
+
+def test_distributed_sink_roundrobin():
+    ql = """
+    define stream In (k string, v int);
+    @sink(type='inMemory',
+          @distribution(strategy='roundRobin',
+                        @destination(topic='d1'),
+                        @destination(topic='d2')))
+    define stream Out (k string, v int);
+    from In select k, v insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    d1, d2 = [], []
+    from siddhi_tpu.io.broker import subscribe_fn
+    subscribe_fn("d1", d1.append)
+    subscribe_fn("d2", d2.append)
+    h = rt.get_input_handler("In")
+    for i in range(4):
+        h.send([str(i), i])
+    rt.flush()
+    assert len(d1) == 2 and len(d2) == 2
+    manager.shutdown()
+
+
+def test_distributed_sink_partitioned():
+    ql = """
+    define stream In (k string, v int);
+    @sink(type='inMemory',
+          @distribution(strategy='partitioned', partitionKey='k',
+                        @destination(topic='p1'),
+                        @destination(topic='p2')))
+    define stream Out (k string, v int);
+    from In select k, v insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    p1, p2 = [], []
+    from siddhi_tpu.io.broker import subscribe_fn
+    subscribe_fn("p1", p1.append)
+    subscribe_fn("p2", p2.append)
+    h = rt.get_input_handler("In")
+    for i in range(6):
+        h.send(["a" if i % 2 else "b", i])
+    rt.flush()
+    # same key always lands on the same destination
+    keys1 = {e.data[0] for e in p1}
+    keys2 = {e.data[0] for e in p2}
+    assert not (keys1 & keys2)
+    assert len(p1) + len(p2) == 6
+    manager.shutdown()
+
+
+def test_source_connect_retry():
+    """A source that fails its first connects eventually connects via
+    backoff retry (reference: TestFailingInMemorySource pattern)."""
+    attempts = []
+
+    class FlakySource(Source):
+        def connect(self):
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise ConnectionError("not yet")
+            topic = self.options.get("topic")
+            from siddhi_tpu.io.broker import subscribe_fn
+            self._sub = subscribe_fn(topic, self.deliver)
+
+    register_source_type("flaky", FlakySource)
+    ql = """
+    @source(type='flaky', topic='ft')
+    define stream Rx (k string);
+    @info(name='q') from Rx select k insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    import time
+    deadline = time.time() + 5.0
+    while time.time() < deadline and len(attempts) < 3:
+        time.sleep(0.05)
+    InMemoryBroker.publish("ft", ["hello"])
+    rt.flush()
+    assert len(attempts) >= 3
+    assert [e.data for e in got] == [["hello"]]
+    manager.shutdown()
+
+
+def test_pause_resume_sources():
+    ql = """
+    @source(type='inMemory', topic='pr')
+    define stream Rx (k string);
+    @info(name='q') from Rx select k insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    InMemoryBroker.publish("pr", ["one"])
+    rt.pause_sources()
+    import threading
+    t = threading.Thread(
+        target=lambda: InMemoryBroker.publish("pr", ["two"]), daemon=True)
+    t.start()
+    import time
+    time.sleep(0.2)
+    assert [e.data[0] for e in got] == ["one"]   # 'two' blocked on pause
+    rt.resume_sources()
+    t.join(timeout=2)
+    rt.flush()
+    assert [e.data[0] for e in got] == ["one", "two"]
+    manager.shutdown()
