@@ -1,0 +1,74 @@
+"""Debugger: breakpoints at query IN/OUT terminals, blocking the event
+thread until next()/play() (reference: CORE/debugger/SiddhiDebugger.java:36 —
+acquireBreakPoint :95, checkBreakPoint :133-169; wired into
+ProcessStreamReceiver.receive :100-126 in the reference, here into the
+query runtimes' staged-batch entry and the delivery path)."""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Set, Tuple
+
+
+class SiddhiDebugger:
+    IN = "IN"
+    OUT = "OUT"
+
+    def __init__(self, app):
+        self.app = app
+        self._breakpoints: Set[Tuple[str, str]] = set()
+        self._callback: Optional[Callable] = None
+        self._resume = threading.Event()
+        self._step_mode = False
+        self._lock = threading.RLock()
+
+    # -- control (called from the debugging thread) ---------------------------
+    def acquire_break_point(self, query_name: str, terminal: str) -> None:
+        with self._lock:
+            self._breakpoints.add((query_name, terminal))
+
+    acquireBreakPoint = acquire_break_point
+
+    def release_break_point(self, query_name: str, terminal: str) -> None:
+        with self._lock:
+            self._breakpoints.discard((query_name, terminal))
+
+    releaseBreakPoint = release_break_point
+
+    def release_all_break_points(self) -> None:
+        with self._lock:
+            self._breakpoints.clear()
+
+    releaseAllBreakPoints = release_all_break_points
+
+    def set_debugger_callback(self, cb: Callable) -> None:
+        """cb(events, query_name, terminal, debugger)"""
+        self._callback = cb
+
+    setDebuggerCallback = set_debugger_callback
+
+    def next(self) -> None:
+        """Resume and break at the very next checkpoint."""
+        with self._lock:
+            self._step_mode = True
+        self._resume.set()
+
+    def play(self) -> None:
+        """Resume until the next registered breakpoint."""
+        with self._lock:
+            self._step_mode = False
+        self._resume.set()
+
+    # -- checkpoint (called from the event thread) ----------------------------
+    def check_break_point(self, query_name: str, terminal: str,
+                          events) -> None:
+        with self._lock:
+            hit = self._step_mode or \
+                (query_name, terminal) in self._breakpoints
+        if not hit:
+            return
+        with self._lock:
+            self._step_mode = False
+        self._resume.clear()
+        if self._callback is not None:
+            self._callback(events, query_name, terminal, self)
+        self._resume.wait()

@@ -114,6 +114,9 @@ class QueryRuntime:
 
     def process_staged(self, staged: ev.StagedBatch, now: int) -> None:
         p = self.planned
+        dbg = getattr(self.app, "_debugger", None)
+        if dbg is not None:
+            dbg.check_break_point(self.name, "IN", staged)
         if p.group_by_positions and p.slot_allocator is not None:
             key_cols = [staged.cols[i] for i in p.group_by_positions]
             gslot = p.slot_allocator.slots_for(key_cols, staged.valid)
@@ -352,6 +355,9 @@ def _deliver_pairs(qr, pairs, now: int) -> None:
     p = qr.planned
     current = [e for k, e in pairs if k == ev.CURRENT]
     expired = [e for k, e in pairs if k == ev.EXPIRED]
+    dbg = getattr(qr.app, "_debugger", None)
+    if dbg is not None:
+        dbg.check_break_point(qr.name, "OUT", current)
     for cb in qr.callbacks:
         cb(now, current or None, expired or None)
     if p.output_target:
@@ -578,10 +584,19 @@ class NamedWindowRuntime:
 
 class StreamJunction:
     """Per-stream pub/sub hub (reference: CORE/stream/StreamJunction.java:61).
-    Packs each published chunk to numpy once; subscribers share the staging."""
+    Packs each published chunk to numpy once; subscribers share the staging.
 
-    def __init__(self, schema: ev.Schema):
+    `@OnError(action='STREAM')` on the stream definition routes events whose
+    processing raised, together with the error, into the `!stream` fault
+    stream (reference: StreamJunction.handleError :368-430 +
+    FaultStreamEventConverter); the default action logs and drops."""
+
+    def __init__(self, schema: ev.Schema, stream_id: str = "",
+                 on_error: str = "LOG", app=None):
         self.schema = schema
+        self.stream_id = stream_id
+        self.on_error = on_error
+        self.app = app
         self.queries: List[QueryRuntime] = []
         self.stream_callbacks: List[Callable] = []
 
@@ -592,12 +607,41 @@ class StreamJunction:
         self.stream_callbacks.append(cb)
 
     def publish(self, events: List[ev.Event], now: int) -> None:
+        stats = self.app.stats if self.app is not None else None
+        if stats is not None and stats.enabled:
+            stats.stream_in(self.stream_id, len(events))
         for cb in self.stream_callbacks:
             cb(events)
         if self.queries:
             staged = ev.pack_np(self.schema, events)
             for q in self.queries:
-                q.process_staged(staged, now)
+                try:
+                    if stats is not None and stats.detail:
+                        t0 = time.perf_counter_ns()
+                        q.process_staged(staged, now)
+                        stats.query_latency(
+                            getattr(q, "name", self.stream_id), len(events),
+                            time.perf_counter_ns() - t0)
+                    else:
+                        q.process_staged(staged, now)
+                except Exception as exc:  # noqa: BLE001 — fault routing
+                    self._handle_error(events, exc, now)
+
+    def _handle_error(self, events, exc: Exception, now: int) -> None:
+        import logging
+        if self.on_error == "STREAM" and self.app is not None:
+            fault_id = "!" + self.stream_id
+            if fault_id in self.app.junctions:
+                fault_events = [
+                    ev.Event(e.timestamp, list(e.data) + [repr(exc)])
+                    for e in events]
+                self.app._route(fault_id, fault_events)
+                return
+        logging.getLogger("siddhi_tpu").error(
+            "error processing %r events: %s", self.stream_id, exc)
+        listener = getattr(self.app, "exception_listener", None)
+        if listener is not None:
+            listener(exc)
 
 
 class _EmissionDrainer:
@@ -729,10 +773,24 @@ class SiddhiAppRuntime:
         self.playback = pb is not None
         self._playback_time = 0
 
+        # statistics (reference: @app:statistics levels OFF/BASIC/DETAIL)
+        from ..utils.statistics import OFF, StatisticsManager
+        st_ann = app.get_annotation("app:statistics")
+        level = OFF
+        if st_ann is not None:
+            v = st_ann.element() or st_ann.element("level") or "BASIC"
+            level = str(v).upper()
+            if level == "TRUE":
+                level = "BASIC"
+            elif level == "FALSE":
+                level = OFF
+        self.stats = StatisticsManager(level)
+        self.exception_listener = None
+
         # schemas & junctions
         self.schemas: Dict[str, ev.Schema] = {}
         self.junctions: Dict[str, StreamJunction] = {}
-        for sid, sdef in app.stream_definition_map.items():
+        for sid, sdef in list(app.stream_definition_map.items()):
             self._define_stream_runtime(sdef)
 
         # tables (reference: CORE/table/InMemoryTable.java)
@@ -808,7 +866,21 @@ class SiddhiAppRuntime:
     def _define_stream_runtime(self, sdef: StreamDefinition):
         schema = ev.Schema(sdef, self.interner, objects=None)
         self.schemas[sdef.id] = schema
-        self.junctions[sdef.id] = StreamJunction(schema)
+        on_error = "LOG"
+        ann = sdef.get_annotation("OnError")
+        if ann is not None:
+            on_error = (ann.element("action") or "LOG").upper()
+        self.junctions[sdef.id] = StreamJunction(
+            schema, stream_id=sdef.id, on_error=on_error, app=self)
+        if on_error == "STREAM" and not sdef.id.startswith("!"):
+            # auto-define the `!stream` fault stream: original attrs +
+            # `_error` (reference: FaultStreamEventConverter)
+            fdef = StreamDefinition("!" + sdef.id)
+            for a in sdef.attribute_list:
+                fdef.attribute(a.name, a.type)
+            fdef.attribute("_error", "STRING")
+            self.app.stream_definition_map[fdef.id] = fdef
+            self._define_stream_runtime(fdef)
 
     def _query_name(self, q: Query, i: int) -> str:
         info = q.get_annotation("info")
@@ -1205,6 +1277,25 @@ class SiddhiAppRuntime:
                 self._scheduler.drain_playback(now)
             junction.publish(events, now)
 
+    # -- statistics / debugging -----------------------------------------------
+    def statistics(self) -> Dict:
+        """Metric report (reference: SiddhiStatisticsManager)."""
+        return self.stats.report(self)
+
+    def set_statistics_level(self, level: str) -> None:
+        self.stats.level = level.upper()
+
+    def set_exception_listener(self, fn) -> None:
+        """reference: SiddhiAppRuntimeImpl.handleRuntimeExceptionWith"""
+        self.exception_listener = fn
+
+    def debug(self):
+        """Attach a debugger; returns it (reference:
+        SiddhiAppRuntimeImpl.debug :657-675)."""
+        from .debugger import SiddhiDebugger
+        self._debugger = SiddhiDebugger(self)
+        return self._debugger
+
     # -- on-demand (store) queries --------------------------------------------
     def query(self, q) -> List[ev.Event]:
         """Execute a one-shot store query against tables/windows/aggregations
@@ -1273,9 +1364,16 @@ class SiddhiManager:
     """reference: CORE/SiddhiManager.java:49"""
 
     def __init__(self):
+        from ..utils.persistence import InMemoryPersistenceStore
         self.interner = ev.StringInterner()
         self.runtimes: Dict[str, SiddhiAppRuntime] = {}
-        self._persistence: Dict[str, List[bytes]] = {}
+        self.persistence_store = InMemoryPersistenceStore()
+
+    def set_persistence_store(self, store) -> None:
+        """reference: SiddhiManager.setPersistenceStore"""
+        self.persistence_store = store
+
+    setPersistenceStore = set_persistence_store
 
     def create_siddhi_app_runtime(
             self, app: Union[str, SiddhiApp],
@@ -1291,14 +1389,25 @@ class SiddhiManager:
     createSiddhiAppRuntime = create_siddhi_app_runtime
 
     def persist(self) -> None:
+        """Snapshot every app into the persistence store (reference:
+        SiddhiManager.persist :281; sources pause around the snapshot as in
+        SiddhiAppRuntimeImpl.persist :677-691)."""
+        from ..utils.persistence import new_revision
         for name, rt in self.runtimes.items():
-            self._persistence.setdefault(name, []).append(rt.snapshot())
+            rt.pause_sources()
+            try:
+                self.persistence_store.save(name, new_revision(name),
+                                            rt.snapshot())
+            finally:
+                rt.resume_sources()
 
     def restore_last_revision(self) -> None:
         for name, rt in self.runtimes.items():
-            revs = self._persistence.get(name)
-            if revs:
-                rt.restore(revs[-1])
+            rev = self.persistence_store.get_last_revision(name)
+            if rev is not None:
+                blob = self.persistence_store.load(name, rev)
+                if blob is not None:
+                    rt.restore(blob)
 
     def shutdown(self) -> None:
         for rt in self.runtimes.values():

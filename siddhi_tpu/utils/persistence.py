@@ -1,0 +1,95 @@
+"""Persistence stores for state snapshots (reference:
+CORE/util/persistence/{PersistenceStore,InMemoryPersistenceStore,
+FileSystemPersistenceStore}.java — FileSystemPersistenceStore.save :40).
+
+The snapshot payload here is the pickled state pytree produced by
+SiddhiAppRuntime.snapshot() — no stop-the-world object walk, just arrays.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class PersistenceStore:
+    """SPI: save/load full snapshots by (app, revision)."""
+
+    def save(self, app_name: str, revision: str, snapshot: bytes) -> None:
+        raise NotImplementedError
+
+    def load(self, app_name: str, revision: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def get_last_revision(self, app_name: str) -> Optional[str]:
+        raise NotImplementedError
+
+    def clear_all_revisions(self, app_name: str) -> None:
+        raise NotImplementedError
+
+
+class InMemoryPersistenceStore(PersistenceStore):
+    def __init__(self):
+        self._revisions: Dict[str, List[str]] = {}
+        self._data: Dict[str, bytes] = {}
+        self._lock = threading.RLock()
+
+    def save(self, app_name, revision, snapshot):
+        with self._lock:
+            self._revisions.setdefault(app_name, []).append(revision)
+            self._data[app_name + "__" + revision] = snapshot
+
+    def load(self, app_name, revision):
+        return self._data.get(app_name + "__" + revision)
+
+    def get_last_revision(self, app_name):
+        revs = self._revisions.get(app_name)
+        return revs[-1] if revs else None
+
+    def clear_all_revisions(self, app_name):
+        with self._lock:
+            for r in self._revisions.pop(app_name, []):
+                self._data.pop(app_name + "__" + r, None)
+
+
+class FileSystemPersistenceStore(PersistenceStore):
+    """Snapshots as `<folder>/<app>/<revision>.snapshot` files."""
+
+    def __init__(self, folder: str):
+        self.folder = folder
+
+    def _dir(self, app_name: str) -> str:
+        return os.path.join(self.folder, app_name)
+
+    def save(self, app_name, revision, snapshot):
+        d = self._dir(app_name)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, revision + ".snapshot"), "wb") as f:
+            f.write(snapshot)
+
+    def load(self, app_name, revision):
+        path = os.path.join(self._dir(app_name), revision + ".snapshot")
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    def get_last_revision(self, app_name):
+        d = self._dir(app_name)
+        if not os.path.isdir(d):
+            return None
+        revs = sorted(f[:-len(".snapshot")] for f in os.listdir(d)
+                      if f.endswith(".snapshot"))
+        return revs[-1] if revs else None
+
+    def clear_all_revisions(self, app_name):
+        d = self._dir(app_name)
+        if os.path.isdir(d):
+            for f in os.listdir(d):
+                if f.endswith(".snapshot"):
+                    os.remove(os.path.join(d, f))
+
+
+def new_revision(app_name: str) -> str:
+    return f"{int(time.time() * 1000)}_{app_name}"

@@ -1,0 +1,89 @@
+"""Runtime statistics (reference: CORE/util/statistics/* — Dropwizard
+metrics in the reference; here a dependency-free registry with the same
+metric roles: throughput per stream, latency per query, memory, buffered
+events.  Levels OFF/BASIC/DETAIL, runtime-switchable as in
+SiddhiAppRuntimeImpl.setStatisticsLevel :859-895)."""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+OFF, BASIC, DETAIL = "OFF", "BASIC", "DETAIL"
+
+
+class StatisticsManager:
+    def __init__(self, level: str = OFF):
+        self.level = level
+        self._lock = threading.Lock()
+        self._stream_in: Dict[str, int] = {}
+        self._query_events: Dict[str, int] = {}
+        self._query_time_ns: Dict[str, int] = {}
+        self._query_max_ns: Dict[str, int] = {}
+        self._start = time.time()
+
+    # -- hook points -----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self.level != OFF
+
+    @property
+    def detail(self) -> bool:
+        return self.level == DETAIL
+
+    def stream_in(self, stream_id: str, n: int) -> None:
+        with self._lock:
+            self._stream_in[stream_id] = \
+                self._stream_in.get(stream_id, 0) + n
+
+    def query_latency(self, name: str, n: int, elapsed_ns: int) -> None:
+        with self._lock:
+            self._query_events[name] = self._query_events.get(name, 0) + n
+            self._query_time_ns[name] = \
+                self._query_time_ns.get(name, 0) + elapsed_ns
+            if elapsed_ns > self._query_max_ns.get(name, 0):
+                self._query_max_ns[name] = elapsed_ns
+
+    # -- reporting -------------------------------------------------------------
+    def report(self, app=None) -> Dict:
+        with self._lock:
+            elapsed = max(time.time() - self._start, 1e-9)
+            out = {
+                "level": self.level,
+                "uptime_s": elapsed,
+                "streams": {
+                    sid: {"events": n, "throughput_eps": n / elapsed}
+                    for sid, n in self._stream_in.items()},
+                "queries": {},
+            }
+            for name, n in self._query_events.items():
+                t = self._query_time_ns.get(name, 0)
+                out["queries"][name] = {
+                    "events": n,
+                    "total_ms": t / 1e6,
+                    "avg_latency_us": (t / max(n, 1)) / 1e3,
+                    "max_latency_ms": self._query_max_ns.get(name, 0) / 1e6,
+                }
+        if app is not None:
+            mem = 0
+            try:
+                import jax
+                import numpy as np
+                for qr in app.query_runtimes.values():
+                    for leaf in jax.tree.leaves(qr.state):
+                        mem += np.asarray(leaf).nbytes \
+                            if not hasattr(leaf, "nbytes") else leaf.nbytes
+            except Exception:  # noqa: BLE001 — metrics must not throw
+                pass
+            out["state_bytes"] = mem
+            out["buffered_emissions"] = app._drainer._q.qsize() \
+                if app._drainer is not None else 0
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stream_in.clear()
+            self._query_events.clear()
+            self._query_time_ns.clear()
+            self._query_max_ns.clear()
+            self._start = time.time()

@@ -1,0 +1,133 @@
+"""Operational subsystems: fault streams, persistence stores, statistics,
+debugger (reference: TEST/stream/OnErrorTestCase patterns,
+TEST/managment/PersistenceTestCase, StatisticsTestCase,
+TEST/debugger/SiddhiDebuggerTestCase)."""
+import threading
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.extension import scalar_function
+
+
+# a scalar function extension that throws, to trigger fault routing
+@scalar_function("custom:explode")
+def _explode(args):
+    from siddhi_tpu.core.executor import CompiledExpr
+
+    def fn(env):
+        raise RuntimeError("boom")
+    return CompiledExpr(fn=fn, type="INT")
+
+
+def test_fault_stream_routing():
+    ql = """
+    @OnError(action='STREAM')
+    define stream In (k string, v int);
+
+    @info(name='bad')
+    from In[custom:explode(v) > 0] select k, v insert into Out;
+
+    @info(name='faults')
+    from !In select k, v, _error insert into FaultLog;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    faults = []
+    rt.add_callback("faults", lambda ts, ins, outs: faults.extend(ins or []))
+    rt.start()
+    h = rt.get_input_handler("In")
+    h.send(["a", 1])
+    rt.flush()
+    assert len(faults) == 1
+    assert faults[0].data[0] == "a"
+    assert "boom" in faults[0].data[2]
+    manager.shutdown()
+
+
+def test_filesystem_persistence_store(tmp_path):
+    from siddhi_tpu.utils.persistence import FileSystemPersistenceStore
+    ql = """
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, sum(v) as total group by k insert into Out;
+    """
+    manager = SiddhiManager()
+    manager.set_persistence_store(FileSystemPersistenceStore(str(tmp_path)))
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    h = rt.get_input_handler("In")
+    h.send(["a", 10])
+    rt.flush()
+    manager.persist()
+    h.send(["a", 100])   # post-snapshot; dropped by restore
+    rt.flush()
+    manager.restore_last_revision()
+    h.send(["a", 5])
+    rt.flush()
+    assert got[-1].data[1] == 15    # 10 + 5, the 100 was rolled back
+    files = list(tmp_path.rglob("*.snapshot"))
+    assert len(files) == 1
+    manager.shutdown()
+
+
+def test_statistics_levels():
+    ql = """
+    @app:statistics('DETAIL')
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, v insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(5):
+        h.send([str(i), i])
+    rt.flush()
+    rep = rt.statistics()
+    assert rep["level"] == "DETAIL"
+    assert rep["streams"]["In"]["events"] == 5
+    assert rep["queries"]["q"]["events"] == 5
+    assert rep["queries"]["q"]["avg_latency_us"] > 0
+    assert rep["state_bytes"] > 0
+    rt.set_statistics_level("OFF")
+    assert rt.statistics()["level"] == "OFF"
+    manager.shutdown()
+
+
+def test_debugger_breakpoint():
+    ql = """
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, v insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    out = []
+    rt.add_callback("q", lambda ts, ins, outs: out.extend(ins or []))
+    debugger = rt.debug()
+    hits = []
+    debugger.set_debugger_callback(
+        lambda events, qn, term, dbg: (hits.append((qn, term)), dbg.play()))
+    debugger.acquire_break_point("q", debugger.IN)
+    rt.start()
+    h = rt.get_input_handler("In")
+
+    done = threading.Event()
+
+    def send():
+        h.send(["a", 1])
+        done.set()
+
+    t = threading.Thread(target=send, daemon=True)
+    t.start()
+    assert done.wait(10.0)
+    rt.flush()
+    assert hits == [("q", "IN")]
+    assert len(out) == 1
+    debugger.release_all_break_points()
+    manager.shutdown()
