@@ -100,8 +100,9 @@ def plan_single_query(
     scope.interner = interner
     scope.add_source(sid, in_schema, alias=ist.stream_reference_id)
 
-    # ---- handlers: filters before/after the (single) window ---------------
-    pre_filters, post_filters = [], []
+    # ---- handlers: filters/stream-functions before/after the window --------
+    # chain entries: ('filter', compiled) | ('fn', names, dtypes, fn)
+    pre_chain, post_chain = [], []
     if named_window_input:
         from .window import PassAllWindow
         window_proc: WindowProcessor = PassAllWindow(
@@ -109,12 +110,13 @@ def plan_single_query(
     else:
         window_proc = NoWindow(in_schema, [], batch_capacity)
     seen_window = False
+    chain_schema = in_schema   # grows as stream functions append attributes
     for h in ist.stream_handlers:
         if isinstance(h, Filter):
             c = compile_expression(h.expression, scope)
             if c.type != "BOOL":
                 raise CompileError("filter expression must be boolean")
-            (post_filters if seen_window else pre_filters).append(c)
+            (post_chain if seen_window else pre_chain).append(("filter", c))
         elif isinstance(h, Window):
             if named_window_input:
                 raise CompileError(
@@ -124,11 +126,31 @@ def plan_single_query(
             seen_window = True
             window_proc = create_window(
                 (h.namespace + ":" if h.namespace else "") + h.name,
-                in_schema, h.parameters, batch_capacity,
+                chain_schema, h.parameters, batch_capacity,
                 capacity_hint=window_capacity_hint)
         elif isinstance(h, StreamFunction):
-            raise CompileError(
-                f"stream function {h.name!r} not yet supported")
+            from .streamfn import STREAM_FUNCTIONS
+            fname = (h.namespace + ":" if h.namespace else "") + h.name
+            sfn = STREAM_FUNCTIONS.get(fname)
+            if sfn is None:
+                raise CompileError(
+                    f"unknown stream function {fname!r}; registered: "
+                    f"{sorted(STREAM_FUNCTIONS)}")
+            names, types, fn = sfn.compile(h.parameters, scope, sid)
+            if names:
+                sdef = StreamDefinition(sid)
+                for a in chain_schema.definition.attribute_list:
+                    sdef.attribute(a.name, a.type)
+                for n, t in zip(names, types):
+                    sdef.attribute(n, t)
+                chain_schema = ev.Schema(sdef, interner,
+                                         objects=in_schema.objects)
+                scope.add_source(sid, chain_schema,
+                                 alias=ist.stream_reference_id,
+                                 default=False)
+            dtypes = [ev.dtype_of(t) for t in types]
+            (post_chain if seen_window else pre_chain).append(
+                ("fn", dtypes, fn))
 
     # ---- selector -----------------------------------------------------------
     out_target = query.output_stream.target_id if query.output_stream else ""
@@ -146,6 +168,10 @@ def plan_single_query(
     # partition key composes with group-by
     # (reference: PartitionStateHolder's nested partitionKey->groupByKey map)
     gpos = list(sel.group_by_positions)
+    if any(p >= len(in_schema.names) for p in gpos):
+        raise CompileError(
+            "group by on stream-function-appended attributes is not yet "
+            "supported")
     if partition_positions:
         if seen_window:
             raise CompileError(
@@ -177,10 +203,17 @@ def plan_single_query(
             # expired rows must pass the same filters so signed aggregation
             # stays balanced (reference: filter sits after the shared window)
             is_current = jnp.logical_or(is_current, kind == ev.EXPIRED)
-        for f in pre_filters:
-            m = f.fn(env)
-            keep = jnp.logical_and(keep,
-                                   jnp.logical_or(jnp.logical_not(is_current), m))
+        for entry in pre_chain:
+            if entry[0] == "filter":
+                m = entry[1].fn(env)
+                keep = jnp.logical_and(
+                    keep, jnp.logical_or(jnp.logical_not(is_current), m))
+            else:
+                _, dtypes, fn = entry
+                new_cols, keep = fn(env, keep)
+                cols = cols + tuple(
+                    jnp.asarray(c, d) for c, d in zip(new_cols, dtypes))
+                env[sid] = cols
         rows = Rows(ts=ts, kind=kind, valid=keep,
                     seq=jnp.zeros_like(ts), gslot=gslot, cols=cols)
         wstate, wout = wproc.process(wstate, rows, now)
@@ -189,16 +222,24 @@ def plan_single_query(
         for k, v in env.items():
             if k.startswith("__in__:"):
                 env2[k] = v
-        if post_filters:
+        if post_chain:
             keep2 = orows.valid
             oc = orows.kind == ev.CURRENT
             oe = orows.kind == ev.EXPIRED
             data_row = jnp.logical_or(oc, oe)
-            for f in post_filters:
-                m = f.fn(env2)
-                keep2 = jnp.logical_and(
-                    keep2, jnp.logical_or(jnp.logical_not(data_row), m))
-            orows = orows._replace(valid=keep2)
+            ocols = orows.cols
+            for entry in post_chain:
+                if entry[0] == "filter":
+                    m = entry[1].fn(env2)
+                    keep2 = jnp.logical_and(
+                        keep2, jnp.logical_or(jnp.logical_not(data_row), m))
+                else:
+                    _, dtypes, fn = entry
+                    new_cols, keep2 = fn(env2, keep2)
+                    ocols = ocols + tuple(
+                        jnp.asarray(c, d) for c, d in zip(new_cols, dtypes))
+                    env2[sid] = ocols
+            orows = orows._replace(valid=keep2, cols=ocols)
         astate, (ots, okind, ovalid, ocols) = sel.process(astate, orows, env2)
         return ((wstate, astate), (ots, okind, ovalid, ocols),
                 wout.next_wakeup)

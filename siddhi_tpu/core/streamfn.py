@@ -1,0 +1,88 @@
+"""Stream functions: 1->N in-chain transforms appending attributes
+(reference: CORE/query/processor/stream/function/StreamFunctionProcessor.java,
+LogStreamProcessor.java:330, Pol2CartStreamFunctionProcessor.java:185).
+
+TPU-native design: a stream function contributes (new_names, new_types,
+fn(env) -> new column block) compiled into the query's fused step — the
+reference's per-event process(...) object becomes column math.  `log` uses
+`jax.debug.callback`, the XLA-native host tap, instead of breaking the
+fusion.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..query_api.expression import Constant
+from .executor import CompileError, Scope, compile_expression
+
+log = logging.getLogger("siddhi_tpu")
+
+
+class StreamFunctionDef:
+    """SPI: compile(params, scope, schema) ->
+    (new_names, new_types, fn(env, valid) -> (new_cols tuple, keep_mask))."""
+
+    def compile(self, params, scope: Scope, sid: str):
+        raise NotImplementedError
+
+
+class LogStreamFunction(StreamFunctionDef):
+    """`#log([priority,] message)` — passes events through, emitting the
+    message + batch size on the host via jax.debug.callback."""
+
+    def compile(self, params, scope, sid):
+        message = "events"
+        priority = "INFO"
+        consts = [p for p in params if isinstance(p, Constant)]
+        if len(consts) == 1:
+            message = str(consts[0].value)
+        elif len(consts) >= 2:
+            priority = str(consts[0].value).upper()
+            message = str(consts[1].value)
+        level = getattr(logging, priority, logging.INFO)
+
+        def host_log(n):
+            log.log(level, "%s : %d event(s)", message, int(n))
+
+        def fn(env, valid):
+            jax.debug.callback(host_log, jnp.sum(valid.astype(jnp.int32)))
+            return (), valid
+
+        return [], [], fn
+
+
+class Pol2CartStreamFunction(StreamFunctionDef):
+    """`#pol2Cart(theta, rho[, z])` appends cartesian x, y (reference:
+    Pol2CartStreamFunctionProcessor)."""
+
+    def compile(self, params, scope, sid):
+        if len(params) not in (2, 3):
+            raise CompileError("pol2Cart(theta, rho[, z]) takes 2-3 args")
+        theta = compile_expression(params[0], scope)
+        rho = compile_expression(params[1], scope)
+
+        def fn(env, valid):
+            t = jnp.asarray(theta.fn(env), jnp.float64)
+            r = jnp.asarray(rho.fn(env), jnp.float64)
+            return (r * jnp.cos(t), r * jnp.sin(t)), valid
+
+        return ["x", "y"], ["DOUBLE", "DOUBLE"], fn
+
+
+STREAM_FUNCTIONS: Dict[str, StreamFunctionDef] = {
+    "log": LogStreamFunction(),
+    "pol2Cart": Pol2CartStreamFunction(),
+}
+
+
+def stream_function_extension(name: str):
+    """Decorator registering a custom stream function
+    (reference: @Extension stream function types)."""
+    def deco(cls):
+        STREAM_FUNCTIONS[name] = cls() if isinstance(cls, type) else cls
+        return cls
+    return deco

@@ -1,0 +1,90 @@
+"""Stream functions (reference: LogStreamProcessor,
+Pol2CartStreamFunctionProcessor and the stream-function extension SPI)."""
+import logging
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.streamfn import (
+    StreamFunctionDef,
+    stream_function_extension,
+)
+
+
+def test_pol2cart_appends_xy():
+    ql = """
+    define stream P (theta double, rho double);
+    @info(name='q')
+    from P#pol2Cart(theta, rho)
+    select rho, x, y
+    insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    h = rt.get_input_handler("P")
+    h.send([0.0, 2.0])
+    rt.flush()
+    assert got[0].data[0] == pytest.approx(2.0)
+    assert got[0].data[1] == pytest.approx(2.0)   # x = rho*cos(0)
+    assert got[0].data[2] == pytest.approx(0.0)   # y = rho*sin(0)
+    manager.shutdown()
+
+
+def test_log_stream_function(caplog):
+    ql = """
+    define stream S (k string, v int);
+    @info(name='q')
+    from S#log('got event')
+    select k, v
+    insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    h = rt.get_input_handler("S")
+    with caplog.at_level(logging.INFO, logger="siddhi_tpu"):
+        h.send(["a", 1])
+        rt.flush()
+        import jax
+        jax.effects_barrier()
+    assert [e.data for e in got] == [["a", 1]]
+    assert any("got event" in r.message for r in caplog.records)
+    manager.shutdown()
+
+
+def test_custom_stream_function_extension():
+    import jax.numpy as jnp
+    from siddhi_tpu.core.executor import compile_expression
+
+    @stream_function_extension("custom:double")
+    class DoubleFn(StreamFunctionDef):
+        def compile(self, params, scope, sid):
+            src = compile_expression(params[0], scope)
+
+            def fn(env, valid):
+                return (jnp.asarray(src.fn(env)) * 2,), valid
+            return ["doubled"], ["LONG"], fn
+
+    ql = """
+    define stream S (k string, v long);
+    @info(name='q')
+    from S#custom:double(v)[doubled > 5]
+    select k, doubled
+    insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["a", 2])   # doubled=4, filtered
+    h.send(["b", 4])   # doubled=8, passes
+    rt.flush()
+    assert [e.data for e in got] == [["b", 8]]
+    manager.shutdown()
