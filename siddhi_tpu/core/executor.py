@@ -75,6 +75,10 @@ class Scope:
     `default_keys` in order (ambiguity is an error, as in the reference).
     """
 
+    # per-extension config access (utils/config.py); set by the planner when
+    # the app runtime carries a ConfigManager
+    config_manager = None
+
     def __init__(self):
         self._sources: Dict[str, "ev.Schema"] = {}
         self._aliases: Dict[str, str] = {}

@@ -73,6 +73,7 @@ def plan_single_query(
     window_capacity_hint: int = 2048,
     partition_positions: Optional[List[int]] = None,
     named_window_input: bool = False,
+    config_manager=None,
 ) -> PlannedQuery:
     ist = query.input_stream
     assert isinstance(ist, SingleInputStream)
@@ -99,9 +100,13 @@ def plan_single_query(
     scope = Scope()
     scope.interner = interner
     scope.add_source(sid, in_schema, alias=ist.stream_reference_id)
+    # extensions read per-extension config via
+    # scope.config_manager.generate_config_reader(namespace, name)
+    # (reference: ConfigReader wired in SingleInputStreamParser :205-217)
+    scope.config_manager = config_manager
 
     # ---- handlers: filters/stream-functions before/after the window --------
-    # chain entries: ('filter', compiled) | ('fn', names, dtypes, fn)
+    # chain entries: ('filter', compiled) | ('fn', dtypes, fn)
     pre_chain, post_chain = [], []
     if named_window_input:
         from .window import PassAllWindow
@@ -154,7 +159,7 @@ def plan_single_query(
 
     # ---- selector -----------------------------------------------------------
     out_target = query.output_stream.target_id if query.output_stream else ""
-    sel = SelectorExec(query.selector, scope, in_schema, group_slots,
+    sel = SelectorExec(query.selector, scope, chain_schema, group_slots,
                        out_target or name, interner)
 
     # output schema
@@ -191,7 +196,7 @@ def plan_single_query(
 
     def step(state, ts, kind, valid, cols, gslot, now, in_tabs=()):
         wstate, astate = state
-        env = {sid: cols, "__ts__": ts, "__now__": now}
+        env = {sid: cols, "__ts__": ts, "__now__": now, "__kind__": kind}
         for dep, (tcol0, tvalid) in zip(in_deps, in_tabs):
             def probe(vals, _tc=tcol0, _tv=tvalid):
                 return jnp.any(jnp.logical_and(
@@ -218,7 +223,8 @@ def plan_single_query(
                     seq=jnp.zeros_like(ts), gslot=gslot, cols=cols)
         wstate, wout = wproc.process(wstate, rows, now)
         orows = wout.rows
-        env2 = {sid: orows.cols, "__ts__": orows.ts, "__now__": now}
+        env2 = {sid: orows.cols, "__ts__": orows.ts, "__now__": now,
+                "__kind__": orows.kind}
         for k, v in env.items():
             if k.startswith("__in__:"):
                 env2[k] = v

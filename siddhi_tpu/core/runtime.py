@@ -762,6 +762,9 @@ class SiddhiAppRuntime:
         self.mesh = mesh  # jax.sharding.Mesh with a 'shard' axis, or None
         self.name = name or app.name or "SiddhiApp"
         self.interner = manager.interner
+        # system-wide properties + per-extension ConfigReaders; handed to the
+        # planner so extensions can read config at compile time
+        self.config_manager = manager.config_manager
         self.objects = ev.ObjectRegistry()
         self._lock = threading.RLock()
         self._scheduler = _Scheduler(self)
@@ -916,7 +919,8 @@ class SiddhiAppRuntime:
         from_window = in_sid in self.named_windows
         planned = plan_single_query(
             q, name, self.app.stream_definition_map, self.schemas,
-            self.interner, named_window_input=from_window)
+            self.interner, named_window_input=from_window,
+            config_manager=self.config_manager)
         runtime = QueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
         self.query_runtimes[name] = runtime
@@ -1122,7 +1126,8 @@ class SiddhiAppRuntime:
                 planned = plan_single_query(
                     q, qname, self.app.stream_definition_map, self.schemas,
                     self.interner, group_slots=max(keys_cap, 4096),
-                    partition_positions=ppos)
+                    partition_positions=ppos,
+                    config_manager=self.config_manager)
                 runtime = QueryRuntime(planned, self)
                 self.query_runtimes[qname] = runtime
                 self.junctions[sid].subscribe_query(runtime)
@@ -1364,16 +1369,24 @@ class SiddhiManager:
     """reference: CORE/SiddhiManager.java:49"""
 
     def __init__(self):
+        from ..utils.config import ConfigManager
         from ..utils.persistence import InMemoryPersistenceStore
         self.interner = ev.StringInterner()
         self.runtimes: Dict[str, SiddhiAppRuntime] = {}
         self.persistence_store = InMemoryPersistenceStore()
+        self.config_manager = ConfigManager()
 
     def set_persistence_store(self, store) -> None:
         """reference: SiddhiManager.setPersistenceStore"""
         self.persistence_store = store
 
+    def set_config_manager(self, config_manager) -> None:
+        """reference: SiddhiManager.setConfigManager — supplies system-wide
+        properties and per-extension ConfigReaders (utils/config.py)."""
+        self.config_manager = config_manager
+
     setPersistenceStore = set_persistence_store
+    setConfigManager = set_config_manager
 
     def create_siddhi_app_runtime(
             self, app: Union[str, SiddhiApp],

@@ -23,8 +23,14 @@ log = logging.getLogger("siddhi_tpu")
 
 
 class StreamFunctionDef:
-    """SPI: compile(params, scope, schema) ->
-    (new_names, new_types, fn(env, valid) -> (new_cols tuple, keep_mask))."""
+    """SPI: compile(params, scope, sid) ->
+    (new_names, new_types, fn(env, valid) -> (new_cols tuple, keep_mask)).
+
+    `sid` is the input stream id (a string).  `env` maps stream id -> column
+    tuple plus "__ts__"/"__now__"/"__kind__" arrays.  Per-extension config is
+    available as scope.config_manager (utils/config.py) when the app was
+    created with one.
+    """
 
     def compile(self, params, scope: Scope, sid: str):
         raise NotImplementedError
@@ -37,19 +43,29 @@ class LogStreamFunction(StreamFunctionDef):
     def compile(self, params, scope, sid):
         message = "events"
         priority = "INFO"
-        consts = [p for p in params if isinstance(p, Constant)]
-        if len(consts) == 1:
-            message = str(consts[0].value)
-        elif len(consts) >= 2:
-            priority = str(consts[0].value).upper()
-            message = str(consts[1].value)
+        if any(not isinstance(p, Constant) for p in params):
+            raise CompileError(
+                "log(...) parameters must be constants (per-event message "
+                "expressions are not supported on the fused device path)")
+        if len(params) == 1:
+            message = str(params[0].value)
+        elif len(params) >= 2:
+            priority = str(params[0].value).upper()
+            message = str(params[1].value)
         level = getattr(logging, priority, logging.INFO)
 
         def host_log(n):
-            log.log(level, "%s : %d event(s)", message, int(n))
+            if int(n):  # timer ticks / all-padding batches stay silent
+                log.log(level, "%s : %d event(s)", message, int(n))
 
         def fn(env, valid):
-            jax.debug.callback(host_log, jnp.sum(valid.astype(jnp.int32)))
+            import jax.numpy as _jnp
+            from . import event as _ev
+            arriving = valid
+            if "__kind__" in env:  # count CURRENT rows only, not EXPIRED
+                arriving = _jnp.logical_and(valid,
+                                            env["__kind__"] == _ev.CURRENT)
+            jax.debug.callback(host_log, jnp.sum(arriving.astype(jnp.int32)))
             return (), valid
 
         return [], [], fn
@@ -64,13 +80,18 @@ class Pol2CartStreamFunction(StreamFunctionDef):
             raise CompileError("pol2Cart(theta, rho[, z]) takes 2-3 args")
         theta = compile_expression(params[0], scope)
         rho = compile_expression(params[1], scope)
+        zc = compile_expression(params[2], scope) if len(params) == 3 else None
 
         def fn(env, valid):
             t = jnp.asarray(theta.fn(env), jnp.float64)
             r = jnp.asarray(rho.fn(env), jnp.float64)
-            return (r * jnp.cos(t), r * jnp.sin(t)), valid
+            out = (r * jnp.cos(t), r * jnp.sin(t))
+            if zc is not None:  # cylindrical: z passes through alongside x, y
+                out = out + (jnp.asarray(zc.fn(env), jnp.float64),)
+            return out, valid
 
-        return ["x", "y"], ["DOUBLE", "DOUBLE"], fn
+        names = ["x", "y"] + (["z"] if zc is not None else [])
+        return names, ["DOUBLE"] * len(names), fn
 
 
 STREAM_FUNCTIONS: Dict[str, StreamFunctionDef] = {

@@ -1,0 +1,136 @@
+"""Config system: ConfigManager SPI, InMemory + YAML managers, ConfigReader.
+
+Reference (what, not how): CORE/util/config/ConfigManager.java,
+InMemoryConfigManager.java, YAMLConfigManager.java:40 and ConfigReader —
+system-wide properties (e.g. ``shardId``, ``partitionById`` for distributed
+incremental aggregation, AggregationParser :173-197) plus per-extension
+``namespace.name.key`` config read by operators at plan time.  The ``${var}``
+env substitution half of the reference config story lives in
+compiler/__init__.py (SiddhiCompiler.update_variables).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+
+class ConfigReader:
+    """Per-extension config view (reference: CORE/util/config/ConfigReader).
+
+    Keys are looked up as ``<namespace>.<name>.<key>`` in the manager's
+    extension config map.
+    """
+
+    def __init__(self, namespace: str, name: str,
+                 configs: Optional[Dict[str, str]] = None):
+        self.namespace = namespace
+        self.name = name
+        self._configs = configs or {}
+
+    def read_config(self, key: str, default: Optional[str] = None):
+        return self._configs.get(
+            f"{self.namespace}.{self.name}.{key}", default)
+
+    def get_all_configs(self) -> Dict[str, str]:
+        prefix = f"{self.namespace}.{self.name}."
+        return {k[len(prefix):]: v for k, v in self._configs.items()
+                if k.startswith(prefix)}
+
+    readConfig = read_config
+    getAllConfigs = get_all_configs
+
+
+class ConfigManager:
+    """reference: CORE/util/config/ConfigManager interface."""
+
+    def generate_config_reader(self, namespace: str, name: str) -> ConfigReader:
+        return ConfigReader(namespace, name, {})
+
+    def extract_system_configs(self) -> Dict[str, str]:
+        return {}
+
+    def extract_property(self, name: str) -> Optional[str]:
+        return None
+
+    generateConfigReader = generate_config_reader
+    extractSystemConfigs = extract_system_configs
+    extractProperty = extract_property
+
+
+class InMemoryConfigManager(ConfigManager):
+    """reference: CORE/util/config/InMemoryConfigManager."""
+
+    def __init__(self, configs: Optional[Dict[str, str]] = None,
+                 system_configs: Optional[Dict[str, str]] = None):
+        self._configs = dict(configs or {})
+        self._system_configs = dict(system_configs or {})
+
+    def generate_config_reader(self, namespace, name):
+        return ConfigReader(namespace, name, self._configs)
+
+    def extract_system_configs(self):
+        return dict(self._system_configs)
+
+    def extract_property(self, name):
+        if name in self._system_configs:
+            return self._system_configs[name]
+        return self._configs.get(name)
+
+
+class YAMLConfigManager(ConfigManager):
+    """reference: CORE/util/config/YAMLConfigManager.java:40.
+
+    Accepts YAML text (or use :meth:`from_file`) shaped like the reference's
+    model (util/config/model/*)::
+
+        properties:
+          shardId: wrk-1
+          partitionById: "true"
+        refs:                       # per-extension configs
+          - ref:
+              namespace: source
+              name: http
+              properties:
+                port: "8080"
+        extensions:                 # flat alternative
+          source.http.idle.timeout: "30"
+    """
+
+    def __init__(self, yaml_text: str = ""):
+        import yaml as _yaml
+        data = _yaml.safe_load(yaml_text) if yaml_text else None
+        data = data or {}
+        if not isinstance(data, dict):
+            raise ValueError(
+                "YAML config must be a mapping with optional keys "
+                f"'properties'/'refs'/'extensions', got {type(data).__name__}")
+        self._system: Dict[str, str] = {
+            str(k): str(v) for k, v in (data.get("properties") or {}).items()}
+        flat: Dict[str, str] = {
+            str(k): str(v) for k, v in (data.get("extensions") or {}).items()}
+        for entry in data.get("refs") or []:
+            ref = entry.get("ref") if isinstance(entry, dict) else None
+            if not ref:
+                continue
+            ns, nm = ref.get("namespace"), ref.get("name")
+            if not ns or not nm:
+                raise ValueError(
+                    f"config ref needs both 'namespace' and 'name': {ref}")
+            for k, v in (ref.get("properties") or {}).items():
+                flat[f"{ns}.{nm}.{k}"] = str(v)
+        self._configs = flat
+
+    @classmethod
+    def from_file(cls, path: str) -> "YAMLConfigManager":
+        with open(path) as f:
+            return cls(f.read())
+
+    def generate_config_reader(self, namespace, name):
+        return ConfigReader(namespace, name, self._configs)
+
+    def extract_system_configs(self):
+        return dict(self._system)
+
+    def extract_property(self, name):
+        if name in self._system:
+            return self._system[name]
+        return self._configs.get(name)

@@ -1,0 +1,89 @@
+"""Config system tests (reference: TEST config usage + YAMLConfigManager).
+
+Covers: InMemoryConfigManager, YAMLConfigManager (refs/flat/properties),
+ConfigReader lookup, SiddhiManager wiring, ${var} substitution.
+"""
+import os
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.compiler import SiddhiCompiler
+from siddhi_tpu.compiler.tokenizer import SiddhiParserException
+from siddhi_tpu.utils.config import (
+    ConfigReader,
+    InMemoryConfigManager,
+    YAMLConfigManager,
+)
+
+YAML_TEXT = """
+properties:
+  shardId: wrk-1
+  partitionById: "true"
+refs:
+  - ref:
+      namespace: source
+      name: http
+      properties:
+        port: 8080
+        host: localhost
+extensions:
+  sink.log.priority: INFO
+"""
+
+
+class TestConfigManagers:
+    def test_in_memory_extension_configs(self):
+        cm = InMemoryConfigManager(
+            {"source.http.port": "9090"}, {"shardId": "s-2"})
+        reader = cm.generate_config_reader("source", "http")
+        assert reader.read_config("port") == "9090"
+        assert reader.read_config("missing", "dflt") == "dflt"
+        assert cm.extract_property("shardId") == "s-2"
+        assert cm.extract_system_configs() == {"shardId": "s-2"}
+
+    def test_yaml_manager(self):
+        cm = YAMLConfigManager(YAML_TEXT)
+        assert cm.extract_property("shardId") == "wrk-1"
+        assert cm.extract_system_configs()["partitionById"] == "true"
+        r = cm.generate_config_reader("source", "http")
+        assert r.read_config("port") == "8080"
+        assert r.get_all_configs() == {"port": "8080", "host": "localhost"}
+        assert cm.generate_config_reader("sink", "log") \
+            .read_config("priority") == "INFO"
+
+    def test_yaml_empty(self):
+        cm = YAMLConfigManager("")
+        assert cm.extract_system_configs() == {}
+        assert cm.extract_property("x") is None
+
+    def test_reader_scoped_to_extension(self):
+        r = ConfigReader("a", "b", {"a.b.k": "1", "a.c.k": "2"})
+        assert r.read_config("k") == "1"
+        assert r.get_all_configs() == {"k": "1"}
+
+
+class TestManagerWiring:
+    def test_runtime_sees_config_manager(self):
+        m = SiddhiManager()
+        m.set_config_manager(InMemoryConfigManager({}, {"shardId": "w9"}))
+        rt = m.create_siddhi_app_runtime(
+            "define stream S (a int); "
+            "@info(name='q') from S select a insert into O;")
+        assert rt.config_manager.extract_property("shardId") == "w9"
+        m.shutdown()
+
+
+class TestVarSubstitution:
+    def test_env_substitution(self):
+        os.environ["SIDTPU_TEST_STREAM"] = "EnvStream"
+        try:
+            app = SiddhiCompiler.parse(
+                "define stream ${SIDTPU_TEST_STREAM} (a int);")
+            assert "EnvStream" in app.stream_definition_map
+        finally:
+            del os.environ["SIDTPU_TEST_STREAM"]
+
+    def test_missing_var_raises(self):
+        with pytest.raises(SiddhiParserException):
+            SiddhiCompiler.parse("define stream ${SIDTPU_NOPE_X} (a int);")

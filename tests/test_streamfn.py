@@ -88,3 +88,59 @@ def test_custom_stream_function_extension():
     rt.flush()
     assert [e.data for e in got] == [["b", 8]]
     manager.shutdown()
+
+
+def test_pol2cart_select_star_includes_appended():
+    """select * expands over the post-chain schema (x, y included)."""
+    ql = """
+    define stream P (theta double, rho double);
+    @info(name='q')
+    from P#pol2Cart(theta, rho)
+    select *
+    insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    assert list(rt.schemas["Out"].names) == ["theta", "rho", "x", "y"]
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    rt.get_input_handler("P").send([0.0, 3.0])
+    rt.flush()
+    assert got[0].data[1] == pytest.approx(3.0)
+    assert got[0].data[2] == pytest.approx(3.0)
+    assert got[0].data[3] == pytest.approx(0.0)
+    manager.shutdown()
+
+
+def test_pol2cart_three_arg_appends_z():
+    ql = """
+    define stream P (theta double, rho double, height double);
+    @info(name='q')
+    from P#pol2Cart(theta, rho, height)
+    select x, y, z
+    insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    rt.get_input_handler("P").send([0.0, 2.0, 7.5])
+    rt.flush()
+    assert got[0].data[0] == pytest.approx(2.0)
+    assert got[0].data[1] == pytest.approx(0.0)
+    assert got[0].data[2] == pytest.approx(7.5)
+    manager.shutdown()
+
+
+def test_log_rejects_non_constant_params():
+    from siddhi_tpu.core.executor import CompileError
+    ql = """
+    define stream S (k string, v int);
+    @info(name='q') from S#log(k) select v insert into Out;
+    """
+    manager = SiddhiManager()
+    with pytest.raises(CompileError):
+        manager.create_siddhi_app_runtime(ql)
+    manager.shutdown()
