@@ -179,16 +179,21 @@ def linearize(sis: StateInputStream, count_cap: int = 8) -> PatternSpec:
 # ---------------------------------------------------------------------------
 
 class PatternState(NamedTuple):
-    active: Any       # bool[K,P]
-    pos: Any          # i32[K,P]
-    count: Any        # i32[K,P] captures at current pos
-    lmask: Any        # i32[K,P] logical sides satisfied (bit0/bit1)
-    start_ts: Any     # i64[K,P]
-    entry_ts: Any     # i64[K,P] ts of entering current pos
+    """Per-key NFA slab.  The key axis K is LAST on every leaf so the whole
+    state pipeline (blob [W,K] <-> leaves <-> tick ops) stays key-minor: K
+    rides the TPU lane dimension and pack/unpack are pure reshapes, no
+    transposes (a [K,P] convention cost ~80ms/step in layout churn at 131k
+    keys)."""
+    active: Any       # bool[P,K]
+    pos: Any          # i32[P,K]
+    count: Any        # i32[P,K] captures at current pos
+    lmask: Any        # i32[P,K] logical sides satisfied (bit0/bit1)
+    start_ts: Any     # i64[P,K]
+    entry_ts: Any     # i64[P,K] ts of entering current pos
     seed_on: Any      # bool[K]
     done: Any         # bool[K]  non-every pattern already matched
     dropped: Any      # i64 scalar: forks dropped on slab overflow
-    caps: Dict[str, Tuple]   # atom.ckey -> (ts[K,P,D], cols tuple [K,P,D])
+    caps: Dict[str, Tuple]   # atom.ckey -> (ts[P,D,K], cols tuple [P,D,K])
 
 
 class PatternExec:
@@ -237,16 +242,16 @@ class PatternExec:
             schema = self.schemas[a.stream_id]
             D = a.capture_depth
             cols = tuple(
-                jnp.full((K, P, D), ev.default_value(t), dtype=d)
+                jnp.full((P, D, K), ev.default_value(t), dtype=d)
                 for t, d in zip(schema.types, schema.dtypes))
-            caps[a.ckey] = (jnp.zeros((K, P, D), jnp.int64), cols)
+            caps[a.ckey] = (jnp.zeros((P, D, K), jnp.int64), cols)
         return PatternState(
-            active=jnp.zeros((K, P), jnp.bool_),
-            pos=jnp.zeros((K, P), jnp.int32),
-            count=jnp.zeros((K, P), jnp.int32),
-            lmask=jnp.zeros((K, P), jnp.int32),
-            start_ts=jnp.zeros((K, P), jnp.int64),
-            entry_ts=jnp.zeros((K, P), jnp.int64),
+            active=jnp.zeros((P, K), jnp.bool_),
+            pos=jnp.zeros((P, K), jnp.int32),
+            count=jnp.zeros((P, K), jnp.int32),
+            lmask=jnp.zeros((P, K), jnp.int32),
+            start_ts=jnp.zeros((P, K), jnp.int64),
+            entry_ts=jnp.zeros((P, K), jnp.int64),
             seed_on=jnp.ones((K,), jnp.bool_),
             done=jnp.zeros((K,), jnp.bool_),
             dropped=jnp.asarray(0, jnp.int64),
@@ -258,24 +263,24 @@ class PatternExec:
              ev_valid, now_k):
         spec = self.spec
         S = self.S
-        K, P = st.active.shape
+        P, K = st.active.shape
         a0 = spec.atoms[0]
-        F = jnp.zeros((K, P), jnp.bool_)
+        F = jnp.zeros((P, K), jnp.bool_)
 
         # ---- phase 1: within expiry ----------------------------------------
         if spec.within is not None:
-            alive = now_k[:, None] - st.start_ts <= spec.within
+            alive = now_k[None, :] - st.start_ts <= spec.within
             st = st._replace(active=jnp.logical_and(st.active, alive))
 
         # ---- phase 2: absent deadlines -------------------------------------
         absent_complete = F
-        absent_ts = jnp.zeros((K, P), jnp.int64)
+        absent_ts = jnp.zeros((P, K), jnp.int64)
         for a in spec.atoms:
             if not a.absent:
                 continue
             at_pos = jnp.logical_and(st.active, st.pos == a.pos)
             due = jnp.logical_and(
-                at_pos, st.entry_ts + a.waiting_time <= now_k[:, None])
+                at_pos, st.entry_ts + a.waiting_time <= now_k[None, :])
             if a.pos == S - 1:
                 absent_complete = jnp.logical_or(absent_complete, due)
                 absent_ts = jnp.where(due, st.entry_ts + a.waiting_time,
@@ -314,11 +319,21 @@ class PatternExec:
                 if atom.stream_id != stream_id:
                     continue
                 filt = self._filters[atom.ckey]
-                cond = jnp.ones((K, P), jnp.bool_) if filt is None else \
-                    jnp.broadcast_to(filt.fn(env), (K, P))
+                if filt is None:
+                    cond = jnp.ones((P, K), jnp.bool_)
+                else:
+                    # the atom under evaluation sees the INCOMING event under
+                    # its own ref; other refs stay bound to captures (binding
+                    # by stream id wrongly aliased e1.price to the current
+                    # event for same-stream patterns)
+                    env_a = dict(env)
+                    env_a[atom.ref] = tuple(
+                        jnp.broadcast_to(c[None, :], (P, K))
+                        for c in ev_cols)
+                    cond = jnp.broadcast_to(filt.fn(env_a), (P, K))
                 at_pos = jnp.logical_and(st.active, st.pos == a.pos)
                 m = jnp.logical_and(jnp.logical_and(at_pos, cond),
-                                    ev_ok[:, None])
+                                    ev_ok[None, :])
                 if a.absent:
                     kill = jnp.logical_or(kill, m)   # absence violated
                     continue
@@ -364,7 +379,7 @@ class PatternExec:
         if spec.state_type == "SEQUENCE":
             no_match = jnp.logical_and(
                 st.active,
-                jnp.logical_and(ev_ok[:, None], jnp.logical_not(matched_any)))
+                jnp.logical_and(ev_ok[None, :], jnp.logical_not(matched_any)))
             kill = jnp.logical_or(kill, no_match)
 
         # ---- seed (virtual pending slot at position 0) ---------------------
@@ -374,8 +389,14 @@ class PatternExec:
             if atom is None or atom.stream_id != stream_id or a0.absent:
                 continue
             filt = self._filters[atom.ckey]
-            c = jnp.ones((K,), jnp.bool_) if filt is None else \
-                _seed_eval(filt, env, K)
+            if filt is None:
+                c = jnp.ones((K,), jnp.bool_)
+            else:
+                env_s = dict(env)
+                env_s[atom.ref] = tuple(
+                    jnp.broadcast_to(cc[None, :], st.active.shape)
+                    for cc in ev_cols)
+                c = _seed_eval(filt, env_s, K)
             sm = jnp.logical_and(jnp.logical_and(st.seed_on, ev_ok), c)
             seed_side = jnp.where(
                 jnp.logical_and(sm, jnp.logical_not(seed_match)), side,
@@ -409,7 +430,7 @@ class PatternExec:
         if not a0.every:
             st = st._replace(seed_on=jnp.logical_and(
                 st.seed_on, jnp.logical_not(seed_match)))
-            newly_done = jnp.logical_or(jnp.any(complete, axis=1),
+            newly_done = jnp.logical_or(jnp.any(complete, axis=0),
                                         seed_complete)
             st = st._replace(done=jnp.logical_or(st.done, newly_done))
 
@@ -426,27 +447,27 @@ class PatternExec:
             if here is None:
                 newcaps[ck] = (ts_c, cols_c)
                 continue
-            D = ts_c.shape[2]
+            D = ts_c.shape[1]
             idx = jnp.clip(st.count, 0, D - 1)
             ncols = tuple(
                 _set_along(c, idx, jnp.broadcast_to(
-                    ev_cols[j][:, None], idx.shape), here)
+                    ev_cols[j][None, :], idx.shape), here)
                 for j, c in enumerate(cols_c))
             nts = _set_along(ts_c, idx, jnp.broadcast_to(
-                ev_ts[:, None], idx.shape), here)
+                ev_ts[None, :], idx.shape), here)
             newcaps[ck] = (nts, ncols)
         st = st._replace(caps=newcaps)
 
-        # ---- phase 5: emission gather --------------------------------------
-        emit_mask = jnp.concatenate([complete, seed_complete[:, None]], axis=1)
+        # ---- phase 5: emission gather ([P+1, K]: slot axis + seed row) -----
+        emit_mask = jnp.concatenate([complete, seed_complete[None, :]], axis=0)
         emit_ts = jnp.concatenate([
             jnp.where(absent_complete, absent_ts,
-                      jnp.broadcast_to(ev_ts[:, None], (K, P))),
-            ev_ts[:, None]], axis=1)                      # [K,P+1]
+                      jnp.broadcast_to(ev_ts[None, :], (P, K))),
+            ev_ts[None, :]], axis=0)                      # [P+1,K]
         emit_count = jnp.concatenate(
             [jnp.where(complete, st.count + jnp.where(
                 capture_any(capture, F), 1, 0), 0),
-             jnp.ones((K, 1), jnp.int32)], axis=1)
+             jnp.ones((1, K), jnp.int32)], axis=0)
         emit: Dict[str, Any] = {"mask": emit_mask, "ts": emit_ts,
                                 "count": emit_count}
         for a in spec.all_atoms():
@@ -456,19 +477,19 @@ class PatternExec:
                 continue
             ck = a.ckey
             ts_c, cols_c = st.caps[ck]
-            D = ts_c.shape[2]
+            D = ts_c.shape[1]
             is_seed_cap = (a.pos == 0 and a.stream_id == stream_id)
             seed_cols = tuple(
-                jnp.broadcast_to(ev_cols[j][:, None, None], (K, 1, D))
+                jnp.broadcast_to(ev_cols[j][None, None, :], (1, D, K))
                 if is_seed_cap else
-                jnp.zeros((K, 1, D), c.dtype)
+                jnp.zeros((1, D, K), c.dtype)
                 for j, c in enumerate(cols_c))
             emit[ck] = (
                 jnp.concatenate(
-                    [ts_c, jnp.broadcast_to(ev_ts[:, None, None], (K, 1, D))
-                     if is_seed_cap else jnp.zeros((K, 1, D), jnp.int64)],
-                    axis=1),
-                tuple(jnp.concatenate([c, sc], axis=1)
+                    [ts_c, jnp.broadcast_to(ev_ts[None, None, :], (1, D, K))
+                     if is_seed_cap else jnp.zeros((1, D, K), jnp.int64)],
+                    axis=0),
+                tuple(jnp.concatenate([c, sc], axis=0)
                       for c, sc in zip(cols_c, seed_cols)))
 
         # ---- phase 6: spawn forks + seed -----------------------------------
@@ -485,7 +506,7 @@ class PatternExec:
             pos=jnp.where(advance_inplace, st.pos + 1,
                           st.pos).astype(jnp.int32),
             lmask=jnp.where(advance_inplace, 0, st.lmask).astype(jnp.int32),
-            entry_ts=jnp.where(advance_inplace, ev_ts[:, None], st.entry_ts),
+            entry_ts=jnp.where(advance_inplace, ev_ts[None, :], st.entry_ts),
             active=jnp.logical_and(
                 st.active,
                 jnp.logical_not(jnp.logical_or(kill, deactivate))),
@@ -503,7 +524,7 @@ class PatternExec:
         candidate with allocation-rank r_j lands there.  The rank->candidate
         inverse is a one-hot contraction over the tiny NC=P+2 axis, then all
         payload moves are take_along_axis gathers."""
-        K, P = st.active.shape
+        P, K = st.active.shape
         spec = self.spec
 
         # candidates: P slot-forks + seed (+ optional second seed continuation)
@@ -512,59 +533,61 @@ class PatternExec:
         seed2 = jnp.logical_and(seed_spawn, jnp.asarray(seed_fork_also))
         if seed_fork_also:
             cand_valid = jnp.concatenate(
-                [fork, seed_spawn[:, None], seed2[:, None]], axis=1)
+                [fork, seed_spawn[None, :], seed2[None, :]], axis=0)
         else:
-            cand_valid = jnp.concatenate([fork, seed_spawn[:, None]], axis=1)
+            cand_valid = jnp.concatenate([fork, seed_spawn[None, :]], axis=0)
 
-        rank = jnp.cumsum(cand_valid.astype(jnp.int32), axis=1) - 1  # [K,NC]
-        free = jnp.logical_not(st.active)                            # [K,P]
-        free_rank = jnp.cumsum(free.astype(jnp.int32), axis=1) - 1   # [K,P]
-        nfree = jnp.sum(free.astype(jnp.int32), axis=1)              # [K]
-        ncand = jnp.sum(cand_valid.astype(jnp.int32), axis=1)
+        rank = jnp.cumsum(cand_valid.astype(jnp.int32), axis=0) - 1  # [NC,K]
+        free = jnp.logical_not(st.active)                            # [P,K]
+        free_rank = jnp.cumsum(free.astype(jnp.int32), axis=0) - 1   # [P,K]
+        nfree = jnp.sum(free.astype(jnp.int32), axis=0)              # [K]
+        ncand = jnp.sum(cand_valid.astype(jnp.int32), axis=0)
 
         # destination slot j takes candidate c iff free[j] and
         # rank[c] == free_rank[j] (and candidate exists)
         hot = jnp.logical_and(
-            jnp.logical_and(cand_valid[:, None, :],
-                            rank[:, None, :] == free_rank[:, :, None]),
-            free[:, :, None])                                        # [K,P,NC]
-        has_cand = jnp.any(hot, axis=2)                              # [K,P]
-        take = jnp.argmax(hot, axis=2).astype(jnp.int32)             # [K,P]
+            jnp.logical_and(cand_valid[None, :, :],
+                            rank[None, :, :] == free_rank[:, None, :]),
+            free[:, None, :])                                        # [P,NC,K]
+        has_cand = jnp.any(hot, axis=1)                              # [P,K]
 
         st = st._replace(dropped=st.dropped + jnp.sum(
             jnp.maximum(ncand - nfree, 0).astype(jnp.int64)))
 
         def pull(cand_field, old_field):
-            got = jnp.take_along_axis(cand_field, take, axis=1)
+            # one-hot contraction over the tiny NC axis; a take_along_axis
+            # here compiles to an element-serialized TPU gather (measured
+            # 180ms/step at 131k keys — the whole step budget)
+            got = oh_take(cand_field[None, :, :], hot, 1)
             return jnp.where(has_cand, got, old_field)
 
-        # candidate payloads [K,NC]
+        # candidate payloads [NC,K]
         fork_pos = st.pos + 1
         if seed_fork_also:
             # first seed candidate: advancing slot (pos 1); second: collector
             cpos = jnp.concatenate(
                 [fork_pos,
-                 jnp.full((K, 1), 1, jnp.int32),
-                 jnp.full((K, 1), 0, jnp.int32)], axis=1)
+                 jnp.full((1, K), 1, jnp.int32),
+                 jnp.full((1, K), 0, jnp.int32)], axis=0)
             ccount = jnp.concatenate(
-                [jnp.zeros((K, P), jnp.int32),
-                 jnp.zeros((K, 1), jnp.int32),
-                 jnp.ones((K, 1), jnp.int32)], axis=1)
+                [jnp.zeros((P, K), jnp.int32),
+                 jnp.zeros((1, K), jnp.int32),
+                 jnp.ones((1, K), jnp.int32)], axis=0)
         else:
             cpos = jnp.concatenate(
-                [fork_pos, jnp.full((K, 1), seed_pos, jnp.int32)], axis=1)
+                [fork_pos, jnp.full((1, K), seed_pos, jnp.int32)], axis=0)
             ccount = jnp.concatenate(
-                [jnp.zeros((K, P), jnp.int32),
-                 jnp.full((K, 1), seed_count, jnp.int32)], axis=1)
+                [jnp.zeros((P, K), jnp.int32),
+                 jnp.full((1, K), seed_count, jnp.int32)], axis=0)
         seed_lmask = jnp.where(
             seed_spawn, jnp.left_shift(jnp.ones((K,), jnp.int32), seed_side),
-            0)[:, None] if a0.logical is not None else jnp.zeros((K, 1),
+            0)[None, :] if a0.logical is not None else jnp.zeros((1, K),
                                                                  jnp.int32)
         clmask = jnp.concatenate(
-            [jnp.zeros((K, P), jnp.int32)] + [seed_lmask] * extra, axis=1)
+            [jnp.zeros((P, K), jnp.int32)] + [seed_lmask] * extra, axis=0)
         cstart = jnp.concatenate(
-            [st.start_ts] + [ev_ts[:, None]] * extra, axis=1)
-        centry = jnp.broadcast_to(ev_ts[:, None], (K, NC))
+            [st.start_ts] + [ev_ts[None, :]] * extra, axis=0)
+        centry = jnp.broadcast_to(ev_ts[None, :], (NC, K))
 
         st = st._replace(
             active=jnp.logical_or(st.active, has_cand),
@@ -578,34 +601,31 @@ class PatternExec:
         # captures: forks inherit the source slot (post-capture state, which
         # already includes this event); seeds get the incoming event at atom0
         newcaps = {}
-        is_seed_cand = jnp.concatenate(
-            [jnp.zeros((K, P), jnp.bool_)] +
-            [jnp.ones((K, 1), jnp.bool_)] * extra, axis=1)       # [K,NC]
-        seed_taken = jnp.take_along_axis(is_seed_cand, take, axis=1)  # [K,P]
-        # fork candidate c (< P) sources from slot c; pull source slot per dst
-        src_of_cand = jnp.concatenate(
-            [jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (K, P))] +
-            [jnp.zeros((K, 1), jnp.int32)] * extra, axis=1)
-        src_slot = jnp.take_along_axis(src_of_cand, take, axis=1)     # [K,P]
+        # fork candidate c (< P) sources from slot c; seed candidates are the
+        # trailing `extra` rows.  All moves are one-hot contractions over
+        # the tiny candidate/slot axes (TPU-serialized gathers avoided).
+        seed_taken = jnp.any(hot[:, P:, :], axis=1)              # [P,K]
+        fork_hot = hot[:, :P, :]                                 # [P(dst),P(src),K]
         fork_taken = jnp.logical_and(has_cand, jnp.logical_not(seed_taken))
         for a in spec.all_atoms():
             if a.absent:
                 continue
             ck = a.ckey
             ts_c, cols_c = st.caps[ck]
-            D = ts_c.shape[2]
+            D = ts_c.shape[1]
             seed_has = (a.pos == 0 and a.stream_id == stream_id)
-            first_d = (jnp.arange(D) == 0)[None, None, :]
-            seed_m = jnp.logical_and(seed_taken[:, :, None],
-                                     jnp.ones((1, 1, D), jnp.bool_))
+            first_d = (jnp.arange(D) == 0)[None, :, None]
+            seed_m = jnp.logical_and(seed_taken[:, None, :],
+                                     jnp.ones((1, D, 1), jnp.bool_))
 
             def merge(c, incoming):
-                inherited = jnp.take_along_axis(c, src_slot[:, :, None],
-                                                axis=1)      # [K,P,D]
-                out = jnp.where(fork_taken[:, :, None], inherited, c)
+                # c [P,D,K]; inherited[p,d,k] = sum_src hot[p,src,k]*c[src,d,k]
+                inherited = oh_take(c[None, :, :, :],
+                                    fork_hot[:, :, None, :], 1)  # [P,D,K]
+                out = jnp.where(fork_taken[:, None, :], inherited, c)
                 if seed_has:
-                    iv = jnp.broadcast_to(incoming[:, None, None],
-                                          (K, P, D)).astype(c.dtype)
+                    iv = jnp.broadcast_to(incoming[None, None, :],
+                                          (P, D, K)).astype(c.dtype)
                     out = jnp.where(
                         jnp.logical_and(seed_m, first_d), iv,
                         jnp.where(seed_m, jnp.zeros_like(out), out))
@@ -620,24 +640,30 @@ class PatternExec:
 
     # -- env ------------------------------------------------------------------
     def _build_env(self, st: PatternState, stream_id: str, ev_cols, ev_ts):
-        env: Dict[str, Any] = {"__ts__": ev_ts[:, None]}
+        env: Dict[str, Any] = {"__ts__": ev_ts[None, :]}
         for a in self.spec.all_atoms():
             if a.absent:
                 continue
-            ts_c, cols_c = st.caps[a.ckey]
-            D = ts_c.shape[2]
-            if a.stream_id == stream_id:
-                env[a.ref] = tuple(jnp.broadcast_to(
-                    c[:, None], st.active.shape) for c in ev_cols)
-            else:
-                env[a.ref] = tuple(c[:, :, 0] for c in cols_c)
+            ts_c, cols_c = st.caps[a.ckey]       # [P,D,K]
+            D = ts_c.shape[1]
+            env[a.ref] = tuple(c[:, 0, :] for c in cols_c)
             for i in range(D):
-                env[f"{a.ref}@{i}"] = tuple(c[:, :, i] for c in cols_c)
+                env[f"{a.ref}@{i}"] = tuple(c[:, i, :] for c in cols_c)
             last_i = jnp.clip(st.count - 1, 0, D - 1)
-            env[f"{a.ref}@-1"] = tuple(
-                jnp.take_along_axis(c, last_i[:, :, None], axis=2)[:, :, 0]
-                for c in cols_c)
+            last_oh = jnp.arange(D)[None, :, None] == last_i[:, None, :]
+            env[f"{a.ref}@-1"] = tuple(oh_take(c, last_oh, 1)
+                                       for c in cols_c)
         return env
+
+
+def oh_take(c, oh, axis):
+    """Gather along a tiny axis as a one-hot contraction (select + reduce).
+    TPU-friendly replacement for take_along_axis, whose generic gather
+    lowers to element-serialized DMA on TPU."""
+    if c.dtype == jnp.bool_:
+        return jnp.any(jnp.logical_and(oh, c), axis=axis)
+    return jnp.sum(jnp.where(oh, c, jnp.zeros((), c.dtype)), axis=axis,
+                   dtype=c.dtype)
 
 
 def capture_any(capture: Dict[str, Any], F):
@@ -650,14 +676,14 @@ def capture_any(capture: Dict[str, Any], F):
 def _seed_eval(filt: CompiledExpr, env, K):
     v = filt.fn(env)
     v = jnp.broadcast_to(v, v.shape if v.ndim else (K,))
-    if v.ndim == 2:
-        return v[:, 0]
+    if v.ndim == 2:     # [P,K] -> any slot row works; captures are zeroed
+        return v[0, :]
     return v
 
 
 def _set_along(arr, idx, vals, mask):
-    """arr[k,p, idx[k,p]] = vals[k,p] where mask[k,p]."""
+    """arr[p, idx[p,k], k] = vals[p,k] where mask[p,k]; arr is [P,D,K]."""
     hit = jnp.logical_and(
-        jnp.arange(arr.shape[2])[None, None, :] == idx[:, :, None],
-        mask[:, :, None])
-    return jnp.where(hit, vals[:, :, None].astype(arr.dtype), arr)
+        jnp.arange(arr.shape[1])[None, :, None] == idx[:, None, :],
+        mask[:, None, :])
+    return jnp.where(hit, vals[:, None, :].astype(arr.dtype), arr)

@@ -20,19 +20,28 @@ from ..query_api.definition import StreamDefinition
 from ..query_api.query import Query, StateInputStream
 from . import event as ev
 from .executor import CompileError, Scope
-from .pattern import PatternExec, PatternSpec, linearize
+from .pattern import PatternExec, PatternSpec, linearize, oh_take
 from .selector import SelectorExec
 from .window import NO_WAKEUP, Rows
 
 
 class StatePacker:
     """Pack a per-key state pytree (array leaves with leading K axis) into
-    two blobs: one i32 (i32/f32-bitcast/bool) and one i64.
+    two blobs: one i32 (i32/f32-bitcast/bool) and one i64, stored [W, K]
+    (key axis MINOR).
 
-    Why: XLA:TPU scatter has a large per-op cost (~7ms for 32k rows measured
-    through the axon tunnel), roughly independent of row width.  The NFA
-    state has ~24 leaf arrays; scattering each per batch dominated the step.
-    Packing reduces the per-batch key-state update to 2 gathers + 2 scatters.
+    Why two blobs: XLA:TPU scatter has a large per-op cost (~7ms for 32k rows
+    measured through the axon tunnel), roughly independent of row width.  The
+    NFA state has ~24 leaf arrays; scattering each per batch dominated the
+    step.  Packing reduces the per-batch key-state update to 2 gathers + 2
+    scatters.
+
+    Why [W, K] and not [K, W]: with keys leading, XLA:TPU layout assignment
+    picked a key-major {0,1} layout for the [K, W] blobs, so every per-key
+    row gather touched W whole (8,128) tiles — ~15 GB of HBM traffic per
+    131k-key step (measured).  With keys minor, per-key access rides the
+    tiled minor axis and batch key indices arrive sorted (keyslots group
+    ascending), so gather/scatter granules are dense.
     """
 
     def __init__(self, example):
@@ -47,27 +56,27 @@ class StatePacker:
                                   0))
                 self.scalars.append(i)
                 continue
-            tail = leaf.shape[1:]
+            head = leaf.shape[:-1]     # K is the LAST axis on every leaf
             width = 1
-            for d in tail:
+            for d in head:
                 width *= d
             if leaf.dtype == jnp.int64:
-                self.recs.append(("i64", leaf.dtype, tail, self.w64, width))
+                self.recs.append(("i64", leaf.dtype, head, self.w64, width))
                 self.w64 += width
             else:
-                self.recs.append(("i32", leaf.dtype, tail, self.w32, width))
+                self.recs.append(("i32", leaf.dtype, head, self.w32, width))
                 self.w32 += width
 
     def pack(self, state):
         leaves = jax.tree_util.tree_flatten(state)[0]
         K = None
         parts32, parts64, scal = [], [], []
-        for leaf, (kind, dtype, tail, off, width) in zip(leaves, self.recs):
+        for leaf, (kind, dtype, head, off, width) in zip(leaves, self.recs):
             if kind == "scalar":
                 scal.append(leaf)
                 continue
-            K = leaf.shape[0]
-            flat = leaf.reshape(K, width)
+            K = leaf.shape[-1]
+            flat = leaf.reshape(width, K)            # pure reshape, K minor
             if kind == "i64":
                 parts64.append(flat.astype(jnp.int64))
             else:
@@ -76,32 +85,31 @@ class StatePacker:
                 else:
                     flat = flat.astype(jnp.int32)
                 parts32.append(flat)
-        b32 = jnp.concatenate(parts32, axis=1) if parts32 else \
-            jnp.zeros((K, 0), jnp.int32)
-        b64 = jnp.concatenate(parts64, axis=1) if parts64 else \
-            jnp.zeros((K, 0), jnp.int64)
+        b32 = jnp.concatenate(parts32, axis=0) if parts32 else \
+            jnp.zeros((0, K), jnp.int32)
+        b64 = jnp.concatenate(parts64, axis=0) if parts64 else \
+            jnp.zeros((0, K), jnp.int64)
         return b32, b64, tuple(scal)
 
     def unpack(self, b32, b64, scalars):
         leaves = []
-        K = b32.shape[0] if b32.size or b32.shape[1] == 0 else b64.shape[0]
-        K = b32.shape[0]
-        for kind, dtype, tail, off, width in self.recs:
+        K = b32.shape[1]
+        for kind, dtype, head, off, width in self.recs:
             if kind == "scalar":
                 leaves.append(scalars[off])
                 continue
             if kind == "i64":
-                flat = lax.dynamic_slice_in_dim(b64, off, width, axis=1)
-                leaf = flat.reshape((K,) + tail)
+                flat = lax.dynamic_slice_in_dim(b64, off, width, axis=0)
+                leaf = flat.reshape(head + (K,))
             else:
-                flat = lax.dynamic_slice_in_dim(b32, off, width, axis=1)
+                flat = lax.dynamic_slice_in_dim(b32, off, width, axis=0)
                 if dtype == jnp.float32:
-                    leaf = lax.bitcast_convert_type(flat, jnp.float32)
-                elif dtype == jnp.bool_:
-                    leaf = flat != 0
-                else:
-                    leaf = flat.astype(dtype)
-                leaf = leaf.reshape((K,) + tail)
+                    flat = lax.bitcast_convert_type(flat, jnp.float32)
+                leaf = flat.reshape(head + (K,))
+                if dtype == jnp.bool_:
+                    leaf = leaf != 0
+                elif dtype != jnp.float32:
+                    leaf = leaf.astype(dtype)
             leaves.append(leaf)
         return jax.tree_util.tree_unflatten(self.treedef, leaves)
 
@@ -123,6 +131,11 @@ class PlannedPatternQuery:
     partition_positions: Optional[Dict[str, List[int]]] = None
     raw_steps: Optional[Dict[str, Callable]] = None   # unjitted bodies
     mesh: Any = None
+    # contiguous-slot fast path: takes a scalar key_lo instead of key_idx and
+    # reads/writes the state slab with dynamic slices — generic row
+    # gather/scatter on TPU is row-serialized (~0.3us/row; 131k-key batch =
+    # ~90ms), a contiguous slice is DMA-speed
+    dense_steps: Optional[Dict[str, Callable]] = None
 
 
 def plan_pattern_query(
@@ -138,6 +151,14 @@ def plan_pattern_query(
 ) -> PlannedPatternQuery:
     sis = query.input_stream
     assert isinstance(sis, StateInputStream)
+    # per-key emission row cap (device output compaction); overflow counted
+    # in the out[1] scalar.  Tune with @emit(rows='N') on the query.  Only
+    # partitioned queries compact by default: for K=1 a per-key cap would
+    # cap the whole batch.
+    compact_rows = 8 if partition_positions else (1 << 30)
+    for ann in query.annotations:
+        if ann.name.lower() == "emit":
+            compact_rows = int(ann.element("rows", compact_rows))
     spec = linearize(sis, count_cap=count_cap)
     for sid in spec.stream_ids:
         if sid not in schemas:
@@ -163,11 +184,25 @@ def plan_pattern_query(
 
     packer = StatePacker(pexec.init_state(1))
 
-    def make_step(stream_id: str):
-        def step(packed, sel_state, cols, ts, valid, ord_, key_idx, now):
+    def make_step(stream_id: str, dense: bool = False):
+        def step(packed, sel_state, cols, ts, valid, ord_, key_ref, now):
             b32, b64, scalars = packed
-            # gather this batch's keys ([K_total, W] -> [Kb, W]): 2 gathers
-            sub = packer.unpack(b32[key_idx], b64[key_idx], scalars)
+            Kb = ts.shape[0]
+            if dense:
+                # key_ref is a scalar key_lo: the batch's slots are the
+                # contiguous range [key_lo, key_lo+Kb) -> DMA-speed slices
+                key_lo = jnp.asarray(key_ref, jnp.int32)
+                z = jnp.asarray(0, jnp.int32)
+                key_idx = key_lo + jnp.arange(Kb, dtype=jnp.int32)
+                sub32 = lax.dynamic_slice(b32, (z, key_lo),
+                                          (packer.w32, Kb))
+                sub64 = lax.dynamic_slice(b64, (z, key_lo),
+                                          (packer.w64, Kb))
+            else:
+                # generic path: 2 gathers riding the minor (key) axis
+                key_idx = key_ref
+                sub32, sub64 = b32[:, key_idx], b64[:, key_idx]
+            sub = packer.unpack(sub32, sub64, scalars)
 
             def body(carry, xs):
                 st = carry
@@ -180,24 +215,32 @@ def plan_pattern_query(
             xs = (tuple(c.T for c in cols), ts.T, valid.T)   # scan over E
             sub, emits = lax.scan(body, sub, xs)
 
-            # scatter back: 2 wide scatters (see StatePacker docstring)
             nb32, nb64, nscal = packer.pack(sub)
-            # out-of-bounds (padding) rows are dropped by scatter semantics
-            b32 = b32.at[key_idx].set(nb32, mode="drop")
-            b64 = b64.at[key_idx].set(nb64, mode="drop")
+            if dense:
+                z = jnp.asarray(0, jnp.int32)
+                key_lo = jnp.asarray(key_ref, jnp.int32)
+                b32 = lax.dynamic_update_slice(b32, nb32, (z, key_lo))
+                b64 = lax.dynamic_update_slice(b64, nb64, (z, key_lo))
+            else:
+                # out-of-bounds (padding) rows are dropped by scatter
+                b32 = b32.at[:, key_idx].set(nb32, mode="drop")
+                b64 = b64.at[:, key_idx].set(nb64, mode="drop")
 
-            cap = key_idx.shape[0] if partition_positions else None
             sel_state, out, wake = _emit_matches(
                 pexec, sel, spec, emits, ord_, sel_state, sub, now,
-                key_idx=key_idx, compact_cap=cap)
+                key_idx=key_idx, compact_rows=compact_rows)
             return (b32, b64, nscal), sel_state, out, wake
 
         return step
 
     raw_steps = {sid: make_step(sid) for sid in spec.stream_ids}
+    dense_steps = None
     if mesh is None:
         steps = {sid: jax.jit(body, donate_argnums=(0, 1))
                  for sid, body in raw_steps.items()}
+        dense_steps = {sid: jax.jit(make_step(sid, dense=True),
+                                    donate_argnums=(0, 1))
+                       for sid in spec.stream_ids}
     else:
         steps = {sid: _shard_step(body, mesh, packer, pexec, sel)
                  for sid, body in raw_steps.items()}
@@ -210,7 +253,7 @@ def plan_pattern_query(
         def tstep(packed, sel_state, now):
             b32, b64, scalars = packed
             pstate = packer.unpack(b32, b64, scalars)
-            K = pstate.active.shape[0]
+            K = pstate.active.shape[-1]
             zero_cols = tuple(
                 jnp.full((K,), ev.default_value(t), dtype=d)
                 for t, d in zip(schema0.types, schema0.dtypes))
@@ -239,7 +282,8 @@ def plan_pattern_query(
                            if query.output_stream and
                            query.output_stream.output_event_type
                            else "CURRENT_EVENTS"),
-        steps=steps, timer_step=timer_step, init_state=init_state,
+        steps=steps, dense_steps=dense_steps,
+        timer_step=timer_step, init_state=init_state,
         key_capacity=key_capacity, slots=slots,
         partition_positions=partition_positions,
         raw_steps=raw_steps, mesh=mesh)
@@ -296,7 +340,9 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
     def leaf_spec(x):
         return P() if getattr(x, "ndim", 0) == 0 else P("shard")
 
-    pspec = jax.tree.map(leaf_spec, ex_packed)
+    # blobs are [W, K]: the key (shard) axis is axis 1
+    pspec = (P(None, "shard"), P(None, "shard"),
+             tuple(P() for _ in ex_packed[2]))
     sspec = jax.tree.map(leaf_spec, ex_s)
     bspec = P("shard")    # batched inputs: [n*Kb, ...] on axis 0
 
@@ -308,7 +354,7 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
                         for s in scalars)
         ps, ss, out, wake = body((b32, b64, scalars), sel_state, cols, ts,
                                  valid, ord_, key_idx, now)
-        out = (lax.psum(out[0], "shard"),) + out[1:]
+        out = (lax.psum(out[0], "shard"), lax.psum(out[1], "shard")) + out[2:]
         nb32, nb64, nscal = ps
         # re-replicate scalar counters: old + psum(local delta)
         nscal = tuple(
@@ -321,47 +367,57 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
     sharded = jax.shard_map(
         local, mesh=mesh,
         in_specs=(pspec, sspec, bspec, bspec, bspec, bspec, bspec, P()),
-        out_specs=(pspec, sspec, (P(), bspec, bspec, bspec, bspec), P()))
+        out_specs=(pspec, sspec, (P(), P(), bspec, bspec, bspec, bspec), P()))
     return jax.jit(sharded, donate_argnums=(0, 1))
 
 
 def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,
                   emits, ord_, sel_state, pstate, now, key_idx=None,
-                  compact_cap=None):
-    """Flatten scan emissions [E,K,P+1] into selector Rows + env."""
-    mask = emits["mask"]                       # [E,K,P+1]
-    E, K, P1 = mask.shape
-    B = E * K * P1
+                  compact_rows: int = 8):
+    """Flatten scan emissions [E,P+1,K] into selector Rows + env, then
+    compact the selector's OUTPUT rows per key.
+
+    The selector over the full E*(P+1)*K grid is cheap (elementwise, XLA
+    fuses it); only the final output rows are compacted, [EP,K] -> [R,K],
+    as a one-hot contraction over the tiny EP axis — no device gathers (a
+    searchsorted/sort compaction costs ~80ms at 131k keys: TPU lowers both
+    to serialized gathers; compacting the ~25 capture arrays instead of the
+    ~7 output arrays costs GBs of HBM traffic).  Valid rows beyond R
+    matches per key per batch are counted in the out[1] dropped scalar."""
+    mask = emits["mask"]                       # [E,P+1,K]
+    E, P1, K = mask.shape
+    EP = E * P1
+    B = EP * K
 
     flat = lambda x: x.reshape(B)
     rows_ts = flat(emits["ts"])
     # order: by arrival (ord), then slot index
     slot_rank = jnp.broadcast_to(
-        jnp.arange(P1, dtype=jnp.int64)[None, None, :], mask.shape)
+        jnp.arange(P1, dtype=jnp.int64)[None, :, None], mask.shape)
     ord_ekp = jnp.broadcast_to(
-        jnp.transpose(ord_)[:, :, None].astype(jnp.int64), mask.shape)
+        jnp.transpose(ord_)[:, None, :].astype(jnp.int64), mask.shape)
     seq = flat(ord_ekp * (P1 + 1) + slot_rank)
 
     env: Dict[str, Any] = {"__ts__": rows_ts, "__now__": now}
     for a in spec.all_atoms():
         if a.absent or a.ckey not in emits:
             continue
-        cap_ts, cap_cols = emits[a.ckey]       # [E,K,P+1,D]
-        D = cap_ts.shape[-1]
-        env[a.ref] = tuple(c[..., 0].reshape(B) for c in cap_cols)
+        cap_ts, cap_cols = emits[a.ckey]       # [E,P+1,D,K]
+        D = cap_ts.shape[2]
+        env[a.ref] = tuple(c[:, :, 0, :].reshape(B) for c in cap_cols)
         for i in range(D):
             env[f"{a.ref}@{i}"] = tuple(
-                c[..., i].reshape(B) for c in cap_cols)
-        last_i = jnp.clip(flat(emits["count"]).astype(jnp.int32) - 1, 0,
-                          D - 1)
+                c[:, :, i, :].reshape(B) for c in cap_cols)
+        last_i = jnp.clip(emits["count"].astype(jnp.int32) - 1, 0,
+                          D - 1)                        # [E,P+1,K]
+        last_oh = (jnp.arange(D)[None, None, :, None] ==
+                   last_i[:, :, None, :])               # [E,P+1,D,K]
         env[f"{a.ref}@-1"] = tuple(
-            jnp.take_along_axis(
-                c.reshape(B, D), last_i[:, None], axis=1)[:, 0]
-            for c in cap_cols)
+            flat(oh_take(c, last_oh, 2)) for c in cap_cols)
 
     if key_idx is not None:
         gslot = flat(jnp.broadcast_to(
-            key_idx[None, :, None].astype(jnp.int32), mask.shape))
+            key_idx[None, None, :].astype(jnp.int32), mask.shape))
         gslot = jnp.maximum(gslot, 0)
     else:
         gslot = jnp.zeros((B,), jnp.int32)
@@ -375,19 +431,29 @@ def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,
     )
     sel_state, out = sel.process(sel_state, rows, env)
 
-    # device-side output compaction: move valid rows to the front and trim to
-    # `compact_cap` so the host pulls O(matches) bytes, not O(E*K*(P+1)).
-    # The leading count scalar lets the drainer skip empty outputs with an
-    # 8-byte read.
     ots, okind, ovalid, ocols = out
-    n_valid = jnp.sum(ovalid.astype(jnp.int64))
-    if compact_cap is not None and compact_cap < ots.shape[0]:
-        order = jnp.argsort(jnp.logical_not(ovalid), stable=True)
-        take = order[:compact_cap]
-        out = (ots[take], okind[take], ovalid[take],
-               tuple(c[take] for c in ocols))
-        n_valid = jnp.minimum(n_valid, compact_cap)
-    out = (n_valid,) + out
+    R = min(compact_rows, EP)
+    if R < EP:
+        v2 = ovalid.reshape(EP, K)
+        rank = jnp.cumsum(v2.astype(jnp.int32), axis=0) - 1
+        keep_oh = jnp.logical_and(
+            jnp.arange(R, dtype=jnp.int32)[:, None, None] == rank[None],
+            v2[None])                          # [R,EP,K]
+        cmask = jnp.any(keep_oh, axis=1)       # [R,K]
+        n_valid = jnp.sum(cmask.astype(jnp.int64))
+        n_dropped = jnp.sum(v2.astype(jnp.int64)) - n_valid
+
+        def cmp(x):                            # [B] -> [R*K]
+            return oh_take(x.reshape(EP, K)[None], keep_oh, 1).reshape(R * K)
+
+        out = (cmp(ots), cmp(okind), cmask.reshape(R * K),
+               tuple(cmp(c) for c in ocols))
+    else:
+        n_valid = jnp.sum(ovalid.astype(jnp.int64))
+        n_dropped = jnp.zeros((), jnp.int64)
+    # leading scalars: valid-row count (drainer skips empty outputs with one
+    # 16-byte read) and overflow count (rows beyond R matches/key/batch)
+    out = (n_valid, n_dropped) + out
 
     # next wakeup: earliest absent deadline
     wake = jnp.asarray(NO_WAKEUP, jnp.int64)

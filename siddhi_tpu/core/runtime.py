@@ -193,6 +193,23 @@ class PatternQueryRuntime:
             ts = jax.numpy.asarray(staged.ts[csel])
             valid = jax.numpy.asarray(kvalid)
             ord_ = jax.numpy.asarray(csel.astype(np.int64))
+            # contiguous-slot fast path: dynamic-slice state access instead
+            # of row-serialized gather/scatter (see dense_steps)
+            Kb = key_idx_np.shape[0]
+            nuniq = int((key_idx_np < p.key_capacity).sum())
+            if (p.dense_steps is not None and nuniq > 0 and
+                    int(key_idx_np[0]) + Kb <= p.key_capacity and
+                    int(key_idx_np[nuniq - 1]) ==
+                    int(key_idx_np[0]) + nuniq - 1):
+                pstate, sel_state = self.state
+                pstate, sel_state, out, wake = p.dense_steps[stream_id](
+                    pstate, sel_state, cols, ts, valid, ord_,
+                    jax.numpy.asarray(int(key_idx_np[0]), jax.numpy.int32),
+                    jax.numpy.asarray(now, jax.numpy.int64))
+                self.state = (pstate, sel_state)
+                _emit_output(self, out, now)
+                self._maybe_schedule(wake)
+                return
             key_idx = jax.numpy.asarray(key_idx_np)
         else:
             cols = tuple(
@@ -285,15 +302,99 @@ def _emit_output(qr, out, now: int) -> None:
     _emit_output_sync(qr, out, now)
 
 
+class _LazyBatchPayload(dict):
+    """Batch-callback payload materializing device->host pulls on access:
+    a callback that only bracket-reads 'valid'/'kind' never pays for the
+    data columns.  Any whole-dict access (iteration, get, `in`, len, ...)
+    materializes everything so the plain-dict contract holds."""
+
+    _LAZY = ("ts", "kind", "cols")
+
+    def __init__(self, names, ots, okind, ovalid_np, ocols):
+        super().__init__()
+        self._names = names
+        self._ots, self._okind, self._ocols = ots, okind, ocols
+        dict.__setitem__(self, "valid", ovalid_np)
+
+    def __missing__(self, k):
+        if k == "ts":
+            v = np.asarray(self._ots)
+        elif k == "kind":
+            v = np.asarray(self._okind)
+        elif k == "cols":
+            v = {n: np.asarray(c)
+                 for n, c in zip(self._names, self._ocols)}
+        else:
+            raise KeyError(k)
+        dict.__setitem__(self, k, v)
+        return v
+
+    def _materialize(self):
+        for k in self._LAZY:
+            if not dict.__contains__(self, k):
+                self[k]
+        return self
+
+    def get(self, k, default=None):
+        try:
+            return self[k]
+        except KeyError:
+            return default
+
+    def __contains__(self, k):
+        return k == "valid" or k in self._LAZY
+
+    def __iter__(self):
+        return iter(self._materialize().keys_())
+
+    def keys_(self):
+        return dict.keys(self)
+
+    def keys(self):
+        return dict.keys(self._materialize())
+
+    def items(self):
+        return dict.items(self._materialize())
+
+    def values(self):
+        return dict.values(self._materialize())
+
+    def __len__(self):
+        return 4
+
+
 def _emit_output_sync(qr, out, now: int) -> None:
     """Shared output emission: fan out to columnar batch callbacks first
     (zero-decode path), then unpack to host events only if someone needs
     them (Event callbacks or downstream routing).
 
-    Pattern outputs carry a leading device-computed valid-count scalar so an
-    empty batch costs one 8-byte read, not a bulk row transfer."""
-    if len(out) == 5:
-        n_valid, ots, okind, ovalid, ocols = out
+    Pattern outputs carry leading device-computed valid/dropped count
+    scalars so an empty batch costs one 16-byte read, not a bulk row
+    transfer.  If nothing consumes the output (no callbacks, no rate
+    limiter, and the target stream has no subscribers) the device arrays
+    are dropped without any host transfer at all."""
+    p = qr.planned
+    target_live = getattr(qr, "table_op", None) is not None or \
+        getattr(qr, "rate_limiter", None) is not None
+    if p.output_target and not target_live:
+        app = qr.app
+        if p.output_target in getattr(app, "named_windows", {}) or \
+                p.output_target in getattr(app, "tables", {}):
+            target_live = True
+        else:
+            j = app.junctions.get(p.output_target)
+            target_live = j is not None and bool(
+                j.queries or j.stream_callbacks or app.stats.enabled)
+    if not (qr.callbacks or qr.batch_callbacks or target_live):
+        return
+    if len(out) == 6:
+        n_valid, n_dropped, ots, okind, ovalid, ocols = out
+        nd = int(n_dropped)
+        if nd:
+            import logging
+            logging.getLogger("siddhi_tpu").warning(
+                "%s: %d pattern match rows exceeded the per-key emission "
+                "capacity this batch and were dropped", qr.name, nd)
         if int(n_valid) == 0:
             return
         ovalid_np = np.asarray(ovalid)
@@ -302,16 +403,24 @@ def _emit_output_sync(qr, out, now: int) -> None:
         ovalid_np = np.asarray(ovalid)
         if not ovalid_np.any():
             return
-    p = qr.planned
     if qr.batch_callbacks:
-        cols_np = {n: np.asarray(c)
-                   for n, c in zip(p.out_schema.names, ocols)}
-        payload = {"ts": np.asarray(ots), "kind": np.asarray(okind),
-                   "valid": ovalid_np, "cols": cols_np}
+        payload = _LazyBatchPayload(p.out_schema.names, ots, okind,
+                                    ovalid_np, ocols)
         for bcb in qr.batch_callbacks:
             bcb(now, payload)
-    if not qr.callbacks and not p.output_target:
+    if not qr.callbacks and not target_live:
         return
+    if len(out) == 6:
+        # pattern outputs are compacted [R,K] rank-major on device; restore
+        # timestamp order for event delivery with a host-side stable sort of
+        # just the valid rows (O(matches), runs on the drainer thread)
+        idxv = np.nonzero(ovalid_np)[0]
+        ts_np = np.asarray(ots)
+        order = idxv[np.argsort(ts_np[idxv], kind="stable")]
+        ots = ts_np[order]
+        okind = np.asarray(okind)[order]
+        ocols = tuple(np.asarray(c)[order] for c in ocols)
+        ovalid = np.ones(order.shape[0], np.bool_)
     batch = ev.EventBatch(ots, okind, ovalid, ocols)
     pairs = ev.unpack(p.out_schema, batch,
                       want_kinds=(ev.CURRENT, ev.EXPIRED))
@@ -902,6 +1011,7 @@ class SiddhiAppRuntime:
             from .pattern_planner import plan_pattern_query
             planned = plan_pattern_query(q, name, self.schemas, self.interner)
             runtime = PatternQueryRuntime(planned, self)
+            runtime.async_emit = self._async_enabled(q)
             self.query_runtimes[name] = runtime
             for sid in planned.spec.stream_ids:
 
@@ -1035,9 +1145,21 @@ class SiddhiAppRuntime:
         self._wire_output(runtime, q, planned, name)
 
     def _async_enabled(self, q) -> bool:
+        """@async at app level, on the query, or on any input stream
+        definition (reference: @async is a stream-level annotation,
+        StreamJunction.startProcessing :276-313)."""
         if self.app.get_annotation("async") is not None:
             return True
-        return q.get_annotation("async") is not None
+        if q.get_annotation("async") is not None:
+            return True
+        ist = q.input_stream
+        sids = getattr(ist, "all_stream_ids", None) or \
+            [getattr(ist, "stream_id", None)]
+        for sid in sids:
+            sdef = self.app.stream_definition_map.get(sid)
+            if sdef is not None and sdef.get_annotation("async") is not None:
+                return True
+        return False
 
     def _add_partition(self, part: Partition, qi: int) -> int:
         """Partitions: key-scoped state clones (reference:

@@ -141,3 +141,31 @@ class TestPartition:
             ("S", ["A", 1], 1003),
         ], query="query2")
         assert [e.data for e in got] == [["A", 2], ["A", 3]]
+
+
+def test_partitioned_same_stream_capture_filter(manager):
+    """Filters referencing an earlier capture of the SAME stream must see
+    the captured value, not the incoming event (regression: binding by
+    stream id aliased e1.price to the current event)."""
+    ql = """
+    @app:playback
+    define stream T (key long, price float, volume int);
+    partition with (key of T)
+    begin
+      @capacity(keys='64', slots='4') @info(name='q')
+      from every e1=T[volume == 1] -> e2=T[volume == 2 and price >= e1.price]
+      select e1.key as k, e1.price as p1, e2.price as p2
+      insert into M;
+    end;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(
+        e.data for e in ins or []))
+    rt.start()
+    h = rt.get_input_handler("T")
+    h.send([[9, 500.0, 1]], timestamp=3000)
+    h.send([[9, 100.0, 2]], timestamp=3001)   # 100 < 500: must NOT match
+    h.send([[9, 600.0, 2]], timestamp=3002)   # 600 >= 500: must match
+    rt.flush()
+    assert got == [[9, 500.0, 600.0]]
