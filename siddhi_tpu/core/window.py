@@ -706,6 +706,8 @@ WINDOW_TYPES = {
 
 from . import window_ext as _window_ext  # noqa: E402  (registry extension)
 _window_ext.register(WINDOW_TYPES)
+from . import window_expr as _window_expr  # noqa: E402
+_window_expr.register(WINDOW_TYPES)
 
 
 def create_window(name: str, schema: ev.Schema, params, batch_capacity: int,

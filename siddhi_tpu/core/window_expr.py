@@ -1,0 +1,431 @@
+"""Expression-driven windows: #window.expression / #window.expressionBatch.
+
+Reference behavior (what): CORE/query/processor/stream/window/
+ExpressionWindowProcessor.java:395, ExpressionBatchWindowProcessor.java:589 —
+windows that shrink/grow according to a boolean expression over the window
+contents, with `first`/`last` event references, `count()`, aggregates, and
+`eventTimestamp(first|last)`.
+
+TPU-native design (how): the retention expression is compiled once into a
+vectorized *range evaluator*: for a fixed newest index `hi` it returns, for
+EVERY candidate oldest index j at once, whether the expression holds over
+the range [j, hi] — aggregates become prefix/suffix scans over the combined
+buffer (sum via cumsum difference, min/max via reversed running scans).  The
+reference's per-event "evict oldest until satisfied" loop becomes, per
+arrival, one argmax over that vector; arrivals within a micro-batch advance
+through a `lax.scan` carrying only the eviction front.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..query_api.expression import (
+    Add,
+    And,
+    AttributeFunction,
+    Compare,
+    Constant,
+    Divide,
+    Mod,
+    Multiply,
+    Not,
+    Or,
+    Subtract,
+    Variable,
+)
+from . import event as ev
+from .window import (
+    BIG_SEQ,
+    NO_WAKEUP,
+    Buffer,
+    Rows,
+    WindowOutput,
+    WindowProcessor,
+    concat_rows,
+    empty_buffer,
+    sort_rows,
+)
+
+
+class _RangeCtx:
+    """Evaluation context for one `hi`: arrays indexed by candidate j."""
+
+    def __init__(self, schema, cols, ts, hi, N):
+        self.schema = schema
+        self.cols = cols          # combined columns, each [N]
+        self.ts = ts              # [N]
+        self.hi = hi              # traced scalar
+        self.N = N
+        self.j = jnp.arange(N, dtype=jnp.int64)
+        self.in_range = self.j <= hi   # candidate j values beyond hi unused
+
+    def col(self, name):
+        return self.cols[self.schema.position(name)]
+
+    def at_hi(self, arr):
+        # arr[hi] without a serialized gather: one-hot over N
+        oh = self.j == self.hi
+        return jnp.sum(jnp.where(oh, arr, jnp.zeros((), arr.dtype)),
+                       dtype=arr.dtype)
+
+
+def _col_eval(expr, ctx: _RangeCtx):
+    """Aggregate-argument evaluation: bare attributes are per-event COLUMNS
+    (one value per window entry), not the latest event's scalar."""
+    if isinstance(expr, Constant):
+        return jnp.asarray(expr.value)
+    if isinstance(expr, Variable):
+        if expr.stream_id is None:
+            return ctx.col(expr.attribute_name)
+        raise ValueError(
+            "first/last references are not allowed inside window-expression "
+            "aggregates")
+    for node, op in ((Add, jnp.add), (Subtract, jnp.subtract),
+                     (Multiply, jnp.multiply), (Mod, jnp.mod)):
+        if isinstance(expr, node):
+            return op(_col_eval(expr.left, ctx), _col_eval(expr.right, ctx))
+    if isinstance(expr, Divide):
+        return (_col_eval(expr.left, ctx).astype(jnp.float64) /
+                _col_eval(expr.right, ctx))
+    raise ValueError(
+        f"unsupported aggregate argument in window expression: {expr!r}")
+
+
+def _range_eval(expr, ctx: _RangeCtx):
+    """Recursively evaluate `expr` -> array [N] over candidate oldest j."""
+    if isinstance(expr, Constant):
+        return jnp.asarray(expr.value)
+    if isinstance(expr, Variable):
+        sid = expr.stream_id
+        if sid == "first":
+            return ctx.col(expr.attribute_name)                 # value at j
+        if sid == "last":
+            return ctx.at_hi(ctx.col(expr.attribute_name))      # scalar
+        if sid is None:
+            # bare attribute: the latest (triggering) event, as in reference
+            return ctx.at_hi(ctx.col(expr.attribute_name))
+        raise ValueError(
+            f"expression window reference {sid!r} (use first/last)")
+    if isinstance(expr, AttributeFunction):
+        nm = expr.name
+        if nm == "count":
+            return (ctx.hi - ctx.j + 1).astype(jnp.int64)
+        if nm == "eventTimestamp":
+            p = expr.parameters
+            if p and isinstance(p[0], Variable) and \
+                    p[0].attribute_name == "first":
+                return ctx.ts
+            return ctx.at_hi(ctx.ts)
+        if nm in ("sum", "avg"):
+            x = _col_eval(expr.parameters[0], ctx)
+            x = jnp.where(ctx.in_range, x, 0).astype(jnp.float64)
+            P = jnp.cumsum(x)                          # inclusive prefix
+            total_to_hi = ctx.at_hi(P)
+            s = total_to_hi - P + x                    # sum over [j, hi]
+            if nm == "avg":
+                return s / jnp.maximum(
+                    (ctx.hi - ctx.j + 1).astype(jnp.float64), 1.0)
+            return s
+        if nm in ("min", "max"):
+            x = _col_eval(expr.parameters[0], ctx).astype(jnp.float64)
+            pad = jnp.where(ctx.in_range, x,
+                            jnp.inf if nm == "min" else -jnp.inf)
+            rev = pad[::-1]
+            acc = lax.associative_scan(
+                jnp.minimum if nm == "min" else jnp.maximum, rev)
+            return acc[::-1]                           # agg over [j, N) = [j, hi]
+        raise ValueError(f"unsupported function {nm!r} in window expression")
+    if isinstance(expr, Add):
+        return _range_eval(expr.left, ctx) + _range_eval(expr.right, ctx)
+    if isinstance(expr, Subtract):
+        return _range_eval(expr.left, ctx) - _range_eval(expr.right, ctx)
+    if isinstance(expr, Multiply):
+        return _range_eval(expr.left, ctx) * _range_eval(expr.right, ctx)
+    if isinstance(expr, Divide):
+        return (_range_eval(expr.left, ctx).astype(jnp.float64) /
+                _range_eval(expr.right, ctx))
+    if isinstance(expr, Mod):
+        return _range_eval(expr.left, ctx) % _range_eval(expr.right, ctx)
+    if isinstance(expr, Compare):
+        l, r = _range_eval(expr.left, ctx), _range_eval(expr.right, ctx)
+        return {"<": l < r, "<=": l <= r, ">": l > r, ">=": l >= r,
+                "==": l == r, "!=": l != r}[expr.operator]
+    if isinstance(expr, And):
+        return jnp.logical_and(_range_eval(expr.left, ctx),
+                               _range_eval(expr.right, ctx))
+    if isinstance(expr, Or):
+        return jnp.logical_or(_range_eval(expr.left, ctx),
+                              _range_eval(expr.right, ctx))
+    if isinstance(expr, Not):
+        return jnp.logical_not(_range_eval(expr.expression, ctx))
+    raise ValueError(f"unsupported node in window expression: {expr!r}")
+
+
+def _parse_expr_param(params) -> Any:
+    if not params or not isinstance(params[0], Constant) or \
+            params[0].type != "STRING":
+        raise ValueError(
+            "expression window takes a constant string expression")
+    from ..compiler.parser import Parser
+    return Parser(str(params[0].value)).parse_expression()
+
+
+def _combine(buf: Buffer, rows: Rows, is_cur):
+    """Compacted combined arrays: alive buffer entries (by age) then this
+    batch's arrivals (by arrival order)."""
+    C = buf.capacity
+    B = rows.capacity
+    k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
+    old_key = jnp.where(buf.alive, buf.add_seq, BIG_SEQ)
+    old_order = jnp.argsort(old_key)
+    cur_order = jnp.argsort(jnp.where(is_cur, k, BIG_SEQ))
+    comb_ts = jnp.concatenate([buf.ts[old_order], rows.ts[cur_order]])
+    comb_gslot = jnp.concatenate([buf.gslot[old_order],
+                                  rows.gslot[cur_order]])
+    comb_cols = tuple(jnp.concatenate([bc[old_order], rc[cur_order]])
+                      for bc, rc in zip(buf.cols, rows.cols))
+    count0 = jnp.sum(buf.alive.astype(jnp.int64))
+    ncur = jnp.sum(is_cur.astype(jnp.int64))
+    # virtual compaction: index v walks buffer entries then arrivals with no
+    # gap (v < count0 -> physical v; else physical C + v - count0)
+    v = jnp.arange(C + B, dtype=jnp.int64)
+    phys = jnp.clip(jnp.where(v < count0, v, C + v - count0),
+                    0, C + B - 1).astype(jnp.int32)
+    comb_ts = comb_ts[phys]
+    comb_gslot = comb_gslot[phys]
+    comb_cols = tuple(c[phys] for c in comb_cols)
+    return comb_ts, comb_gslot, comb_cols, count0, ncur, k
+
+
+class ExpressionWindow(WindowProcessor):
+    """Sliding expression window (reference: ExpressionWindowProcessor).
+
+    Holds events while the expression over the window contents is satisfied;
+    when it is not, events expire oldest-first until it is."""
+
+    name = "expression"
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=1024):
+        super().__init__(schema, params, batch_capacity, capacity_hint)
+        self.expr = _parse_expr_param(params)
+        self.capacity = capacity_hint
+
+    @property
+    def out_capacity(self):
+        return self.capacity + 2 * self.batch_capacity
+
+    def init_state(self):
+        return (empty_buffer(self.schema, self.capacity),
+                jnp.asarray(0, jnp.int64))
+
+    def process(self, state, rows: Rows, now):
+        buf, seq0 = state
+        C, B = self.capacity, rows.capacity
+        N = C + B
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        (comb_ts, comb_gslot, comb_cols, count0, ncur, k) = _combine(
+            buf, rows, is_cur)
+        jN = jnp.arange(N, dtype=jnp.int64)
+
+        def step(front, kk):
+            hi = count0 + kk
+            ctx = _RangeCtx(self.schema, comb_cols, comb_ts, hi, N)
+            sat = jnp.broadcast_to(_range_eval(self.expr, ctx), (N,))
+            ok = jnp.logical_and(sat, jnp.logical_and(jN >= front, jN <= hi))
+            nfront = jnp.where(jnp.any(ok), jnp.argmax(ok).astype(jnp.int64),
+                               hi + 1)
+            nfront = jnp.where(kk < ncur, nfront, front)
+            return nfront, nfront
+
+        front_final, fronts = lax.scan(
+            step, jnp.asarray(0, jnp.int64), jnp.arange(B, dtype=jnp.int64))
+
+        # eviction arrival for each combined entry p: first k with fronts[k]>p
+        gt = fronts[:, None] > jN[None, :]             # [B, N]
+        evicted = jnp.logical_and(jN < front_final,
+                                  jN < count0 + ncur)
+        evict_k = jnp.argmax(gt, axis=0).astype(jnp.int64)   # [N]
+        prev_front = jnp.where(evict_k > 0, fronts[jnp.maximum(evict_k - 1, 0)],
+                               0)
+        span = N + 1
+        exp_rows = Rows(
+            ts=comb_ts,
+            kind=jnp.full((N,), ev.EXPIRED, jnp.int32),
+            valid=evicted,
+            seq=seq0 + evict_k * span + (jN - prev_front),
+            gslot=comb_gslot,
+            cols=comb_cols,
+        )
+        cur_rows = Rows(
+            ts=rows.ts, kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+            valid=is_cur, seq=seq0 + k * span + span - 1, gslot=rows.gslot,
+            cols=rows.cols,
+        )
+        out = sort_rows(concat_rows(exp_rows, cur_rows))
+
+        total = count0 + ncur
+        take = front_final + jnp.arange(C, dtype=jnp.int64)
+        tvalid = take < total
+        tpos = jnp.clip(take, 0, N - 1).astype(jnp.int32)
+        nbuf = Buffer(
+            ts=comb_ts[tpos],
+            add_seq=seq0 + tpos,   # age-ordered (relative order is all we need)
+            expire_seq=jnp.full((C,), BIG_SEQ, jnp.int64),
+            expire_ts=jnp.full((C,), BIG_SEQ, jnp.int64),
+            alive=tvalid,
+            gslot=comb_gslot[tpos],
+            cols=tuple(c[tpos] for c in comb_cols),
+        )
+        nseq = seq0 + B * span + 1
+        return ((nbuf, nseq),
+                WindowOutput(out, nbuf, jnp.asarray(NO_WAKEUP, jnp.int64)))
+
+
+class ExpressionBatchWindow(WindowProcessor):
+    """Batch expression window (reference: ExpressionBatchWindowProcessor).
+
+    Collects events while the expression holds; when an arrival breaks it,
+    the collected batch flushes as CURRENT (previous batch replayed as
+    EXPIRED first).  Options: include.triggering.event (the breaking event
+    joins the flushed batch), stream.current.event (arrivals stream out
+    individually while expiry stays batched)."""
+
+    name = "expressionBatch"
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=1024):
+        super().__init__(schema, params, batch_capacity, capacity_hint)
+        self.expr = _parse_expr_param(params)
+        self.include_trigger = bool(
+            params[1].value) if len(params) > 1 and \
+            isinstance(params[1], Constant) else False
+        self.stream_current = bool(
+            params[2].value) if len(params) > 2 and \
+            isinstance(params[2], Constant) else False
+        self.capacity = capacity_hint
+
+    @property
+    def out_capacity(self):
+        return 3 * (self.capacity + self.batch_capacity)
+
+    def init_state(self):
+        return (empty_buffer(self.schema, self.capacity),   # pending
+                empty_buffer(self.schema, self.capacity),   # previous batch
+                jnp.asarray(0, jnp.int64))
+
+    def process(self, state, rows: Rows, now):
+        pend, prev, seq0 = state
+        C, B = self.capacity, rows.capacity
+        N = C + B
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        (comb_ts, comb_gslot, comb_cols, count0, ncur, k) = _combine(
+            pend, rows, is_cur)
+        jN = jnp.arange(N, dtype=jnp.int64)
+
+        def step(carry, kk):
+            start, nflush = carry
+            hi = count0 + kk
+            ctx = _RangeCtx(self.schema, comb_cols, comb_ts, hi, N)
+            sat_vec = jnp.broadcast_to(_range_eval(self.expr, ctx), (N,))
+            sat = jnp.sum(jnp.where(jN == start, sat_vec, False))  # sat[start]
+            flush = jnp.logical_and(kk < ncur,
+                                    jnp.logical_and(start <= hi,
+                                                    jnp.logical_not(sat)))
+            nstart = jnp.where(
+                flush, hi + 1 if self.include_trigger else hi, start)
+            return ((nstart, nflush + flush.astype(jnp.int64)),
+                    (nstart, flush))
+
+        (start_final, _nfl), (starts, flushes) = lax.scan(
+            step, (jnp.asarray(0, jnp.int64), jnp.asarray(0, jnp.int64)),
+            jnp.arange(B, dtype=jnp.int64))
+
+        # entry p flushed in flush ordinal f_p = #flushes whose new start <= p
+        after = jnp.where(flushes, starts, -1)               # [B]
+        f_p = jnp.sum(jnp.logical_and(flushes[:, None],
+                                      after[:, None] <= jN[None, :]),
+                      axis=0).astype(jnp.int64)              # [N]
+        flushed = jnp.logical_and(jN < start_final, jN < count0 + ncur)
+        # batch start for p: largest flush-start <= p (or 0)
+        bstart = jnp.max(jnp.where(
+            jnp.logical_and(flushes[:, None], after[:, None] <= jN[None, :]),
+            after[:, None], 0), axis=0)                      # [N]
+        rank = jN - bstart
+        span = 2 * N + 2
+        npend0 = jnp.sum(prev.alive.astype(jnp.int64))
+
+        # CURRENT: flushed entries at their flush ordinal (or streamed on
+        # arrival when stream.current.event)
+        if self.stream_current:
+            cur_rows = Rows(
+                ts=rows.ts, kind=jnp.full((B,), ev.CURRENT, jnp.int32),
+                valid=is_cur, seq=seq0 + k, gslot=rows.gslot, cols=rows.cols)
+            base = seq0 + B   # expired flushes sequence after streamed rows
+        else:
+            cur_rows = Rows(
+                ts=comb_ts, kind=jnp.full((N,), ev.CURRENT, jnp.int32),
+                valid=flushed, seq=seq0 + f_p * span + N + 1 + rank,
+                gslot=comb_gslot, cols=comb_cols)
+            base = seq0
+
+        # EXPIRED: prev batch replays at flush 0; flushed batch f replays at
+        # flush f+1 (if it happens within this step)
+        total_flushes = jnp.sum(flushes.astype(jnp.int64))
+        prev_rank = jnp.cumsum(prev.alive.astype(jnp.int64)) - 1
+        prev_exp = Rows(
+            ts=prev.ts, kind=jnp.full((C,), ev.EXPIRED, jnp.int32),
+            valid=jnp.logical_and(prev.alive, total_flushes > 0),
+            seq=base + prev_rank,
+            gslot=prev.gslot, cols=prev.cols)
+        ent_exp = Rows(
+            ts=comb_ts, kind=jnp.full((N,), ev.EXPIRED, jnp.int32),
+            valid=jnp.logical_and(flushed, f_p + 1 < total_flushes),
+            seq=base + (f_p + 1) * span + rank,
+            gslot=comb_gslot, cols=comb_cols)
+        out = sort_rows(concat_rows(concat_rows(prev_exp, ent_exp), cur_rows))
+
+        # new pending = [start_final, total); new prev = last flushed batch
+        total = count0 + ncur
+        take = start_final + jnp.arange(C, dtype=jnp.int64)
+        tvalid = take < total
+        tpos = jnp.clip(take, 0, N - 1).astype(jnp.int32)
+        npend = Buffer(
+            ts=comb_ts[tpos], add_seq=seq0 + tpos,
+            expire_seq=jnp.full((C,), BIG_SEQ, jnp.int64),
+            expire_ts=jnp.full((C,), BIG_SEQ, jnp.int64),
+            alive=tvalid, gslot=comb_gslot[tpos],
+            cols=tuple(c[tpos] for c in comb_cols))
+        # last flushed batch = entries with f_p == total_flushes-1
+        last_b = jnp.logical_and(flushed, f_p == total_flushes - 1)
+        lrank = jnp.cumsum(last_b.astype(jnp.int64)) - 1
+        tgt = jnp.where(last_b, lrank, C).astype(jnp.int32)
+        fresh = empty_buffer(self.schema, C)
+        nprev = Buffer(
+            ts=fresh.ts.at[tgt].set(comb_ts, mode="drop"),
+            add_seq=fresh.add_seq.at[tgt].set(seq0 + jN, mode="drop"),
+            expire_seq=fresh.expire_seq,
+            expire_ts=fresh.expire_ts,
+            alive=jnp.zeros((C,), jnp.bool_).at[tgt].set(last_b, mode="drop"),
+            gslot=fresh.gslot.at[tgt].set(comb_gslot, mode="drop"),
+            cols=tuple(f.at[tgt].set(c, mode="drop")
+                       for f, c in zip(fresh.cols, comb_cols)),
+        )
+        # keep the old prev batch when no flush happened this step
+        nprev = jax.tree.map(
+            lambda new, old: jnp.where(_bcast(total_flushes > 0, new),
+                                       new, old), nprev, prev)
+        nseq = seq0 + (B + 2) * span
+        return ((npend, nprev, nseq),
+                WindowOutput(out, npend, jnp.asarray(NO_WAKEUP, jnp.int64)))
+
+
+def _bcast(pred, like):
+    return jnp.reshape(pred, (1,) * like.ndim)
+
+
+def register(window_types: dict) -> None:
+    for cls in (ExpressionWindow, ExpressionBatchWindow):
+        window_types[cls.name] = cls

@@ -1,0 +1,115 @@
+"""Expression windows (reference: ExpressionWindowProcessor,
+ExpressionBatchWindowProcessor examples)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def _run(manager, ql, sends, query="q"):
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(query, lambda ts, ins, outs: got.append(
+        ([list(e.data) for e in ins or []],
+         [list(e.data) for e in outs or []])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for ev_, ts in sends:
+        h.send(ev_, timestamp=ts)
+    rt.flush()
+    return got
+
+
+def test_expression_count_behaves_like_sliding_length(manager):
+    ql = """
+    define stream S (symbol string, price float);
+    @info(name='q') from S#window.expression('count() <= 2')
+    select symbol, price insert all events into Out;
+    """
+    got = _run(manager, ql, [
+        (["A", 1.0], 1000), (["B", 2.0], 1001),
+        (["C", 3.0], 1002), (["D", 4.0], 1003)])
+    ins = [e for cur, exp in got for e in cur]
+    exps = [e for cur, exp in got for e in exp]
+    assert ins == [["A", 1.0], ["B", 2.0], ["C", 3.0], ["D", 4.0]]
+    # 3rd arrival evicts A, 4th evicts B
+    assert exps == [["A", 1.0], ["B", 2.0]]
+
+
+def test_expression_sum_eviction(manager):
+    ql = """
+    define stream S (symbol string, price float);
+    @info(name='q') from S#window.expression('sum(price) < 100.0')
+    select symbol, price insert all events into Out;
+    """
+    got = _run(manager, ql, [
+        (["A", 60.0], 1000), (["B", 30.0], 1001),
+        (["C", 50.0], 1002)])   # 60+30+50 >= 100 -> evict A (90 < 100 ok)
+    exps = [e for cur, exp in got for e in exp]
+    assert exps == [["A", 60.0]]
+
+
+def test_expression_window_running_aggregate(manager):
+    ql = """
+    define stream S (symbol string, price float);
+    @info(name='q') from S#window.expression('count() <= 3')
+    select sum(price) as total insert into Out;
+    """
+    got = _run(manager, ql, [
+        (["A", 1.0], 1000), (["B", 2.0], 1001),
+        (["C", 3.0], 1002), (["D", 4.0], 1003)])
+    totals = [e[0] for cur, exp in got for e in cur]
+    assert totals == [1.0, 3.0, 6.0, 9.0 - 1.0 + 1.0]  # 1, 3, 6, 2+3+4=9
+
+
+def test_expression_batch_count(manager):
+    ql = """
+    define stream S (symbol string, price float);
+    @info(name='q') from S#window.expressionBatch('count() <= 2')
+    select symbol, price insert into Out;
+    """
+    got = _run(manager, ql, [
+        (["A", 1.0], 1000), (["B", 2.0], 1001),
+        (["C", 3.0], 1002), (["D", 4.0], 1003),
+        (["E", 5.0], 1004)])
+    # C breaks count<=2 -> flush [A,B]; E breaks again -> flush [C,D]
+    batches = [cur for cur, exp in got if cur]
+    assert batches == [[["A", 1.0], ["B", 2.0]],
+                       [["C", 3.0], ["D", 4.0]]]
+
+
+def test_expression_batch_symbol_change(manager):
+    ql = """
+    define stream S (symbol string, price float);
+    @info(name='q')
+    from S#window.expressionBatch('last.symbol == first.symbol')
+    select symbol, price insert into Out;
+    """
+    got = _run(manager, ql, [
+        (["X", 1.0], 1000), (["X", 2.0], 1001),
+        (["Y", 3.0], 1002), (["Y", 4.0], 1003),
+        (["Z", 5.0], 1004)])
+    batches = [cur for cur, exp in got if cur]
+    assert batches == [[["X", 1.0], ["X", 2.0]],
+                       [["Y", 3.0], ["Y", 4.0]]]
+
+
+def test_expression_batch_expired_replay(manager):
+    ql = """
+    define stream S (symbol string, price float);
+    @info(name='q') from S#window.expressionBatch('count() <= 2')
+    select symbol, price insert all events into Out;
+    """
+    got = _run(manager, ql, [
+        (["A", 1.0], 1000), (["B", 2.0], 1001),
+        (["C", 3.0], 1002), (["D", 4.0], 1003),
+        (["E", 5.0], 1004)])
+    exps = [exp for cur, exp in got if exp]
+    # at second flush, first batch [A,B] replays as expired
+    assert exps == [[["A", 1.0], ["B", 2.0]]]
