@@ -905,12 +905,43 @@ class SiddhiAppRuntime:
         for sid, sdef in list(app.stream_definition_map.items()):
             self._define_stream_runtime(sdef)
 
-        # tables (reference: CORE/table/InMemoryTable.java)
-        from .table import TableRuntime
+        # tables (reference: CORE/table/InMemoryTable.java; @store tables
+        # back onto a RecordTable SPI store, AbstractRecordTable.java:449)
+        from .table import RecordTableRuntime, TableRuntime
         self.tables: Dict[str, TableRuntime] = {}
         for tid, tdef in app.table_definition_map.items():
             schema = ev.Schema(tdef, self.interner)
-            self.tables[tid] = TableRuntime(tdef, schema)
+            store_ann = tdef.get_annotation("store")
+            if store_ann is not None:
+                from ..io.store import CacheTable, create_store
+                stype = store_ann.element("type")
+                if stype is None:
+                    raise CompileError(
+                        f"@store on table {tid!r} needs a type element")
+                props = {k: v for k, v in store_ann.elements.items()
+                         if k not in (None, "type")}
+                reader = self.config_manager.generate_config_reader(
+                    "store", str(stype))
+                store = create_store(str(stype), tdef, schema, props, reader)
+                cache = None
+                for sub in store_ann.annotations:
+                    if sub.name.lower() == "cache":
+                        pk = tdef.get_annotation("PrimaryKey")
+                        kpos = [schema.position(v)
+                                for v in pk.elements.values()] if pk else \
+                            list(range(len(schema.names)))
+                        cache = CacheTable(
+                            store, kpos,
+                            max_size=int(sub.element("size",
+                                                     sub.element("max.size",
+                                                                 10))),
+                            policy=str(sub.element("policy",
+                                                   sub.element("cache.policy",
+                                                               "FIFO"))))
+                self.tables[tid] = RecordTableRuntime(
+                    tdef, schema, store, self.interner, cache=cache)
+            else:
+                self.tables[tid] = TableRuntime(tdef, schema)
 
         # named windows (reference: CORE/window/Window.java:65)
         self.named_windows: Dict[str, NamedWindowRuntime] = {}

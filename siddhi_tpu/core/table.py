@@ -220,3 +220,109 @@ class TableRuntime:
         return ev.EventBatch(self.ts,
                              jnp.zeros(self.ts.shape, jnp.int32),
                              self.valid, self.cols)
+
+
+class RecordTableRuntime(TableRuntime):
+    """`@store(type='...')` table: an external RecordTable store stays
+    authoritative while its rows are mirrored into the device-resident
+    columnar table, so joins/filters run on the TPU and writes flow through
+    the store SPI (reference: AbstractRecordTable.java:449; cache layer
+    CacheTable.java:62).
+
+    The mirror is preloaded at startup (reference:
+    AbstractQueryableRecordTable pre-load) and kept in sync write-through.
+    """
+
+    def __init__(self, definition, schema, store, interner,
+                 cache=None, capacity: int = 4096):
+        from ..io.store import connect_with_retry
+        super().__init__(definition, schema, capacity)
+        self.store = store
+        self.cache = cache
+        self._interner = interner
+        connect_with_retry(store, definition.id)
+        rows = store.read_all()
+        if rows:
+            self._mirror_insert(rows)
+
+    # -- encode/decode ---------------------------------------------------------
+    def _decode_row(self, vals) -> tuple:
+        out = []
+        for v, t in zip(vals, self.schema.types):
+            if t == "STRING":
+                out.append(self._interner.lookup(int(v)))
+            elif t in ("INT", "LONG"):
+                out.append(int(v))
+            elif t in ("FLOAT", "DOUBLE"):
+                out.append(float(v))
+            elif t == "BOOL":
+                out.append(bool(v))
+            else:
+                out.append(v)
+        return tuple(out)
+
+    def _decode_staged(self, staged) -> List[tuple]:
+        idx = np.nonzero(staged.valid)[0]
+        return [self._decode_row([c[i] for c in staged.cols])
+                for i in idx]
+
+    def _decode_mirror(self, mask: np.ndarray) -> List[tuple]:
+        cols = [np.asarray(c) for c in self.cols]
+        return [self._decode_row([c[i] for c in cols])
+                for i in np.nonzero(mask)[0]]
+
+    def _mirror_insert(self, rows: List[tuple]) -> None:
+        """Load store rows into the device mirror without re-adding them."""
+        enc_cols = []
+        for j, t in enumerate(self.schema.types):
+            vals = [r[j] for r in rows]
+            if t == "STRING":
+                vals = [self._interner.intern(v) for v in vals]
+            enc_cols.append(np.asarray(vals, ev.np_dtype(t)))
+        n = len(rows)
+        staged = ev.StagedBatch(
+            np.zeros(n, np.int64), np.zeros(n, np.int8),
+            np.ones(n, bool), enc_cols, n)
+        batch = ev.EventBatch(
+            jnp.zeros(n, jnp.int64), jnp.zeros(n, jnp.int32),
+            jnp.ones(n, jnp.bool_),
+            tuple(jnp.asarray(c).astype(d)
+                  for c, d in zip(enc_cols, self.schema.dtypes)))
+        super().insert(batch, staged)
+
+    # -- write-through ops -----------------------------------------------------
+    def insert(self, batch, staged) -> None:
+        rows = self._decode_staged(staged)
+        if rows:
+            self.store.add(rows)
+            if self.cache is not None:
+                self.cache.on_add(rows)
+        super().insert(batch, staged)
+
+    def delete_where(self, compiled, other_key, batch, staged=None) -> None:
+        with self._lock:
+            m = self.match_matrix(compiled, other_key, batch)
+            kill = np.asarray(jnp.any(m, axis=0))
+            rows = self._decode_mirror(kill & np.asarray(self.valid))
+            if rows:
+                self.store.delete_rows(rows)
+                if self.cache is not None:
+                    self.cache.on_delete(rows)
+            self.valid = self._jit_masked_delete(self.valid, jnp.asarray(kill))
+            self._reclaim(kill)
+
+    def update_where(self, compiled, other_key, batch, set_fns,
+                     upsert=False, staged=None, insert_map=None) -> None:
+        with self._lock:
+            m = self.match_matrix(compiled, other_key, batch)
+            hit = np.asarray(jnp.any(m, axis=0)) & np.asarray(self.valid)
+            old_rows = self._decode_mirror(hit)
+        super().update_where(compiled, other_key, batch, set_fns,
+                             upsert=upsert, staged=staged,
+                             insert_map=insert_map)
+        with self._lock:
+            new_rows = self._decode_mirror(hit)
+        if old_rows:
+            self.store.update_rows(old_rows, new_rows)
+            if self.cache is not None:
+                self.cache.on_update(old_rows, new_rows)

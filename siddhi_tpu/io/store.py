@@ -1,0 +1,383 @@
+"""Record table stores: the external-storage SPI behind `@store(...)` tables,
+plus the bounded row cache (FIFO/LRU/LFU).
+
+Reference behavior (what): CORE/table/record/AbstractRecordTable.java:449
+(connect-with-retry, add/find/contains/delete/update/updateOrAdd against an
+external store), CORE/table/CacheTable.java:62 with FIFO:111/LRU:128/LFU:128
+policies, and the `@store` annotation consumed by DefinitionParserHelper.
+
+TPU-native design (how): external stores are host-side I/O, so the SPI is a
+plain Python class registered with @record_store("type").  The streaming hot
+path never talks to the store row-by-row: the runtime keeps the store's rows
+mirrored in the device-resident columnar table (joins and filters stay on
+the TPU), and write operations flow through the store SPI so the external
+system stays authoritative.  Conditions hand stores BOTH the expression AST
+(for query pushdown, e.g. SQL translation) and a host row predicate.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..query_api.expression import (
+    Add,
+    And,
+    AttributeFunction,
+    Compare,
+    Constant,
+    Divide,
+    Expression,
+    IsNull,
+    Mod,
+    Multiply,
+    Not,
+    Or,
+    Subtract,
+    Variable,
+)
+
+_STORE_TYPES: Dict[str, type] = {}
+
+
+def record_store(name: str):
+    """Register a RecordTable store type (reference: @Extension store types,
+    e.g. store:rdbms)."""
+    def deco(cls):
+        _STORE_TYPES[name.lower()] = cls
+        return cls
+    return deco
+
+
+def store_registry() -> Dict[str, type]:
+    return _STORE_TYPES
+
+
+def create_store(type_name: str, table_def, schema, properties: Dict,
+                 config_reader=None) -> "RecordTable":
+    cls = _STORE_TYPES.get(type_name.lower())
+    if cls is None:
+        raise ValueError(
+            f"unknown store type {type_name!r}; registered: "
+            f"{sorted(_STORE_TYPES)}")
+    store = cls()
+    store.init(table_def, schema, properties, config_reader)
+    return store
+
+
+class StoreCondition:
+    """Condition handed to stores: the raw AST for pushdown plus a compiled
+    host predicate fn(table_row: tuple, params: dict) -> bool."""
+
+    def __init__(self, ast: Optional[Expression], schema, other_key=None):
+        self.ast = ast
+        self.schema = schema
+        self.other_key = other_key
+        self._fn = _compile_host(ast, schema, other_key) if ast is not None \
+            else (lambda row, params: True)
+
+    def matches(self, row: Sequence, params: Optional[Dict] = None) -> bool:
+        return bool(self._fn(row, params or {}))
+
+
+def _compile_host(expr: Expression, schema, other_key):
+    """Expression AST -> python predicate over one table row.  Variables of
+    the table schema read the row; `other_key`-qualified (or unresolved)
+    variables read the params dict."""
+    pos = {a.name: i for i, a in enumerate(schema.definition.attribute_list)}
+
+    def ev_(e, row, params):
+        if isinstance(e, Constant):
+            return e.value
+        if isinstance(e, Variable):
+            n = e.attribute_name
+            if e.stream_id in (None, schema.definition.id) and n in pos:
+                return row[pos[n]]
+            return params.get(f"{e.stream_id}.{n}" if e.stream_id else n,
+                              params.get(n))
+        if isinstance(e, Compare):
+            l, r = ev_(e.left, row, params), ev_(e.right, row, params)
+            return {"<": l < r, "<=": l <= r, ">": l > r, ">=": l >= r,
+                    "==": l == r, "!=": l != r}[e.operator]
+        if isinstance(e, And):
+            return ev_(e.left, row, params) and ev_(e.right, row, params)
+        if isinstance(e, Or):
+            return ev_(e.left, row, params) or ev_(e.right, row, params)
+        if isinstance(e, Not):
+            return not ev_(e.expression, row, params)
+        if isinstance(e, Add):
+            return ev_(e.left, row, params) + ev_(e.right, row, params)
+        if isinstance(e, Subtract):
+            return ev_(e.left, row, params) - ev_(e.right, row, params)
+        if isinstance(e, Multiply):
+            return ev_(e.left, row, params) * ev_(e.right, row, params)
+        if isinstance(e, Divide):
+            return ev_(e.left, row, params) / ev_(e.right, row, params)
+        if isinstance(e, Mod):
+            return ev_(e.left, row, params) % ev_(e.right, row, params)
+        if isinstance(e, IsNull):
+            return ev_(e.expression, row, params) is None
+        if isinstance(e, AttributeFunction):
+            raise ValueError(
+                f"function {e.name!r} not supported in store conditions")
+        raise ValueError(f"unsupported store condition node {e!r}")
+
+    return lambda row, params: ev_(expr, row, params)
+
+
+class ConnectionUnavailableException(Exception):
+    """Raised by stores when the backing system is unreachable (reference:
+    CORE/exception/ConnectionUnavailableException)."""
+
+
+class RecordTable:
+    """Store SPI (reference: AbstractRecordTable.java:449).
+
+    Lifecycle: init -> connect (with exponential-backoff retry) -> add/
+    find/delete_rows/update_rows/read_all -> disconnect."""
+
+    def init(self, table_def, schema, properties: Dict,
+             config_reader=None) -> None:
+        self.table_def = table_def
+        self.schema = schema
+        self.properties = properties
+        self.config_reader = config_reader
+
+    def connect(self) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        pass
+
+    # -- record operations ----------------------------------------------------
+    def add(self, records: List[Tuple]) -> None:
+        raise NotImplementedError
+
+    def read_all(self) -> List[Tuple]:
+        raise NotImplementedError
+
+    def find(self, condition: StoreCondition,
+             params: Optional[Dict] = None) -> List[Tuple]:
+        return [r for r in self.read_all() if condition.matches(r, params)]
+
+    def contains(self, condition: StoreCondition,
+                 params: Optional[Dict] = None) -> bool:
+        return bool(self.find(condition, params))
+
+    def delete_rows(self, rows: List[Tuple],
+                    condition: Optional[StoreCondition] = None) -> None:
+        raise NotImplementedError
+
+    def update_rows(self, old_rows: List[Tuple], new_rows: List[Tuple],
+                    condition: Optional[StoreCondition] = None) -> None:
+        raise NotImplementedError
+
+
+def connect_with_retry(store: RecordTable, name: str,
+                       max_wait_s: float = 60.0,
+                       _sleep=time.sleep) -> None:
+    """Exponential backoff connect (reference: BackoffRetryCounter sequence
+    5s,10s,...,1min capped)."""
+    wait = 0.05
+    while True:
+        try:
+            store.connect()
+            return
+        except ConnectionUnavailableException:
+            _sleep(wait)
+            wait = min(wait * 2, max_wait_s)
+
+
+@record_store("memory")
+class InMemoryRecordStore(RecordTable):
+    """In-process list-of-rows store: the test double for all record-table
+    behavior (reference: TEST/query/table/util/TestStore)."""
+
+    def init(self, table_def, schema, properties, config_reader=None):
+        super().init(table_def, schema, properties, config_reader)
+        self.rows: List[Tuple] = []
+        self._lock = threading.Lock()
+
+    def add(self, records):
+        with self._lock:
+            self.rows.extend(tuple(r) for r in records)
+
+    def read_all(self):
+        with self._lock:
+            return list(self.rows)
+
+    def delete_rows(self, rows, condition=None):
+        with self._lock:
+            for r in rows:
+                try:
+                    self.rows.remove(tuple(r))
+                except ValueError:
+                    pass
+
+    def update_rows(self, old_rows, new_rows, condition=None):
+        with self._lock:
+            for old, new in zip(old_rows, new_rows):
+                try:
+                    i = self.rows.index(tuple(old))
+                    self.rows[i] = tuple(new)
+                except ValueError:
+                    self.rows.append(tuple(new))
+
+
+# ---------------------------------------------------------------------------
+# Cache layer (reference: CacheTable + FIFO/LRU/LFU policies)
+# ---------------------------------------------------------------------------
+
+
+class CachePolicy:
+    """Bounded key->row cache; subclasses choose the eviction victim."""
+
+    def __init__(self, max_size: int):
+        self.max_size = max_size
+        self._rows: Dict[Any, Tuple] = {}
+
+    def __len__(self):
+        return len(self._rows)
+
+    def __contains__(self, key):
+        return key in self._rows
+
+    def get(self, key):
+        row = self._rows.get(key)
+        if row is not None:
+            self._touch(key)
+        return row
+
+    def put(self, key, row) -> None:
+        if key not in self._rows and len(self._rows) >= self.max_size:
+            victim = self._victim()
+            if victim is not None:
+                self.evict(victim)
+        self._rows[key] = row
+        self._admit(key)
+
+    def evict(self, key) -> None:
+        self._rows.pop(key, None)
+        self._forget(key)
+
+    def clear(self) -> None:
+        self._rows.clear()
+
+    # policy hooks
+    def _admit(self, key) -> None: ...
+    def _touch(self, key) -> None: ...
+    def _forget(self, key) -> None: ...
+    def _victim(self): ...
+
+
+class FIFOCache(CachePolicy):
+    """Evict the oldest-admitted entry (reference: CacheTableFIFO)."""
+
+    def __init__(self, max_size):
+        super().__init__(max_size)
+        self._order: List[Any] = []
+
+    def _admit(self, key):
+        if key not in self._order:
+            self._order.append(key)
+
+    def _forget(self, key):
+        if key in self._order:
+            self._order.remove(key)
+
+    def _victim(self):
+        return self._order[0] if self._order else None
+
+
+class LRUCache(CachePolicy):
+    """Evict the least-recently-used entry (reference: CacheTableLRU)."""
+
+    def __init__(self, max_size):
+        super().__init__(max_size)
+        self._stamp: Dict[Any, int] = {}
+        self._tick = 0
+
+    def _admit(self, key):
+        self._touch(key)
+
+    def _touch(self, key):
+        self._tick += 1
+        self._stamp[key] = self._tick
+
+    def _forget(self, key):
+        self._stamp.pop(key, None)
+
+    def _victim(self):
+        return min(self._stamp, key=self._stamp.get) if self._stamp else None
+
+
+class LFUCache(CachePolicy):
+    """Evict the least-frequently-used entry (reference: CacheTableLFU)."""
+
+    def __init__(self, max_size):
+        super().__init__(max_size)
+        self._hits: Dict[Any, int] = {}
+
+    def _admit(self, key):
+        self._hits.setdefault(key, 0)
+
+    def _touch(self, key):
+        self._hits[key] = self._hits.get(key, 0) + 1
+
+    def _forget(self, key):
+        self._hits.pop(key, None)
+
+    def _victim(self):
+        return min(self._hits, key=self._hits.get) if self._hits else None
+
+
+CACHE_POLICIES = {"FIFO": FIFOCache, "LRU": LRUCache, "LFU": LFUCache}
+
+
+class CacheTable:
+    """Bounded read cache in front of a RecordTable (reference:
+    CacheTable.java:62).  Keys are the table's primary key tuples."""
+
+    def __init__(self, store: RecordTable, key_positions: List[int],
+                 max_size: int = 10, policy: str = "FIFO",
+                 preload: bool = False):
+        if policy.upper() not in CACHE_POLICIES:
+            raise ValueError(
+                f"unknown cache policy {policy!r}; one of FIFO/LRU/LFU")
+        self.store = store
+        self.key_positions = key_positions
+        self.cache: CachePolicy = CACHE_POLICIES[policy.upper()](max_size)
+        self.hits = 0
+        self.misses = 0
+        if preload:
+            for row in store.read_all()[:max_size]:
+                self.cache.put(self._key(row), row)
+
+    def _key(self, row):
+        return tuple(row[i] for i in self.key_positions)
+
+    def get(self, key_values: Tuple) -> Optional[Tuple]:
+        row = self.cache.get(key_values)
+        if row is not None:
+            self.hits += 1
+            return row
+        self.misses += 1
+        cond = StoreCondition(None, None)
+        for r in self.store.read_all():
+            if self._key(r) == key_values:
+                self.cache.put(key_values, r)
+                return r
+        return None
+
+    def on_add(self, rows: List[Tuple]) -> None:
+        for r in rows:
+            self.cache.put(self._key(r), r)
+
+    def on_delete(self, rows: List[Tuple]) -> None:
+        for r in rows:
+            self.cache.evict(self._key(r))
+
+    def on_update(self, old_rows: List[Tuple], new_rows: List[Tuple]) -> None:
+        for o, n in zip(old_rows, new_rows):
+            self.cache.evict(self._key(o))
+            self.cache.put(self._key(n), n)

@@ -1,0 +1,166 @@
+"""@store record tables, the RecordTable SPI, and cache policies
+(reference: AbstractRecordTable, CacheTable FIFO/LRU/LFU, TestStore)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.io.store import (
+    CacheTable,
+    ConnectionUnavailableException,
+    InMemoryRecordStore,
+    RecordTable,
+    StoreCondition,
+    connect_with_retry,
+    record_store,
+    store_registry,
+)
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+BASE_QL = """
+define stream In (symbol string, price float);
+define stream Del (symbol string);
+define stream Upd (symbol string, price float);
+@store(type='memory')
+define table T (symbol string, price float);
+@info(name='ins') from In select symbol, price insert into T;
+@info(name='del') from Del delete T on T.symbol == symbol;
+@info(name='upd') from Upd update T set T.price = price
+    on T.symbol == symbol;
+"""
+
+
+def test_store_table_crud(manager):
+    rt = manager.create_siddhi_app_runtime(BASE_QL)
+    rt.start()
+    store = rt.tables["T"].store
+    rt.get_input_handler("In").send([["A", 10.0], ["B", 20.0]])
+    rt.flush()
+    assert sorted(store.read_all()) == [("A", 10.0), ("B", 20.0)]
+
+    rt.get_input_handler("Upd").send(["A", 99.0])
+    rt.flush()
+    assert sorted(store.read_all()) == [("A", 99.0), ("B", 20.0)]
+
+    rt.get_input_handler("Del").send(["B"])
+    rt.flush()
+    assert store.read_all() == [("A", 99.0)]
+
+
+def test_store_preload_and_join(manager):
+    """Rows already in the store are visible to joins after startup."""
+    pre = [("X", 1.5), ("Y", 2.5)]
+
+    @record_store("preloaded")
+    class PreloadedStore(InMemoryRecordStore):
+        def init(self, table_def, schema, properties, config_reader=None):
+            super().init(table_def, schema, properties, config_reader)
+            self.rows = list(pre)
+
+    ql = """
+    define stream S (symbol string);
+    @store(type='preloaded')
+    define table T (symbol string, price float);
+    @info(name='j')
+    from S join T on S.symbol == T.symbol
+    select S.symbol as s, T.price as p insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("j", lambda ts, ins, outs: got.extend(
+        list(e.data) for e in ins or []))
+    rt.start()
+    rt.get_input_handler("S").send(["Y"])
+    rt.flush()
+    assert got == [["Y", 2.5]]
+
+
+def test_store_on_demand_query(manager):
+    rt = manager.create_siddhi_app_runtime(BASE_QL)
+    rt.start()
+    rt.get_input_handler("In").send([["A", 10.0], ["B", 20.0]])
+    rt.flush()
+    events = rt.query("from T select symbol, price")
+    assert sorted(tuple(e.data) for e in events) == [("A", 10.0), ("B", 20.0)]
+
+
+def test_connect_retry_backoff():
+    calls = []
+
+    class Flaky(RecordTable):
+        n = 0
+
+        def connect(self):
+            Flaky.n += 1
+            if Flaky.n < 3:
+                raise ConnectionUnavailableException("down")
+
+    waits = []
+    connect_with_retry(Flaky(), "t", _sleep=waits.append)
+    assert len(waits) == 2 and waits[1] == waits[0] * 2
+
+
+def test_store_condition_pushdown_ast():
+    from siddhi_tpu.compiler.parser import Parser
+    from siddhi_tpu.core import event as ev
+    from siddhi_tpu.query_api.definition import TableDefinition
+
+    tdef = TableDefinition("T").attribute("symbol", "STRING") \
+                               .attribute("price", "FLOAT")
+    schema = ev.Schema(tdef, None)
+    ast = Parser("price > 15.0 and symbol == 'B'").parse_expression()
+    cond = StoreCondition(ast, schema)
+    assert cond.ast is ast          # stores get the raw AST for pushdown
+    assert cond.matches(("B", 20.0))
+    assert not cond.matches(("B", 10.0))
+    assert not cond.matches(("A", 20.0))
+
+
+class TestCachePolicies:
+    def _mk(self, policy):
+        store = InMemoryRecordStore()
+        store.init(None, None, {})
+        store.add([(i, i * 10.0) for i in range(5)])
+        return CacheTable(store, [0], max_size=2, policy=policy)
+
+    def test_fifo_evicts_oldest(self):
+        c = self._mk("FIFO")
+        c.get((0,)); c.get((1,))       # cache: 0, 1
+        c.get((0,))                    # touch 0 (FIFO ignores)
+        c.get((2,))                    # evicts 0
+        assert (0,) not in c.cache and (1,) in c.cache and (2,) in c.cache
+
+    def test_lru_evicts_least_recent(self):
+        c = self._mk("LRU")
+        c.get((0,)); c.get((1,))
+        c.get((0,))                    # 0 now most recent
+        c.get((2,))                    # evicts 1
+        assert (1,) not in c.cache and (0,) in c.cache and (2,) in c.cache
+
+    def test_lfu_evicts_least_frequent(self):
+        c = self._mk("LFU")
+        c.get((0,)); c.get((1,))
+        c.get((0,)); c.get((0,))       # 0 hot
+        c.get((2,))                    # evicts 1
+        assert (1,) not in c.cache and (0,) in c.cache and (2,) in c.cache
+
+    def test_hit_miss_counters(self):
+        c = self._mk("LRU")
+        c.get((0,))
+        c.get((0,))
+        assert c.misses == 1 and c.hits == 1
+
+    def test_unknown_policy_rejected(self):
+        store = InMemoryRecordStore(); store.init(None, None, {})
+        with pytest.raises(ValueError):
+            CacheTable(store, [0], policy="RANDOM")
+
+
+def test_registry_has_memory():
+    assert "memory" in store_registry()
