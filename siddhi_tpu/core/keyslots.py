@@ -57,6 +57,8 @@ class SlotAllocator:
         self._tslot = np.full(self._cap2, -1, np.int32)
         self._cell_by_slot = np.full(capacity, -1, np.int64)
         self._tombstones = 0
+        # insertion journal for incremental snapshots (drained per snapshot)
+        self.journal: List[Tuple[bytes, int]] = []
 
     def __len__(self):
         return len(self._map)
@@ -168,6 +170,7 @@ class SlotAllocator:
             self._map[key] = slot
             self._keys_by_slot[slot] = key
             self._table_insert(int(h1[r]), int(h2[r]), slot)
+            self.journal.append((key, slot))
 
     def purge(self, slots: Sequence[int]) -> None:
         with self._lock:
@@ -187,6 +190,29 @@ class SlotAllocator:
     def snapshot(self) -> Dict[bytes, int]:
         with self._lock:
             return dict(self._map)
+
+    def drain_journal(self) -> List[Tuple[bytes, int]]:
+        """Insertions since the last drain (incremental snapshot delta)."""
+        with self._lock:
+            j, self.journal = self.journal, []
+            return j
+
+    def apply_journal(self, entries: List[Tuple[bytes, int]]) -> None:
+        """Replay journal entries from an incremental snapshot."""
+        with self._lock:
+            taken = set()
+            for key, slot in entries:
+                if key in self._map:
+                    continue
+                self._map[key] = slot
+                self._keys_by_slot[slot] = key
+                taken.add(slot)
+                w = np.frombuffer(key, np.uint64)[None, :]
+                h1 = max(int(_hash_words(w, 0)[0]), 2)
+                h2 = int(_hash_words(w, 0xABCD)[0])
+                self._table_insert(h1, h2, slot)
+            if taken:
+                self._free = [s for s in self._free if s not in taken]
 
     def restore(self, mapping: Dict[bytes, int]) -> None:
         with self._lock:

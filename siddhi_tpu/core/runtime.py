@@ -52,6 +52,13 @@ class QueryCallback:
         raise NotImplementedError
 
 
+def _allocator_of(qr):
+    """Slot allocator of a query runtime (pattern runtimes hold it
+    directly, planned single queries on the plan)."""
+    return getattr(qr, "slot_allocator", None) or \
+        getattr(qr.planned, "slot_allocator", None)
+
+
 def _wrap_stream_callback(cb) -> Callable[[List[ev.Event]], None]:
     if isinstance(cb, StreamCallback):
         return cb.receive
@@ -167,6 +174,9 @@ class PatternQueryRuntime:
         self.batch_callbacks: List[Callable] = []
         self.next_wakeup: int = _NO_WAKEUP_INT
         self.slot_allocator = slot_allocator  # shared per partition
+        # per-key dirty mask since the last (incremental) snapshot
+        self._dirty = np.zeros(planned.key_capacity, np.bool_) \
+            if planned.partition_positions else None
 
     @property
     def name(self):
@@ -197,10 +207,16 @@ class PatternQueryRuntime:
             # of row-serialized gather/scatter (see dense_steps)
             Kb = key_idx_np.shape[0]
             nuniq = int((key_idx_np < p.key_capacity).sum())
+            if self._dirty is not None and nuniq:
+                self._dirty[key_idx_np[:nuniq]] = True
             if (p.dense_steps is not None and nuniq > 0 and
                     int(key_idx_np[0]) + Kb <= p.key_capacity and
                     int(key_idx_np[nuniq - 1]) ==
                     int(key_idx_np[0]) + nuniq - 1):
+                if self._dirty is not None:
+                    # the dense step also time-ticks slots beyond nuniq
+                    self._dirty[int(key_idx_np[0]):
+                                int(key_idx_np[0]) + Kb] = True
                 pstate, sel_state = self.state
                 pstate, sel_state, out, wake = p.dense_steps[stream_id](
                     pstate, sel_state, cols, ts, valid, ord_,
@@ -238,6 +254,12 @@ class PatternQueryRuntime:
         pos = p.partition_positions[stream_id]
         slots = self.slot_allocator.slots_for(
             [staged.cols[i] for i in pos], staged.valid)
+        if self._dirty is not None:
+            live = slots[slots >= 0]
+            if live.size:
+                # global state column of slot s under the shard layout
+                self._dirty[(live % n) * (p.key_capacity // n) +
+                            live // n] = True
         dev = slots % n
         local = slots // n
         groups = []
@@ -1476,7 +1498,7 @@ class SiddhiAppRuntime:
             states = {}
             for name, qr in self.query_runtimes.items():
                 host_state = jax.tree.map(lambda x: np.asarray(x), qr.state)
-                alloc = qr.planned.slot_allocator
+                alloc = _allocator_of(qr)
                 states[name] = {
                     "state": host_state,
                     "slots": alloc.snapshot() if alloc else None,
@@ -1486,13 +1508,100 @@ class SiddhiAppRuntime:
                 for wid, nw in self.named_windows.items()}
             aggs = {aid: {d: dict(s) for d, s in a.stores.items()}
                     for aid, a in self.aggregations.items()}
+            from .table import _table_state
+            tables = {tid: _table_state(t) for tid, t in self.tables.items()}
             payload = {
                 "states": states,
                 "windows": windows,
                 "aggregations": aggs,
+                "tables": tables,
+                "interner": list(self.interner._to_str),
+            }
+            # a full snapshot resets the incremental baseline
+            for qr in self.query_runtimes.values():
+                if getattr(qr, "_dirty", None) is not None:
+                    qr._dirty[:] = False
+                alloc = _allocator_of(qr)
+                if alloc is not None:
+                    alloc.journal.clear()
+            return pickle.dumps(payload)
+
+    def snapshot_incremental(self) -> bytes:
+        """Delta since the last snapshot: for partitioned pattern queries
+        only the state columns of keys touched since then (plus their slot
+        journal); small states ship whole (reference: incremental snapshots
+        via per-element op-logs, SnapshotService.incrementalSnapshot :189 —
+        here the op-log is the host-tracked dirty key mask)."""
+        with self._lock:
+            deltas = {}
+            for name, qr in self.query_runtimes.items():
+                alloc = _allocator_of(qr)
+                dirty = getattr(qr, "_dirty", None)
+                if dirty is not None and isinstance(qr.state, tuple) and \
+                        len(qr.state) == 2 and isinstance(qr.state[0], tuple):
+                    idx = np.nonzero(dirty)[0]
+                    b32, b64, scalars = qr.state[0]
+                    deltas[name] = {
+                        "kind": "keyed",
+                        "slots": idx,
+                        "b32": np.asarray(b32)[:, idx],
+                        "b64": np.asarray(b64)[:, idx],
+                        "scalars": [np.asarray(s) for s in scalars],
+                        "sel_state": jax.tree.map(
+                            lambda x: np.asarray(x), qr.state[1]),
+                        "journal": alloc.drain_journal() if alloc else [],
+                    }
+                    dirty[:] = False
+                else:
+                    deltas[name] = {
+                        "kind": "full",
+                        "state": jax.tree.map(
+                            lambda x: np.asarray(x), qr.state),
+                        "slots": alloc.snapshot() if alloc else None,
+                    }
+            from .table import _table_state
+            payload = {
+                "deltas": deltas,
+                "windows": {
+                    wid: jax.tree.map(lambda x: np.asarray(x), nw.state)
+                    for wid, nw in self.named_windows.items()},
+                "aggregations": {
+                    aid: {d: dict(s) for d, s in a.stores.items()}
+                    for aid, a in self.aggregations.items()},
+                "tables": {tid: _table_state(t)
+                           for tid, t in self.tables.items()},
                 "interner": list(self.interner._to_str),
             }
             return pickle.dumps(payload)
+
+    def restore_increment(self, blob: bytes) -> None:
+        payload = pickle.loads(blob)
+        with self._lock:
+            for s in payload["interner"]:
+                self.interner.intern(s)
+            for name, d in payload["deltas"].items():
+                qr = self.query_runtimes.get(name)
+                if qr is None:
+                    continue
+                alloc = _allocator_of(qr)
+                if d["kind"] == "keyed":
+                    (b32, b64, scalars), _ = qr.state
+                    idx = jax.numpy.asarray(d["slots"])
+                    b32 = b32.at[:, idx].set(jax.numpy.asarray(d["b32"]))
+                    b64 = b64.at[:, idx].set(jax.numpy.asarray(d["b64"]))
+                    scalars = tuple(jax.numpy.asarray(s)
+                                    for s in d["scalars"])
+                    sel_state = jax.tree.map(lambda x: jax.numpy.asarray(x),
+                                             d["sel_state"])
+                    qr.state = ((b32, b64, scalars), sel_state)
+                    if alloc is not None:
+                        alloc.apply_journal(d["journal"])
+                else:
+                    qr.state = jax.tree.map(
+                        lambda x: jax.numpy.asarray(x), d["state"])
+                    if d["slots"] is not None and alloc is not None:
+                        alloc.restore(d["slots"])
+            self._restore_shared(payload)
 
     def restore(self, blob: bytes) -> None:
         payload = pickle.loads(blob)
@@ -1505,17 +1614,26 @@ class SiddhiAppRuntime:
                     continue
                 qr.state = jax.tree.map(
                     lambda x: jax.numpy.asarray(x), data["state"])
-                if data["slots"] is not None and qr.planned.slot_allocator:
-                    qr.planned.slot_allocator.restore(data["slots"])
-            for wid, wstate in payload.get("windows", {}).items():
-                nw = self.named_windows.get(wid)
-                if nw is not None:
-                    nw.state = jax.tree.map(
-                        lambda x: jax.numpy.asarray(x), wstate)
-            for aid, stores in payload.get("aggregations", {}).items():
-                agg = self.aggregations.get(aid)
-                if agg is not None:
-                    agg.stores = {d: dict(s) for d, s in stores.items()}
+                alloc = _allocator_of(qr)
+                if data["slots"] is not None and alloc is not None:
+                    alloc.restore(data["slots"])
+            self._restore_shared(payload)
+
+    def _restore_shared(self, payload) -> None:
+        from .table import _restore_table_state
+        for wid, wstate in payload.get("windows", {}).items():
+            nw = self.named_windows.get(wid)
+            if nw is not None:
+                nw.state = jax.tree.map(
+                    lambda x: jax.numpy.asarray(x), wstate)
+        for aid, stores in payload.get("aggregations", {}).items():
+            agg = self.aggregations.get(aid)
+            if agg is not None:
+                agg.stores = {d: dict(s) for d, s in stores.items()}
+        for tid, tdata in payload.get("tables", {}).items():
+            t = self.tables.get(tid)
+            if t is not None:
+                _restore_table_state(t, tdata)
 
 
 class SiddhiManager:
@@ -1525,13 +1643,18 @@ class SiddhiManager:
         from ..utils.config import ConfigManager
         from ..utils.persistence import InMemoryPersistenceStore
         self.interner = ev.StringInterner()
+        from ..utils.persistence import AsyncSnapshotPersistor
         self.runtimes: Dict[str, SiddhiAppRuntime] = {}
         self.persistence_store = InMemoryPersistenceStore()
         self.config_manager = ConfigManager()
+        self._persistor = AsyncSnapshotPersistor()
+        self._has_base: set = set()
 
     def set_persistence_store(self, store) -> None:
-        """reference: SiddhiManager.setPersistenceStore"""
+        """reference: SiddhiManager.setPersistenceStore (full or
+        incremental store)."""
         self.persistence_store = store
+        self._has_base.clear()
 
     def set_config_manager(self, config_manager) -> None:
         """reference: SiddhiManager.setConfigManager — supplies system-wide
@@ -1554,26 +1677,67 @@ class SiddhiManager:
     # camelCase alias mirroring the reference API surface
     createSiddhiAppRuntime = create_siddhi_app_runtime
 
-    def persist(self) -> None:
+    def persist(self) -> List[str]:
         """Snapshot every app into the persistence store (reference:
         SiddhiManager.persist :281; sources pause around the snapshot as in
-        SiddhiAppRuntimeImpl.persist :677-691)."""
-        from ..utils.persistence import new_revision
+        SiddhiAppRuntimeImpl.persist :677-691).
+
+        With an IncrementalPersistenceStore, the first persist writes a full
+        BASE snapshot and subsequent calls write dirty-key INCREMENTS.  The
+        store write happens on the async persistor thread (reference:
+        AsyncSnapshotPersistor); call wait_for_persistence() to block on it.
+        Returns the revision ids."""
+        from ..utils.persistence import (
+            IncrementalPersistenceStore,
+            new_revision,
+        )
+        store = self.persistence_store
+        incremental = isinstance(store, IncrementalPersistenceStore)
+        revs = []
         for name, rt in self.runtimes.items():
             rt.pause_sources()
             try:
-                self.persistence_store.save(name, new_revision(name),
-                                            rt.snapshot())
+                rev = new_revision(name)
+                if incremental:
+                    if name not in self._has_base:
+                        blob = rt.snapshot()
+                        self._persistor.submit(store.save_base, name, rev,
+                                               blob)
+                        self._has_base.add(name)
+                    else:
+                        blob = rt.snapshot_incremental()
+                        self._persistor.submit(store.save_increment, name,
+                                               rev, blob)
+                else:
+                    self._persistor.submit(store.save, name, rev,
+                                           rt.snapshot())
+                revs.append(rev)
             finally:
                 rt.resume_sources()
+        return revs
+
+    def wait_for_persistence(self) -> None:
+        self._persistor.flush()
 
     def restore_last_revision(self) -> None:
+        from ..utils.persistence import IncrementalPersistenceStore
+        self.wait_for_persistence()
+        store = self.persistence_store
         for name, rt in self.runtimes.items():
-            rev = self.persistence_store.get_last_revision(name)
-            if rev is not None:
-                blob = self.persistence_store.load(name, rev)
-                if blob is not None:
-                    rt.restore(blob)
+            if isinstance(store, IncrementalPersistenceStore):
+                chain = store.load_chain(name)
+                if chain is None:
+                    continue
+                base, incs = chain
+                rt.restore(base)
+                for inc in incs:
+                    rt.restore_increment(inc)
+            else:
+                rev = store.get_last_revision(name)
+                if rev is not None:
+                    blob = store.load(name, rev)
+                    if blob is not None:
+                        rt.restore(blob)
 
     def shutdown(self) -> None:
         for rt in self.runtimes.values():

@@ -326,3 +326,33 @@ class RecordTableRuntime(TableRuntime):
             self.store.update_rows(old_rows, new_rows)
             if self.cache is not None:
                 self.cache.on_update(old_rows, new_rows)
+
+
+def _table_state(t: TableRuntime) -> Dict:
+    """Host snapshot of a table's device state (reference: InMemoryTable
+    state; record tables rebuild their mirror from the store on restore)."""
+    if isinstance(t, RecordTableRuntime):
+        return {"record": True}
+    return {
+        "record": False,
+        "cols": [np.asarray(c) for c in t.cols],
+        "ts": np.asarray(t.ts),
+        "valid": np.asarray(t.valid),
+        "append_ptr": t._append_ptr,
+        "free_rows": list(t._free_rows),
+        "slots": t.allocator.snapshot() if t.allocator else None,
+    }
+
+
+def _restore_table_state(t: TableRuntime, data: Dict) -> None:
+    if data.get("record"):
+        return
+    with t._lock:
+        t.cols = tuple(jnp.asarray(c).astype(d)
+                       for c, d in zip(data["cols"], t.schema.dtypes))
+        t.ts = jnp.asarray(data["ts"])
+        t.valid = jnp.asarray(data["valid"])
+        t._append_ptr = data["append_ptr"]
+        t._free_rows = list(data["free_rows"])
+        if data["slots"] is not None and t.allocator:
+            t.allocator.restore(data["slots"])

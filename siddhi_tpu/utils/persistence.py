@@ -10,7 +10,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 
 class PersistenceStore:
@@ -93,3 +93,122 @@ class FileSystemPersistenceStore(PersistenceStore):
 
 def new_revision(app_name: str) -> str:
     return f"{int(time.time() * 1000)}_{app_name}"
+
+
+class IncrementalPersistenceStore:
+    """Incremental snapshot storage: a full BASE snapshot followed by
+    op-log INCREMENT snapshots (reference: IncrementalPersistenceStore +
+    IncrementalFileSystemPersistenceStore)."""
+
+    def save_base(self, app_name: str, revision: str, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def save_increment(self, app_name: str, revision: str,
+                       blob: bytes) -> None:
+        raise NotImplementedError
+
+    def load_chain(self, app_name: str):
+        """Returns (base_blob, [increment blobs in order]) or None."""
+        raise NotImplementedError
+
+    def clear_all_revisions(self, app_name: str) -> None:
+        raise NotImplementedError
+
+
+class InMemoryIncrementalPersistenceStore(IncrementalPersistenceStore):
+    def __init__(self):
+        self._base: Dict[str, Tuple[str, bytes]] = {}
+        self._incs: Dict[str, List[Tuple[str, bytes]]] = {}
+
+    def save_base(self, app_name, revision, blob):
+        self._base[app_name] = (revision, blob)
+        self._incs[app_name] = []
+
+    def save_increment(self, app_name, revision, blob):
+        self._incs.setdefault(app_name, []).append((revision, blob))
+
+    def load_chain(self, app_name):
+        if app_name not in self._base:
+            return None
+        return (self._base[app_name][1],
+                [b for _, b in self._incs.get(app_name, [])])
+
+    def clear_all_revisions(self, app_name):
+        self._base.pop(app_name, None)
+        self._incs.pop(app_name, None)
+
+
+class IncrementalFileSystemPersistenceStore(IncrementalPersistenceStore):
+    """reference: CORE/util/persistence/
+    IncrementalFileSystemPersistenceStore.java — base + increments as files,
+    ordered by revision id."""
+
+    def __init__(self, folder: str):
+        self.folder = folder
+        os.makedirs(folder, exist_ok=True)
+
+    def _dir(self, app_name):
+        d = os.path.join(self.folder, app_name)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def save_base(self, app_name, revision, blob):
+        d = self._dir(app_name)
+        for f in os.listdir(d):          # new base invalidates old chain
+            os.remove(os.path.join(d, f))
+        with open(os.path.join(d, f"base_{revision}.snapshot"), "wb") as f:
+            f.write(blob)
+
+    def save_increment(self, app_name, revision, blob):
+        with open(os.path.join(self._dir(app_name),
+                               f"inc_{revision}.snapshot"), "wb") as f:
+            f.write(blob)
+
+    def load_chain(self, app_name):
+        d = self._dir(app_name)
+        bases = sorted(f for f in os.listdir(d) if f.startswith("base_"))
+        if not bases:
+            return None
+        with open(os.path.join(d, bases[-1]), "rb") as f:
+            base = f.read()
+        incs = []
+        for name in sorted(f for f in os.listdir(d)
+                           if f.startswith("inc_")):
+            with open(os.path.join(d, name), "rb") as f:
+                incs.append(f.read())
+        return base, incs
+
+    def clear_all_revisions(self, app_name):
+        d = self._dir(app_name)
+        for f in os.listdir(d):
+            os.remove(os.path.join(d, f))
+
+
+class AsyncSnapshotPersistor:
+    """Background snapshot writer so persist() does not block the event path
+    (reference: CORE/util/snapshot/AsyncSnapshotPersistor.java:29)."""
+
+    def __init__(self):
+        import queue
+        import threading
+        self._q = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="siddhi-persist")
+        self._thread.start()
+
+    def submit(self, fn, *args) -> None:
+        self._q.put((fn, args))
+
+    def flush(self) -> None:
+        self._q.join()
+
+    def _run(self):
+        while True:
+            fn, args = self._q.get()
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — persistor must survive
+                import traceback
+                traceback.print_exc()
+            finally:
+                self._q.task_done()
