@@ -314,22 +314,13 @@ class Parser:
         return Window(ns, name, params)
 
     def _parse_script_body(self) -> str:
-        self.expect_punct("{")
-        depth = 1
-        parts = []
-        while depth > 0:
-            t = self.next()
-            if t.kind == "EOF":
-                raise SiddhiParserException("unterminated function body",
-                                            t.line, t.col)
-            if t.kind == "PUNCT" and t.text == "{":
-                depth += 1
-            elif t.kind == "PUNCT" and t.text == "}":
-                depth -= 1
-                if depth == 0:
-                    break
-            parts.append(t.text)
-        return " ".join(parts)
+        """The tokenizer captures { ... } bodies verbatim as one SCRIPT
+        token (whitespace preserved — python bodies need it)."""
+        t = self.next()
+        if t.kind != "SCRIPT":
+            raise SiddhiParserException("expected { function body }",
+                                        t.line, t.col)
+        return t.text
 
     def _parse_aggregation_definition(self, anns) -> AggregationDefinition:
         d = AggregationDefinition(self.expect_name())

@@ -99,6 +99,33 @@ def tokenize(text: str) -> List[Token]:
             advance(j + 1 - i)
             toks.append(Token("STRING", val, val, ln, cl))
             continue
+        # script body { ... } (define function): raw capture with balanced
+        # braces, skipping over quoted strings inside the script
+        if c == "{":
+            depth = 0
+            j = i
+            while j < n:
+                ch = text[j]
+                if ch in "'\"":
+                    q = ch
+                    j += 1
+                    while j < n and text[j] != q:
+                        j += 2 if text[j] == "\\" else 1
+                    j += 1
+                    continue
+                if ch == "{":
+                    depth += 1
+                elif ch == "}":
+                    depth -= 1
+                    if depth == 0:
+                        break
+                j += 1
+            if j >= n:
+                err("unterminated { script body }")
+            val = text[i + 1:j]
+            advance(j + 1 - i)
+            toks.append(Token("SCRIPT", val, val, ln, cl))
+            continue
         # backquoted id
         if c == "`":
             j = text.find("`", i + 1)

@@ -78,6 +78,9 @@ class Scope:
     # per-extension config access (utils/config.py); set by the planner when
     # the app runtime carries a ConfigManager
     config_manager = None
+    # `define function` script definitions (id -> FunctionDefinition); set by
+    # the planner from the app
+    script_functions = None
 
     def __init__(self):
         self._sources: Dict[str, "ev.Schema"] = {}
@@ -272,6 +275,10 @@ def compile_expression(expr: Expression, scope: Scope) -> CompiledExpr:
 def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
     name = expr.name
     full = f"{expr.namespace}:{name}" if expr.namespace else name
+    if not expr.namespace and scope.script_functions and \
+            name in scope.script_functions:
+        return _compile_script_function(scope.script_functions[name],
+                                        expr, scope)
     args = expr.parameters
 
     if name in AGGREGATOR_NAMES and not expr.namespace:
@@ -397,3 +404,83 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
 def _extension_registry():
     from .extension import scalar_function_registry
     return scalar_function_registry()
+
+
+def _build_script_callable(fd):
+    """Compile a `define function` body into a python callable
+    fn(data: list) -> value (reference: script function executors; body
+    convention mirrors the reference's javascript scripts — the arguments
+    arrive as the `data` list and the body returns the result)."""
+    import textwrap
+    lang = (fd.language or "").lower()
+    if lang not in ("python", "py"):
+        raise CompileError(
+            f"script language {fd.language!r} is not available in this "
+            f"runtime; define function {fd.id}[python] ...")
+    body = textwrap.dedent(fd.body).strip("\n")
+    ns: Dict[str, Any] = {"np": __import__("numpy"),
+                          "math": __import__("math")}
+    if "return" not in body and "\n" not in body:
+        src = f"def __scriptfn__(data):\n    return ({body})"
+    else:
+        src = "def __scriptfn__(data):\n" + textwrap.indent(body, "    ")
+    try:
+        exec(src, ns)  # noqa: S102 — user-defined script function body
+    except SyntaxError as e:
+        raise CompileError(
+            f"invalid python body in define function {fd.id!r}: {e}")
+    return ns["__scriptfn__"]
+
+
+def _compile_script_function(fd, expr: AttributeFunction,
+                             scope: Scope) -> CompiledExpr:
+    """Script functions run on the host via jax.pure_callback, one batched
+    call per step (the reference evaluates its JS/Scala scripts per event on
+    the JVM; here the device round-trips once per micro-batch instead)."""
+    import numpy as _np
+
+    import jax as _jax
+
+    from . import event as ev
+
+    pyfn = _build_script_callable(fd)
+    args = [compile_expression(p, scope) for p in expr.parameters]
+    rtype = (fd.return_type or "OBJECT").upper()
+    out_dtype = ev.dtype_of(rtype)
+    interner = scope.interner
+    arg_types = [a.type for a in args]
+
+    def host(*arrs):
+        arrs = [_np.asarray(a) for a in arrs]
+        shape = _np.broadcast_shapes(*[a.shape for a in arrs]) if arrs else ()
+        arrs = [_np.broadcast_to(a, shape) for a in arrs]
+        flat = [a.reshape(-1) for a in arrs]
+        n = flat[0].shape[0] if flat else 1
+        out = _np.empty((n,), ev.np_dtype(rtype))
+        for i in range(n):
+            data = []
+            for a, t in zip(flat, arg_types):
+                v = a[i]
+                if t == "STRING":
+                    data.append(interner.lookup(int(v)))
+                elif t == "BOOL":
+                    data.append(bool(v))
+                elif t in ("FLOAT", "DOUBLE"):
+                    data.append(float(v))
+                else:
+                    data.append(int(v))
+            r = pyfn(data)
+            if rtype == "STRING":
+                out[i] = interner.intern(None if r is None else str(r))
+            else:
+                out[i] = r
+        return out.reshape(shape)
+
+    def fn(env):
+        vals = [a.fn(env) for a in args]
+        vals = [jnp.asarray(v) for v in vals]
+        shape = jnp.broadcast_shapes(*[v.shape for v in vals]) if vals else ()
+        sds = _jax.ShapeDtypeStruct(shape, out_dtype)
+        return _jax.pure_callback(host, sds, *vals, vmap_method="expand_dims")
+
+    return CompiledExpr(fn, rtype)

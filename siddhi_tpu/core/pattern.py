@@ -199,7 +199,7 @@ class PatternState(NamedTuple):
 class PatternExec:
     def __init__(self, spec: PatternSpec, schemas: Dict[str, ev.Schema],
                  interner: ev.StringInterner, slots: int = 8,
-                 emit_refs: Optional[set] = None):
+                 emit_refs: Optional[set] = None, script_functions=None):
         self.spec = spec
         self.schemas = schemas
         self.P = slots
@@ -212,6 +212,7 @@ class PatternExec:
         # selector-facing scope: every non-absent atom ref is a source
         self.scope = Scope()
         self.scope.interner = interner
+        self.scope.script_functions = script_functions
         for a in spec.all_atoms():
             if not a.absent:
                 self.scope.add_source(a.ref, schemas[a.stream_id])
@@ -225,6 +226,7 @@ class PatternExec:
                 continue
             fscope = Scope()
             fscope.interner = interner
+            fscope.script_functions = script_functions
             fscope.add_source(a.ref, schemas[a.stream_id], default=True)
             for other in spec.all_atoms():
                 if other.ckey != a.ckey and not other.absent:

@@ -148,6 +148,7 @@ def plan_pattern_query(
     count_cap: int = 8,
     partition_positions: Optional[Dict[str, List[int]]] = None,
     mesh=None,
+    script_functions=None,
 ) -> PlannedPatternQuery:
     sis = query.input_stream
     assert isinstance(sis, StateInputStream)
@@ -164,7 +165,8 @@ def plan_pattern_query(
         if sid not in schemas:
             raise CompileError(f"undefined stream {sid!r} in pattern")
     pexec = PatternExec(spec, schemas, interner, slots=slots,
-                        emit_refs=_used_refs(query, spec))
+                        emit_refs=_used_refs(query, spec),
+                        script_functions=script_functions)
 
     out_target = query.output_stream.target_id if query.output_stream else ""
     # per-key aggregation: the selector's group slots are the partition keys

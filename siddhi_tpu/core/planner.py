@@ -74,6 +74,7 @@ def plan_single_query(
     partition_positions: Optional[List[int]] = None,
     named_window_input: bool = False,
     config_manager=None,
+    script_functions=None,
 ) -> PlannedQuery:
     ist = query.input_stream
     assert isinstance(ist, SingleInputStream)
@@ -104,6 +105,7 @@ def plan_single_query(
     # scope.config_manager.generate_config_reader(namespace, name)
     # (reference: ConfigReader wired in SingleInputStreamParser :205-217)
     scope.config_manager = config_manager
+    scope.script_functions = script_functions
 
     # ---- handlers: filters/stream-functions before/after the window --------
     # chain entries: ('filter', compiled) | ('fn', dtypes, fn)

@@ -1062,7 +1062,9 @@ class SiddhiAppRuntime:
             return
         if isinstance(q.input_stream, StateInputStream):
             from .pattern_planner import plan_pattern_query
-            planned = plan_pattern_query(q, name, self.schemas, self.interner)
+            planned = plan_pattern_query(
+                q, name, self.schemas, self.interner,
+                script_functions=self.app.function_definition_map)
             runtime = PatternQueryRuntime(planned, self)
             runtime.async_emit = self._async_enabled(q)
             self.query_runtimes[name] = runtime
@@ -1083,7 +1085,8 @@ class SiddhiAppRuntime:
         planned = plan_single_query(
             q, name, self.app.stream_definition_map, self.schemas,
             self.interner, named_window_input=from_window,
-            config_manager=self.config_manager)
+            config_manager=self.config_manager,
+            script_functions=self.app.function_definition_map)
         runtime = QueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
         self.query_runtimes[name] = runtime
@@ -1273,7 +1276,8 @@ class SiddhiAppRuntime:
                 planned = plan_pattern_query(
                     q, qname, self.schemas, self.interner,
                     key_capacity=keys_cap, slots=nfa_slots,
-                    partition_positions=ppos, mesh=self.mesh)
+                    partition_positions=ppos, mesh=self.mesh,
+                    script_functions=self.app.function_definition_map)
                 runtime = PatternQueryRuntime(planned, self,
                                               slot_allocator=shared_allocator)
                 runtime.async_emit = self._async_enabled(q)
@@ -1302,7 +1306,8 @@ class SiddhiAppRuntime:
                     q, qname, self.app.stream_definition_map, self.schemas,
                     self.interner, group_slots=max(keys_cap, 4096),
                     partition_positions=ppos,
-                    config_manager=self.config_manager)
+                    config_manager=self.config_manager,
+                    script_functions=self.app.function_definition_map)
                 runtime = QueryRuntime(planned, self)
                 self.query_runtimes[qname] = runtime
                 self.junctions[sid].subscribe_query(runtime)

@@ -1,0 +1,95 @@
+"""`define function` script functions (reference: script function executors
++ FunctionTestCase; language here is python, run host-side per micro-batch
+via jax.pure_callback)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core.executor import CompileError
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def _run(manager, ql, sends, query="q"):
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(query, lambda ts, ins, outs: got.extend(
+        list(e.data) for e in ins or []))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for e in sends:
+        h.send(e)
+    rt.flush()
+    return got
+
+
+def test_numeric_expression_body(manager):
+    ql = """
+    define function addFive[python] return int { data[0] + 5 };
+    define stream S (v int);
+    @info(name='q') from S select addFive(v) as r insert into Out;
+    """
+    assert _run(manager, ql, [[1], [10]]) == [[6], [15]]
+
+
+def test_multiline_python_body(manager):
+    ql = """
+    define function grade[python] return string {
+        v = data[0]
+        if v >= 90:
+            return "A"
+        elif v >= 50:
+            return "B"
+        return "C"
+    };
+    define stream S (score double);
+    @info(name='q') from S select grade(score) as g insert into Out;
+    """
+    assert _run(manager, ql, [[95.0], [60.0], [10.0]]) == \
+        [["A"], ["B"], ["C"]]
+
+
+def test_string_concat_function(manager):
+    ql = """
+    define function concatFn[python] return string {
+        return data[0] + '-' + data[1]
+    };
+    define stream S (a string, b string);
+    @info(name='q') from S select concatFn(a, b) as c insert into Out;
+    """
+    assert _run(manager, ql, [["x", "y"]]) == [["x-y"]]
+
+
+def test_script_function_in_filter(manager):
+    ql = """
+    define function isEven[python] return bool { data[0] % 2 == 0 };
+    define stream S (v int);
+    @info(name='q') from S[isEven(v)] select v insert into Out;
+    """
+    assert _run(manager, ql, [[1], [2], [3], [4]]) == [[2], [4]]
+
+
+def test_unknown_language_rejected(manager):
+    ql = """
+    define function f[javascript] return int { return 1 };
+    define stream S (v int);
+    @info(name='q') from S select f(v) as r insert into Out;
+    """
+    with pytest.raises(CompileError):
+        manager.create_siddhi_app_runtime(ql)
+
+
+def test_bad_python_body_rejected(manager):
+    ql = """
+    define function f[python] return int {
+        def oops(:
+    };
+    define stream S (v int);
+    @info(name='q') from S select f(v) as r insert into Out;
+    """
+    with pytest.raises(CompileError):
+        manager.create_siddhi_app_runtime(ql)
