@@ -70,11 +70,9 @@ class TableRuntime:
         key_cols = [staged_cols[i] for i in self.pkey_positions]
         if insert:
             return self.allocator.slots_for(key_cols, valid)
-        # lookup-only: unknown keys -> -1
-        slots = []
-        snapshot = self.allocator
-        out = snapshot.slots_for(key_cols, valid)  # may allocate; acceptable
-        return out
+        # lookup-only: unknown keys -> -1, nothing is allocated (reference:
+        # find/contains never mutate, CORE/table/holder/IndexEventHolder.java)
+        return self.allocator.slots_for(key_cols, valid, lookup_only=True)
 
     def _append_slots(self, n: int) -> np.ndarray:
         out = np.empty((n,), np.int32)
