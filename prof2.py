@@ -1,0 +1,97 @@
+import time, sys
+import numpy as np
+import jax
+
+N_KEYS = 1 << 20
+BATCH = 1 << 17
+QL = f"""
+@app:playback
+@async
+define stream TradeStream (key long, price float, volume int);
+partition with (key of TradeStream)
+begin
+  @capacity(keys='{N_KEYS}', slots='4')
+  @emit(rows='2')
+  @info(name='flagship')
+  from every e1=TradeStream[volume == 1]
+       -> e2=TradeStream[volume == 2 and price >= e1.price]
+       -> e3=TradeStream[volume == 3]
+       -> e4=TradeStream[volume == 4 and price >= e3.price]
+  select e1.key as k, e1.price as p1, e2.price as p2, e4.price as p4
+  insert into Matches;
+end;
+"""
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core import event as ev
+from siddhi_tpu.core.keyslots import group_events_by_key
+
+manager = SiddhiManager()
+rt = manager.create_siddhi_app_runtime(QL)
+matches = [0]
+rt.add_batch_callback("flagship", lambda ts, b: matches.__setitem__(0, matches[0] + b["n_current"]))
+rt.start()
+h = rt.get_input_handler("TradeStream")
+blocks = N_KEYS // BATCH
+key_block = {b: np.repeat(np.arange(b * BATCH, (b + 1) * BATCH, dtype=np.int64), 4) for b in range(blocks)}
+vol4 = np.tile(np.array([1, 2, 3, 4], np.int32), BATCH)
+price4 = vol4.astype(np.float32)
+clock = [1000]
+def send(block):
+    clock[0] += 10
+    ts = clock[0] + np.tile(np.arange(4, dtype=np.int64), BATCH)
+    h.send_columns([key_block[block], price4, vol4], timestamps=ts)
+for b in range(blocks):
+    send(b)       # warm all keys + compile
+rt.flush()
+print("warm done", file=sys.stderr)
+
+# instrument pieces
+qr = rt.query_runtimes["flagship"]
+p = qr.planned
+pos = p.partition_positions["TradeStream"]
+block = 3
+n = 4 * BATCH
+cap = ev.bucket_size(n)
+schema = rt.junctions["TradeStream"].schema
+cols = [key_block[block], price4, vol4]
+for it in range(3):
+    t0 = time.perf_counter()
+    ts = np.zeros((cap,), np.int64); ts[:n] = clock[0]
+    valid = np.zeros((cap,), np.bool_); valid[:n] = True
+    padded = []
+    for c, t in zip(cols, schema.types):
+        a = np.zeros((cap,), ev.np_dtype(t)); a[:n] = c
+        padded.append(a)
+    t1 = time.perf_counter()
+    slots = qr.slot_allocator.slots_for([padded[i] for i in pos], valid)
+    t2 = time.perf_counter()
+    key_idx_np, sel, _ = group_events_by_key(slots, valid, pad=p.key_capacity)
+    t3 = time.perf_counter()
+    raw_cols = tuple(jax.numpy.asarray(c) for c in padded)
+    raw_ts = jax.numpy.asarray(ts)
+    sel_d = jax.numpy.asarray(sel)
+    t4 = time.perf_counter()
+    pstate, sel_state = qr.state
+    out = p.dense_steps["TradeStream"](
+        pstate, sel_state, raw_cols, raw_ts, sel_d,
+        jax.numpy.asarray(int(key_idx_np[0]), jax.numpy.int32),
+        jax.numpy.asarray(clock[0], jax.numpy.int64))
+    t5 = time.perf_counter()
+    qr.state = (out[0], out[1])
+    jax.block_until_ready(out[0])
+    t6 = time.perf_counter()
+    print(f"pad={1000*(t1-t0):.1f} slots={1000*(t2-t1):.1f} group={1000*(t3-t2):.1f} "
+          f"h2d-dispatch={1000*(t4-t3):.1f} step-dispatch={1000*(t5-t4):.1f} "
+          f"block={1000*(t6-t5):.1f} total={1000*(t6-t0):.1f}ms", file=sys.stderr)
+
+# end-to-end send timing, steady state
+lat = []
+for sweep in range(2):
+    for b in range(blocks):
+        ta = time.perf_counter()
+        send(b)
+        lat.append(time.perf_counter() - ta)
+rt.flush()
+lat = np.array(sorted(lat)) * 1000
+print(f"send p50={lat[len(lat)//2]:.1f}ms p90={lat[int(len(lat)*0.9)]:.1f}ms max={lat[-1]:.1f}ms", file=sys.stderr)
+manager.shutdown()

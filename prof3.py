@@ -1,0 +1,46 @@
+import time, sys, threading
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+# 1) copy_to_host_async: does a later read become cheap?
+f = jax.jit(lambda x, s: (x.sum() + s, (x[:65536] * 2).astype(jnp.int32)))
+x = jnp.array(np.random.rand(1 << 20).astype(np.float32))
+jax.block_until_ready(x)
+o = f(x, 1.0); jax.block_until_ready(o); jax.device_get(o)
+for trial in range(3):
+    o = f(x, float(trial + 2))
+    jax.block_until_ready(o)
+    for a in o:
+        a.copy_to_host_async()
+    time.sleep(0.2)   # let the async copy complete
+    t0 = time.perf_counter()
+    v = jax.device_get(o)
+    print(f"device_get after copy_to_host_async+sleep: {(time.perf_counter()-t0)*1000:.1f}ms")
+for trial in range(3):
+    o = f(x, float(trial + 10))
+    jax.block_until_ready(o)
+    t0 = time.perf_counter()
+    v = jax.device_get(o)
+    print(f"device_get cold: {(time.perf_counter()-t0)*1000:.1f}ms")
+
+# 2) does a D2H fetch from another thread slow concurrent H2D?
+a = np.random.rand(4 * 1024 * 1024 // 4).astype(np.float32)  # 4MB
+d = jax.device_put(a); jax.block_until_ready(d)
+t0 = time.perf_counter()
+for _ in range(10):
+    d = jax.device_put(a); jax.block_until_ready(d)
+print(f"H2D 4MB alone: {(time.perf_counter()-t0)/10*1000:.1f}ms")
+stop = [False]
+def fetcher():
+    i = 0
+    while not stop[0]:
+        o = f(x, float(100 + i)); i += 1
+        jax.device_get(o)
+th = threading.Thread(target=fetcher); th.start()
+time.sleep(0.1)
+t0 = time.perf_counter()
+for _ in range(10):
+    d = jax.device_put(a); jax.block_until_ready(d)
+print(f"H2D 4MB with concurrent fetch loop: {(time.perf_counter()-t0)/10*1000:.1f}ms")
+stop[0] = True; th.join()

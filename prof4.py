@@ -1,0 +1,34 @@
+import time
+import numpy as np
+import jax
+
+# dispatch-only cost of jnp.asarray on FRESH numpy buffers (no block)
+for mb, n in ((1, 5), (4, 5), (8, 5), (15, 5)):
+    arrs = [np.random.randint(0, 1 << 40, mb * 131072, np.int64) for _ in range(n)]
+    outs = []
+    t0 = time.perf_counter()
+    for a in arrs:
+        outs.append(jax.numpy.asarray(a))
+    t1 = time.perf_counter()
+    jax.block_until_ready(outs)
+    t2 = time.perf_counter()
+    print(f"{mb}MB x{n}: dispatch {(t1-t0)/n*1000:.1f}ms/call, "
+          f"drain {(t2-t1)*1000:.1f}ms total -> "
+          f"{mb*n/(t2-t0):.0f} MB/s effective")
+
+# one big vs many small, same total bytes (fresh every time)
+total_mb = 15
+big = [np.random.randint(0, 255, total_mb << 20, np.uint8) for _ in range(3)]
+t0 = time.perf_counter()
+outs = [jax.numpy.asarray(b) for b in big]
+jax.block_until_ready(outs)
+dt = (time.perf_counter() - t0) / 3
+print(f"one {total_mb}MB buffer: {dt*1000:.1f}ms -> {total_mb/dt:.0f} MB/s")
+smalls = [[np.random.randint(0, 255, (total_mb << 20) // 6, np.uint8)
+           for _ in range(6)] for _ in range(3)]
+t0 = time.perf_counter()
+for group in smalls:
+    outs = [jax.numpy.asarray(s) for s in group]
+jax.block_until_ready(outs)
+dt = (time.perf_counter() - t0) / 3
+print(f"six {total_mb//6}MB buffers: {dt*1000:.1f}ms -> {total_mb/dt:.0f} MB/s")

@@ -1,0 +1,56 @@
+"""Send-path cost with ZERO consumers (no callback, no drainer traffic)."""
+import time, sys
+import numpy as np
+
+N_KEYS = 1 << 20
+BATCH = 1 << 17
+QL = f"""
+@app:playback
+@async
+define stream TradeStream (key long, price float, volume int);
+partition with (key of TradeStream)
+begin
+  @capacity(keys='{N_KEYS}', slots='4')
+  @emit(rows='2')
+  @info(name='flagship')
+  from every e1=TradeStream[volume == 1]
+       -> e2=TradeStream[volume == 2 and price >= e1.price]
+       -> e3=TradeStream[volume == 3]
+       -> e4=TradeStream[volume == 4 and price >= e3.price]
+  select e1.key as k, e1.price as p1, e2.price as p2, e4.price as p4
+  insert into Matches;
+end;
+"""
+from siddhi_tpu import SiddhiManager
+manager = SiddhiManager()
+rt = manager.create_siddhi_app_runtime(QL)
+rt.start()
+h = rt.get_input_handler("TradeStream")
+blocks = N_KEYS // BATCH
+key_block = {b: np.repeat(np.arange(b * BATCH, (b + 1) * BATCH, dtype=np.int64), 4) for b in range(blocks)}
+vol4 = np.tile(np.array([1, 2, 3, 4], np.int32), BATCH)
+price4 = vol4.astype(np.float32)
+clock = [1000]
+def send(block):
+    clock[0] += 10
+    ts = clock[0] + np.tile(np.arange(4, dtype=np.int64), BATCH)
+    h.send_columns([key_block[block], price4, vol4], timestamps=ts)
+for b in range(blocks):
+    send(b)
+rt.flush()
+lat = []
+t0 = time.perf_counter()
+for sweep in range(3):
+    for b in range(blocks):
+        ta = time.perf_counter()
+        send(b)
+        lat.append(time.perf_counter() - ta)
+import jax
+qr = rt.query_runtimes["flagship"]
+jax.block_until_ready(qr.state)
+dt = time.perf_counter() - t0
+lat = np.array(sorted(lat)) * 1000
+n = 3 * blocks * 4 * BATCH
+print(f"no-consumer: {n/dt:,.0f} ev/s; send p50={lat[len(lat)//2]:.1f} "
+      f"p90={lat[int(len(lat)*0.9)]:.1f} max={lat[-1]:.1f}ms", file=sys.stderr)
+manager.shutdown()

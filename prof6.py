@@ -1,0 +1,92 @@
+"""Instrument PatternQueryRuntime.process_staged statement timings in a
+sustained run (steady-state averages)."""
+import time, sys
+import numpy as np
+import jax
+
+import siddhi_tpu.core.runtime as R
+from siddhi_tpu.core import event as ev
+from siddhi_tpu.core.keyslots import group_events_by_key
+
+acc = {}
+def t(name, dt):
+    acc.setdefault(name, []).append(dt)
+
+orig = R.PatternQueryRuntime.process_staged
+def patched(self, stream_id, staged, now):
+    p = self.planned
+    B = staged.ts.shape[0]
+    t0 = time.perf_counter()
+    raw_cols = tuple(jax.numpy.asarray(c) for c in staged.cols)
+    raw_ts = jax.numpy.asarray(staged.ts)
+    t1 = time.perf_counter(); t("h2d_raw", t1 - t0)
+    pos = p.partition_positions[stream_id]
+    slots = self.slot_allocator.slots_for([staged.cols[i] for i in pos], staged.valid)
+    t2 = time.perf_counter(); t("slots", t2 - t1)
+    key_idx_np, sel, _ = group_events_by_key(slots, staged.valid, pad=p.key_capacity)
+    t3 = time.perf_counter(); t("group", t3 - t2)
+    sel_d = jax.numpy.asarray(sel)
+    t4 = time.perf_counter(); t("h2d_sel", t4 - t3)
+    nuniq = int((key_idx_np < p.key_capacity).sum())
+    Kb = key_idx_np.shape[0]
+    pstate, sel_state = self.state
+    pstate, sel_state, out, wake = p.dense_steps[stream_id](
+        pstate, sel_state, raw_cols, raw_ts, sel_d,
+        jax.numpy.asarray(int(key_idx_np[0]), jax.numpy.int32),
+        jax.numpy.asarray(now, jax.numpy.int64))
+    t5 = time.perf_counter(); t("step_dispatch", t5 - t4)
+    self.state = (pstate, sel_state)
+    R._emit_output(self, out, now, wake=None)
+    t6 = time.perf_counter(); t("emit", t6 - t5)
+R.PatternQueryRuntime.process_staged = patched
+
+N_KEYS = 1 << 20
+BATCH = 1 << 17
+QL = f"""
+@app:playback
+@async
+define stream TradeStream (key long, price float, volume int);
+partition with (key of TradeStream)
+begin
+  @capacity(keys='{N_KEYS}', slots='4')
+  @emit(rows='2')
+  @info(name='flagship')
+  from every e1=TradeStream[volume == 1]
+       -> e2=TradeStream[volume == 2 and price >= e1.price]
+       -> e3=TradeStream[volume == 3]
+       -> e4=TradeStream[volume == 4 and price >= e3.price]
+  select e1.key as k, e1.price as p1, e2.price as p2, e4.price as p4
+  insert into Matches;
+end;
+"""
+from siddhi_tpu import SiddhiManager
+manager = SiddhiManager()
+rt = manager.create_siddhi_app_runtime(QL)
+matches = [0]
+rt.add_batch_callback("flagship", lambda ts, b: matches.__setitem__(0, matches[0] + b["n_current"]))
+rt.start()
+h = rt.get_input_handler("TradeStream")
+blocks = N_KEYS // BATCH
+key_block = {b: np.repeat(np.arange(b * BATCH, (b + 1) * BATCH, dtype=np.int64), 4) for b in range(blocks)}
+vol4 = np.tile(np.array([1, 2, 3, 4], np.int32), BATCH)
+price4 = vol4.astype(np.float32)
+clock = [1000]
+def send(block):
+    clock[0] += 10
+    ts = clock[0] + np.tile(np.arange(4, dtype=np.int64), BATCH)
+    h.send_columns([key_block[block], price4, vol4], timestamps=ts)
+for b in range(blocks):
+    send(b)
+rt.flush()
+acc.clear()
+t0 = time.perf_counter()
+for sweep in range(3):
+    for b in range(blocks):
+        send(b)
+rt.flush()
+dt = time.perf_counter() - t0
+for k, v in acc.items():
+    a = np.array(v) * 1000
+    print(f"{k:14s} mean={a.mean():6.1f} p50={np.median(a):6.1f} max={a.max():7.1f}ms", file=sys.stderr)
+print(f"rate: {3*blocks*4*BATCH/dt:,.0f} ev/s", file=sys.stderr)
+manager.shutdown()

@@ -12,8 +12,9 @@ and partitioning is an axis — no hash probing on the critical path on device.
 
 Two backends share identical semantics and snapshot format:
 - native (default): `native/staging.c` does the fused hash+probe+insert and
-  the counting-sort grouping in one C pass over numpy-owned buffers
-  (~75ms -> ~5ms per 524k-event batch on the 1-core driver host);
+  the counting-sort grouping in C passes over numpy-owned buffers with an
+  interleaved cell table (~75ms -> ~25ms per 524k-event batch on the 1-core
+  driver host; `slots_and_group` fuses the count pass into the probe);
 - numpy fallback when no C toolchain exists.
 
 Slots are recycled through a free list on purge (reference: @purge idle-key
@@ -86,8 +87,8 @@ class _JournalView:
 
 
 class SlotAllocator:
-    """Native-backed key->slot allocator.  All state lives in numpy buffers
-    shared with C; snapshots read them directly."""
+    """Key->slot allocator over numpy buffers shared with the C kernels;
+    snapshots read the buffers directly."""
 
     def __init__(self, capacity: int, name: str = "?"):
         self.capacity = capacity
@@ -95,9 +96,9 @@ class SlotAllocator:
         self._lock = threading.Lock()
         self._cap2 = 1 << max(10, int(2 * capacity - 1).bit_length())
         self._mask = np.uint64(self._cap2 - 1)
-        self._th = np.zeros(self._cap2, np.uint64)    # 0 empty, 1 tombstone
-        self._th2 = np.zeros(self._cap2, np.uint64)
-        self._tslot = np.full(self._cap2, -1, np.int32)
+        # interleaved probe cells [cap2, 3] = (h1, h2, slot): one cache line
+        # per probe instead of three; h1 0=empty, 1=tombstone
+        self._cells = np.zeros((self._cap2, 3), np.uint64)
         self._cell_by_slot = np.full(capacity, -1, np.int64)
         self._used = np.zeros(capacity, np.uint8)
         self._free = np.arange(capacity - 1, -1, -1, dtype=np.int32)
@@ -108,6 +109,9 @@ class SlotAllocator:
         self._meta = np.array([0, capacity, 0, 0, 0, jcap], np.int64)
         self._w8 = 0                    # key width in u64 words (fixed)
         self._arena = None              # [capacity, w8*8] u8
+        # L2-resident direct-mapped probe cache (h1, h2, slot); cleared on
+        # any unbinding mutation (purge/rebuild/restore)
+        self._pcache = np.zeros((1 << 14, 3), np.uint64)
         self.journal = _JournalView(self)
 
     def __len__(self):
@@ -128,14 +132,34 @@ class SlotAllocator:
         """Vectorized lookup/insert: key_cols are 1-D arrays of equal length.
         Returns int32 slot ids (-1 for invalid rows; with lookup_only also
         -1 for unknown keys, and nothing is allocated)."""
+        out, _ = self._slots(key_cols, valid, lookup_only, group=False,
+                             pad=0)
+        return out
+
+    def slots_and_group(self, key_cols: Sequence[np.ndarray],
+                        valid: Optional[np.ndarray], pad: int):
+        """Fused resolve + group: one C pass probes/inserts AND accumulates
+        per-slot counts, then the fill pass emits the [Kb, E] device layout.
+        Returns (slots, key_idx, sel)."""
+        if LIB is None:
+            slots = self.slots_for(key_cols, valid)
+            v = np.ones(slots.shape[0], bool) if valid is None else valid
+            key_idx, sel, _ = group_events_by_key(slots, v, pad=pad)
+            return slots, key_idx, sel
+        out, grouped = self._slots(key_cols, valid, False, group=True,
+                                   pad=pad)
+        return out, grouped[0], grouped[1]
+
+    def _slots(self, key_cols, valid, lookup_only, group: bool, pad: int):
         n = len(key_cols[0])
         if n == 0:
-            return np.empty((0,), np.int32)
+            return np.empty((0,), np.int32), None
         words = _key_words(key_cols)
         self._ensure_arena(words.shape[1])
         live = None if valid is None else \
             np.ascontiguousarray(valid, np.uint8)
         out = np.empty(n, np.int32)
+        grouped = None
         with self._lock:
             # purge churn turns EMPTY cells into tombstones; once EMPTY runs
             # out, probes for new keys could never terminate.  Rebuild
@@ -143,29 +167,49 @@ class SlotAllocator:
             if (self._meta[0] + self._meta[2]) * 4 > self._cap2 * 3:
                 self._rebuild_table()
             if LIB is not None:
-                rc = LIB.sg_slots_for(
-                    ptr(words, ctypes.c_uint64), n, self._w8,
-                    None if live is None else ptr(live, ctypes.c_uint8),
-                    ptr(self._th, ctypes.c_uint64),
-                    ptr(self._th2, ctypes.c_uint64),
-                    ptr(self._tslot, ctypes.c_int32), self._cap2,
-                    ptr(self._cell_by_slot, ctypes.c_int64),
-                    ptr(self._arena, ctypes.c_uint8),
-                    ptr(self._free, ctypes.c_int32),
-                    ptr(self._journal, ctypes.c_int32),
-                    ptr(self._used, ctypes.c_uint8),
-                    ptr(self._meta, ctypes.c_int64),
-                    1 if lookup_only else 0,
-                    ptr(out, ctypes.c_int32))
-                if rc < 0:
-                    raise RuntimeError(
-                        f"slot capacity {self.capacity} exhausted for "
-                        f"{self.name!r}; raise via @capacity annotation")
+                if group:
+                    _group_scratch_lock.acquire()
+                    cnt, rank, touched = _scratch(self.capacity)
+                    gmeta = np.zeros(2, np.int64)
+                    gargs = (ptr(cnt, ctypes.c_int32),
+                             ptr(touched, ctypes.c_int32),
+                             ptr(gmeta, ctypes.c_int64))
+                else:
+                    gargs = (None, None, None)
+                try:
+                    rc = LIB.sg_slots_for(
+                        ptr(words, ctypes.c_uint64), n, self._w8,
+                        None if live is None else ptr(live, ctypes.c_uint8),
+                        ptr(self._cells, ctypes.c_uint64), self._cap2,
+                        ptr(self._cell_by_slot, ctypes.c_int64),
+                        ptr(self._arena, ctypes.c_uint8),
+                        ptr(self._free, ctypes.c_int32),
+                        ptr(self._journal, ctypes.c_int32),
+                        ptr(self._used, ctypes.c_uint8),
+                        ptr(self._meta, ctypes.c_int64),
+                        1 if lookup_only else 0,
+                        ptr(out, ctypes.c_int32), *gargs,
+                        ptr(self._pcache, ctypes.c_uint64),
+                        self._pcache.shape[0] - 1)
+                    if rc < 0:
+                        if group:
+                            # re-zero count scratch the aborted pass touched
+                            cnt[:] = 0
+                        raise RuntimeError(
+                            f"slot capacity {self.capacity} exhausted for "
+                            f"{self.name!r}; raise via @capacity annotation")
+                    if group:
+                        grouped = _fill_groups(out, live, n, cnt, rank,
+                                               touched, int(gmeta[0]),
+                                               int(gmeta[1]), pad)
+                finally:
+                    if group:
+                        _group_scratch_lock.release()
             else:
                 self._py_slots_for(words, live, lookup_only, out)
         if live is not None:
             out[live == 0] = -1
-        return out
+        return out, grouped
 
     # -- numpy fallback ------------------------------------------------------
     def _py_slots_for(self, words, live, lookup_only, out) -> None:
@@ -187,13 +231,7 @@ class SlotAllocator:
                         f"{self.name!r}; raise via @capacity annotation")
                 self._meta[1] -= 1
                 slot = int(self._free[self._meta[1]])
-                j = int(h1[r]) & (self._cap2 - 1)
-                while self._th[j] > _TOMB:
-                    j = (j + 1) & (self._cap2 - 1)
-                self._th[j] = np.uint64(h1[r])
-                self._th2[j] = np.uint64(h2[r])
-                self._tslot[j] = slot
-                self._cell_by_slot[slot] = j
+                self._cell_insert(int(h1[r]), int(h2[r]), slot)
                 self._arena[slot] = words[r].view(np.uint8)
                 self._used[slot] = 1
                 self._meta[0] += 1
@@ -207,12 +245,21 @@ class SlotAllocator:
             slots[new] = -1
         out[:] = slots
 
+    def _cell_insert(self, h1: int, h2: int, slot: int) -> None:
+        j = h1 & (self._cap2 - 1)
+        while self._cells[j, 0] > _TOMB:
+            j = (j + 1) & (self._cap2 - 1)
+        self._cells[j, 0] = np.uint64(h1)
+        self._cells[j, 1] = np.uint64(h2)
+        self._cells[j, 2] = np.uint64(np.uint32(slot))
+        self._cell_by_slot[slot] = j
+
     def _py_probe_one(self, h1: int, h2: int) -> int:
         j = h1 & (self._cap2 - 1)
         while True:
-            c = int(self._th[j])
-            if c == int(h1) and int(self._th2[j]) == int(h2):
-                return int(self._tslot[j])
+            c = int(self._cells[j, 0])
+            if c == int(h1) and int(self._cells[j, 1]) == int(h2):
+                return int(np.int32(np.uint32(self._cells[j, 2])))
             if c == 0:
                 return -1
             j = (j + 1) & (self._cap2 - 1)
@@ -228,7 +275,8 @@ class SlotAllocator:
             if uidx.size == 0:
                 break
             ui = idx[uidx]
-            ch, ch2, cs = self._th[ui], self._th2[ui], self._tslot[ui]
+            ch, ch2 = self._cells[ui, 0], self._cells[ui, 1]
+            cs = self._cells[ui, 2].astype(np.uint32).astype(np.int32)
             hit = (ch == h1[uidx]) & (ch2 == h2[uidx]) & (ch > _TOMB)
             empty = ch == _EMPTY
             out[uidx[hit]] = cs[hit]
@@ -239,41 +287,31 @@ class SlotAllocator:
         return out, new
 
     def _rebuild_table(self) -> None:
+        self._pcache[:] = 0
         self._meta[2] = 0
         if self._arena is None:
-            self._th[:] = _EMPTY
-            self._th2[:] = _EMPTY
-            self._tslot[:] = -1
+            self._cells[:] = 0
             self._cell_by_slot[:] = -1
             return
         if LIB is not None:
             LIB.sg_rebuild(
-                ptr(self._th, ctypes.c_uint64),
-                ptr(self._th2, ctypes.c_uint64),
-                ptr(self._tslot, ctypes.c_int32), self._cap2,
+                ptr(self._cells, ctypes.c_uint64), self._cap2,
                 ptr(self._cell_by_slot, ctypes.c_int64),
                 ptr(self._arena, ctypes.c_uint8), self._w8,
                 ptr(self._used, ctypes.c_uint8), self.capacity)
             return
-        self._th[:] = _EMPTY
-        self._th2[:] = _EMPTY
-        self._tslot[:] = -1
+        self._cells[:] = 0
         self._cell_by_slot[:] = -1
         for s in np.nonzero(self._used)[0].tolist():
             w = self._arena[s].view(np.uint64)[None, :]
             h1 = max(int(_hash_words(w, 0)[0]), 2)
             h2 = int(_hash_words(w, 0xABCD)[0])
-            j = h1 & (self._cap2 - 1)
-            while self._th[j] > _TOMB:
-                j = (j + 1) & (self._cap2 - 1)
-            self._th[j] = np.uint64(h1)
-            self._th2[j] = np.uint64(h2)
-            self._tslot[j] = s
-            self._cell_by_slot[s] = j
+            self._cell_insert(h1, h2, int(s))
 
     # -- lifecycle ------------------------------------------------------------
     def purge(self, slots: Sequence[int]) -> None:
         with self._lock:
+            self._pcache[:] = 0
             for s in slots:
                 s = int(s)
                 if s < 0 or s >= self.capacity or not self._used[s]:
@@ -284,9 +322,9 @@ class SlotAllocator:
                 self._meta[0] -= 1
                 cell = int(self._cell_by_slot[s])
                 if cell >= 0:
-                    self._th[cell] = _TOMB
-                    self._th2[cell] = _EMPTY
-                    self._tslot[cell] = -1
+                    self._cells[cell, 0] = _TOMB
+                    self._cells[cell, 1] = _EMPTY
+                    self._cells[cell, 2] = np.uint64(0xFFFFFFFF)
                     self._cell_by_slot[s] = -1
                     self._meta[2] += 1
 
@@ -327,11 +365,12 @@ class SlotAllocator:
             self._meta[1] = free.shape[0]
 
     def _unbind(self, slot: int) -> None:
+        self._pcache[:] = 0
         cell = int(self._cell_by_slot[slot])
         if cell >= 0:
-            self._th[cell] = _TOMB
-            self._th2[cell] = _EMPTY
-            self._tslot[cell] = -1
+            self._cells[cell, 0] = _TOMB
+            self._cells[cell, 1] = _EMPTY
+            self._cells[cell, 2] = np.uint64(0xFFFFFFFF)
             self._cell_by_slot[slot] = -1
             self._meta[2] += 1
         self._used[slot] = 0
@@ -353,15 +392,11 @@ class SlotAllocator:
         prev = self._py_probe_one(h1, h2)
         if prev >= 0:
             if prev == slot:
+                self._arena[slot] = np.frombuffer(key, np.uint8)
+                self._used[slot] = 1
                 return
             self._unbind(prev)        # key moved to a different slot
-        j = h1 & (self._cap2 - 1)
-        while self._th[j] > _TOMB:
-            j = (j + 1) & (self._cap2 - 1)
-        self._th[j] = np.uint64(h1)
-        self._th2[j] = np.uint64(h2)
-        self._tslot[j] = slot
-        self._cell_by_slot[slot] = j
+        self._cell_insert(h1, h2, slot)
         self._arena[slot] = np.frombuffer(key, np.uint8)
         self._used[slot] = 1
         self._meta[0] += 1
@@ -370,9 +405,7 @@ class SlotAllocator:
         with self._lock:
             self._used[:] = 0
             self._cell_by_slot[:] = -1
-            self._th[:] = _EMPTY
-            self._th2[:] = _EMPTY
-            self._tslot[:] = -1
+            self._cells[:] = 0
             self._meta[0] = 0
             self._meta[2] = 0
             self._meta[3] = 0
@@ -392,9 +425,10 @@ class SlotAllocator:
             self._rebuild_table()
 
 
-# scratch buffers for grouping, keyed by minimum capacity
+# scratch buffers for grouping, keyed by minimum capacity; RLock because
+# group_events_by_key holds it across _scratch()+fill
 _group_scratch: Dict[int, Tuple[np.ndarray, np.ndarray, np.ndarray]] = {}
-_group_scratch_lock = threading.Lock()
+_group_scratch_lock = threading.RLock()
 
 
 def _scratch(capacity: int):
@@ -407,6 +441,26 @@ def _scratch(capacity: int):
                 np.zeros(cap, np.int32))
         _group_scratch[cap] = bufs
         return bufs
+
+
+def _fill_groups(slots, live, n, cnt, rank, touched, nu, maxc, pad):
+    """Shared fill phase: bucket Kb/E, run sg_group_fill.  cnt holds counts
+    from the count pass and is re-zeroed by the C fill."""
+    if nu == 0:
+        key_idx = np.full((1,), pad, np.int32)
+        sel = np.full((1, 1), -1, np.int32)
+        return key_idx, sel
+    E = _bucket(maxc, _E_BUCKETS)
+    Kb = _bucket(nu, _KB_BUCKETS)
+    key_idx = np.empty(Kb, np.int32)
+    sel = np.empty((Kb, E), np.int32)
+    LIB.sg_group_fill(
+        ptr(slots, ctypes.c_int32),
+        None if live is None else ptr(live, ctypes.c_uint8), n,
+        ptr(cnt, ctypes.c_int32), ptr(rank, ctypes.c_int32),
+        ptr(touched, ctypes.c_int32), nu, Kb, E, pad,
+        ptr(key_idx, ctypes.c_int32), ptr(sel, ctypes.c_int32))
+    return key_idx, sel
 
 
 def group_events_by_key(slots: np.ndarray, valid: np.ndarray,
@@ -426,26 +480,18 @@ def group_events_by_key(slots: np.ndarray, valid: np.ndarray,
         n = slots.shape[0]
         slots = np.ascontiguousarray(slots, np.int32)
         live = np.ascontiguousarray(valid, np.uint8)
-        cnt, rank, touched = _scratch(max(pad, int(slots.max(initial=0)) + 1))
-        maxc = np.zeros(1, np.int64)
         with _group_scratch_lock:
+            cnt, rank, touched = _scratch(
+                max(pad, int(slots.max(initial=0)) + 1))
+            maxc = np.zeros(1, np.int64)
             nu = LIB.sg_group_count(
                 ptr(slots, ctypes.c_int32), ptr(live, ctypes.c_uint8), n,
                 ptr(cnt, ctypes.c_int32), ptr(touched, ctypes.c_int32),
                 ptr(maxc, ctypes.c_int64))
-            if nu == 0:
-                key_idx = np.full((1,), pad, np.int32)
-                sel = np.full((1, 1), -1, np.int32)
-                return key_idx, sel, np.zeros((1, 1), np.bool_)
-            E = _bucket(int(maxc[0]), _E_BUCKETS)
-            Kb = _bucket(int(nu), _KB_BUCKETS)
-            key_idx = np.empty(Kb, np.int32)
-            sel = np.empty((Kb, E), np.int32)
-            LIB.sg_group_fill(
-                ptr(slots, ctypes.c_int32), ptr(live, ctypes.c_uint8), n,
-                ptr(cnt, ctypes.c_int32), ptr(rank, ctypes.c_int32),
-                ptr(touched, ctypes.c_int32), nu, Kb, E, pad,
-                ptr(key_idx, ctypes.c_int32), ptr(sel, ctypes.c_int32))
+            key_idx, sel = _fill_groups(slots, live, n, cnt, rank, touched,
+                                        int(nu), int(maxc[0]), pad)
+        if int(nu) == 0:
+            return key_idx, sel, np.zeros((1, 1), np.bool_)
         return key_idx, sel, sel >= 0
     vmask = valid & (slots >= 0)
     idx = np.nonzero(vmask)[0]

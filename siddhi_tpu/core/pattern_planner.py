@@ -187,8 +187,21 @@ def plan_pattern_query(
     packer = StatePacker(pexec.init_state(1))
 
     def make_step(stream_id: str, dense: bool = False):
-        def step(packed, sel_state, cols, ts, valid, ord_, key_ref, now):
+        schema = schemas[stream_id]
+
+        def step(packed, sel_state, raw_cols, raw_ts, sel_idx, key_ref, now):
+            # raw_cols/raw_ts are the UNGROUPED batch [B]; sel_idx [Kb,E]
+            # holds batch indices (-1 = padding).  The [Kb,E] gather happens
+            # here on device (~60us) so the host ships ~40% fewer bytes and
+            # never copies event payloads.
             b32, b64, scalars = packed
+            B = raw_ts.shape[0]
+            csel = jnp.clip(sel_idx, 0, B - 1)
+            cols = tuple(c[csel].astype(d)
+                         for c, d in zip(raw_cols, schema.dtypes))
+            ts = raw_ts[csel]
+            valid = sel_idx >= 0
+            ord_ = csel.astype(jnp.int64)
             Kb = ts.shape[0]
             if dense:
                 # key_ref is a scalar key_lo: the batch's slots are the
@@ -346,16 +359,20 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
     pspec = (P(None, "shard"), P(None, "shard"),
              tuple(P() for _ in ex_packed[2]))
     sspec = jax.tree.map(leaf_spec, ex_s)
-    bspec = P("shard")    # batched inputs: [n*Kb, ...] on axis 0
+    bspec = P("shard")    # sharded inputs: [n*Kb, ...] on axis 0
+    rspec = P()           # raw event columns [B]: replicated to all shards
 
-    def local(packed, sel_state, cols, ts, valid, ord_, key_idx, now):
+    def local(packed, sel_state, raw_cols, raw_ts, sel, key_idx, now):
         b32, b64, scalars = packed
         old_scalars = scalars
         # replicated scalar counters become device-varying inside; mark them
         scalars = tuple(lax.pcast(s, ("shard",), to="varying")
                         for s in scalars)
-        ps, ss, out, wake = body((b32, b64, scalars), sel_state, cols, ts,
-                                 valid, ord_, key_idx, now)
+        raw_cols = tuple(lax.pcast(c, ("shard",), to="varying")
+                         for c in raw_cols)
+        raw_ts = lax.pcast(raw_ts, ("shard",), to="varying")
+        ps, ss, out, wake = body((b32, b64, scalars), sel_state, raw_cols,
+                                 raw_ts, sel, key_idx, now)
         out = (lax.psum(out[0], "shard"), lax.psum(out[1], "shard")) + out[2:]
         nb32, nb64, nscal = ps
         # re-replicate scalar counters: old + psum(local delta)
@@ -368,7 +385,7 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
 
     sharded = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(pspec, sspec, bspec, bspec, bspec, bspec, bspec, P()),
+        in_specs=(pspec, sspec, rspec, rspec, bspec, bspec, P()),
         out_specs=(pspec, sspec, (P(), P(), bspec, bspec, bspec, bspec), P()))
     return jax.jit(sharded, donate_argnums=(0, 1))
 

@@ -51,11 +51,12 @@ def _bind(lib):
     lib.sg_slots_for.restype = c.c_int64
     lib.sg_slots_for.argtypes = [
         u64p, c.c_int64, c.c_int64, u8p,
-        u64p, u64p, i32p, c.c_int64,
-        i64p, u8p, i32p, i32p, u8p, i64p, c.c_int32, i32p]
+        u64p, c.c_int64,
+        i64p, u8p, i32p, i32p, u8p, i64p, c.c_int32, i32p,
+        i32p, i32p, i64p, u64p, c.c_int64]
     lib.sg_rebuild.restype = None
     lib.sg_rebuild.argtypes = [
-        u64p, u64p, i32p, c.c_int64, i64p, u8p, c.c_int64, u8p, c.c_int64]
+        u64p, c.c_int64, i64p, u8p, c.c_int64, u8p, c.c_int64]
     lib.sg_group_count.restype = c.c_int64
     lib.sg_group_count.argtypes = [i32p, u8p, c.c_int64, i32p, i32p, i64p]
     lib.sg_group_fill.restype = c.c_int32

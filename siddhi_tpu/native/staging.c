@@ -10,14 +10,14 @@
  * device's dense [K, E] key layout faster than the chip consumes it.  numpy
  * needed ~75ms per 524k-event batch (hash temporaries + argsort); this C
  * path is a fused single pass: FNV-style 128-bit key hashing, open-address
- * probe/insert into a table shared with Python (the arrays are numpy-owned,
- * so snapshots pickle them directly), and counting-sort grouping that emits
- * the gather indices the device step uses.  The column gather itself happens
- * ON DEVICE (a [K,E] gather is ~60us on TPU), so the host never copies event
- * payloads at all.
+ * probe/insert into an INTERLEAVED cell table (h1,h2,slot in one 24-byte
+ * cell, so a probe costs one cache line, not three), and counting-sort
+ * grouping whose count pass is fused into the probe loop.  The column
+ * gather itself happens ON DEVICE (a [K,E] gather is ~60us on TPU), so the
+ * host never copies event payloads at all.
  *
  * Single-threaded by design: the driver host has one core; the win is
- * constant-factor (no temporaries, one pass), not parallelism.
+ * constant-factor (cache lines, fused passes), not parallelism.
  */
 #include <stdint.h>
 #include <string.h>
@@ -28,6 +28,11 @@
 #define MIX 0x9E3779B97F4A7C15ULL
 #define EMPTY 0ULL
 #define TOMB 1ULL
+
+/* cells: [cap2][3] u64 = {h1, h2, slot}; h1 0=empty 1=tombstone. */
+#define C_H1(c, i) ((c)[(i) * 3])
+#define C_H2(c, i) ((c)[(i) * 3 + 1])
+#define C_SLOT(c, i) ((int32_t)(c)[(i) * 3 + 2])
 
 /* Must match keyslots._hash_words exactly (snapshot compatibility: Python
  * rebuild/restore re-hashes with its own implementation). */
@@ -45,40 +50,71 @@ static inline uint64_t hash_words(const uint64_t *w, int64_t w8,
 /* meta: [0]=count [1]=free_top [2]=tombstones [3]=journal_len
  *       [4]=journal_overflow [5]=journal_cap
  * free_stack[free_top-1] is the next slot to pop.
+ *
+ * Optionally fuses the grouping count pass: when cnt/touched/group_meta are
+ * non-NULL, per-slot occurrence counts accumulate during the probe loop
+ * (group_meta: [0]=n_uniq out, [1]=max_count out).
+ *
  * Returns number of newly inserted keys, or -1 on capacity exhaustion. */
 int64_t sg_slots_for(const uint64_t *words, int64_t n, int64_t w8,
                      const uint8_t *live,
-                     uint64_t *th, uint64_t *th2, int32_t *tslot,
-                     int64_t cap2,
+                     uint64_t *cells, int64_t cap2,
                      int64_t *cell_by_slot, uint8_t *arena,
                      int32_t *free_stack, int32_t *journal, uint8_t *used,
                      int64_t *meta, int32_t lookup_only,
-                     int32_t *out_slots) {
+                     int32_t *out_slots,
+                     int32_t *cnt, int32_t *touched, int64_t *group_meta,
+                     uint64_t *pcache, int64_t pc_mask) {
     const uint64_t mask = (uint64_t)(cap2 - 1);
     const int64_t wb = w8 * 8;
     int64_t inserted = 0;
+    int64_t n_uniq = 0;
+    int32_t maxc = 0;
+    /* The cell table is far larger than L2, so nearly every probe is a
+     * cache miss; hash the lookahead key and prefetch its home cell a few
+     * iterations early to overlap the misses. */
+    enum { LOOKAHEAD = 12 };
     for (int64_t i = 0; i < n; i++) {
+        if (i + LOOKAHEAD < n && (!live || live[i + LOOKAHEAD])) {
+            uint64_t ph = hash_words(words + (i + LOOKAHEAD) * w8, w8, 0);
+            __builtin_prefetch(&cells[(ph & mask) * 3], 0, 1);
+        }
         if (live && !live[i]) { out_slots[i] = -1; continue; }
         const uint64_t *key = words + i * w8;
         uint64_t h1 = hash_words(key, w8, 0);
         if (h1 < 2) h1 = 2;
         uint64_t h2 = hash_words(key, w8, 0xABCD);
-        uint64_t idx = h1 & mask;
         int32_t slot = -1;
-        for (;;) {
-            uint64_t c = th[idx];
-            if (c == h1 && th2[idx] == h2) { slot = tslot[idx]; break; }
-            if (c == EMPTY) break;
-            idx = (idx + 1) & mask;
+        /* L2-resident direct-mapped cache in front of the big table:
+         * events of one key cluster within a batch, so most probes hit
+         * here instead of missing into the (HBM-sized) cell table.
+         * Invalidated wholesale by Python on purge/rebuild/restore. */
+        uint64_t pidx = (h1 & (uint64_t)pc_mask) * 3;
+        if (pcache[pidx] == h1 && pcache[pidx + 1] == h2) {
+            slot = (int32_t)pcache[pidx + 2];
+        } else {
+            uint64_t idx = h1 & mask;
+            for (;;) {
+                uint64_t c = C_H1(cells, idx);
+                if (c == h1 && C_H2(cells, idx) == h2) {
+                    slot = C_SLOT(cells, idx); break;
+                }
+                if (c == EMPTY) break;
+                idx = (idx + 1) & mask;
+            }
+            if (slot >= 0) {
+                pcache[pidx] = h1; pcache[pidx + 1] = h2;
+                pcache[pidx + 2] = (uint64_t)(uint32_t)slot;
+            }
         }
         if (slot < 0 && !lookup_only) {
             if (meta[1] <= 0) return -1;          /* capacity exhausted */
             slot = free_stack[--meta[1]];
-            /* insert at first EMPTY or TOMB cell (matches Python
-             * _table_insert: stops where th <= TOMB) */
+            /* insert at first EMPTY or TOMB cell */
             uint64_t j = h1 & mask;
-            while (th[j] > TOMB) j = (j + 1) & mask;
-            th[j] = h1; th2[j] = h2; tslot[j] = slot;
+            while (C_H1(cells, j) > TOMB) j = (j + 1) & mask;
+            C_H1(cells, j) = h1; C_H2(cells, j) = h2;
+            cells[j * 3 + 2] = (uint64_t)(uint32_t)slot;
             cell_by_slot[slot] = (int64_t)j;
             memcpy(arena + (int64_t)slot * wb, key, (size_t)wb);
             used[slot] = 1;
@@ -86,20 +122,26 @@ int64_t sg_slots_for(const uint64_t *words, int64_t n, int64_t w8,
             if (meta[3] < meta[5]) journal[meta[3]++] = slot;
             else meta[4] = 1;                     /* journal overflow */
             inserted++;
+            pcache[pidx] = h1; pcache[pidx + 1] = h2;
+            pcache[pidx + 2] = (uint64_t)(uint32_t)slot;
         }
         out_slots[i] = slot;
+        if (cnt && slot >= 0) {                   /* fused group count */
+            int32_t c2 = ++cnt[slot];
+            if (c2 == 1) touched[n_uniq++] = slot;
+            if (c2 > maxc) maxc = c2;
+        }
     }
+    if (group_meta) { group_meta[0] = n_uniq; group_meta[1] = maxc; }
     return inserted;
 }
 
 /* Rebuild the probe table from the arena (tombstone GC / restore). */
-void sg_rebuild(uint64_t *th, uint64_t *th2, int32_t *tslot, int64_t cap2,
+void sg_rebuild(uint64_t *cells, int64_t cap2,
                 int64_t *cell_by_slot, const uint8_t *arena, int64_t w8,
                 const uint8_t *used, int64_t capacity) {
     const uint64_t mask = (uint64_t)(cap2 - 1);
-    memset(th, 0, (size_t)cap2 * 8);
-    memset(th2, 0, (size_t)cap2 * 8);
-    memset(tslot, 0xFF, (size_t)cap2 * 4);
+    memset(cells, 0, (size_t)cap2 * 24);
     for (int64_t s = 0; s < capacity; s++) {
         cell_by_slot[s] = -1;
         if (!used[s]) continue;
@@ -108,16 +150,15 @@ void sg_rebuild(uint64_t *th, uint64_t *th2, int32_t *tslot, int64_t cap2,
         if (h1 < 2) h1 = 2;
         uint64_t h2 = hash_words(key, w8, 0xABCD);
         uint64_t j = h1 & mask;
-        while (th[j] > TOMB) j = (j + 1) & mask;
-        th[j] = h1; th2[j] = h2; tslot[j] = (int32_t)s;
+        while (C_H1(cells, j) > TOMB) j = (j + 1) & mask;
+        C_H1(cells, j) = h1; C_H2(cells, j) = h2;
+        cells[j * 3 + 2] = (uint64_t)(uint32_t)s;
         cell_by_slot[s] = (int64_t)j;
     }
 }
 
-/* Pass 1 of grouping: per-slot occurrence counts.
- * cnt must be zero for all slots on entry (group_fill re-zeroes touched
- * entries).  touched collects first-seen slots (unsorted).
- * Returns n_uniq; *max_count_out = largest per-slot count. */
+/* Standalone count pass (used when slots come from elsewhere, e.g. the
+ * sharded path regrouping by local slot). */
 int64_t sg_group_count(const int32_t *slots, const uint8_t *valid, int64_t n,
                        int32_t *cnt, int32_t *touched,
                        int64_t *max_count_out) {
@@ -152,7 +193,7 @@ static void radix_sort_u32(uint32_t *a, int64_t n, uint32_t *tmp) {
     }
 }
 
-/* Pass 2: sort unique slots ascending, emit key_idx [Kb] (pad beyond
+/* Fill pass: sort unique slots ascending, emit key_idx [Kb] (pad beyond
  * n_uniq), sel [Kb*E] (-1 = padding), re-zero cnt.  rank is a scratch
  * array >= capacity.  Returns 1 if slots are one contiguous ascending run
  * starting at key_idx[0] (dense fast path), else 0. */
