@@ -17,7 +17,7 @@ import time
 import numpy as np
 
 N_KEYS = 1 << 20          # 1M partition keys
-BATCH = 1 << 18           # 262144 keys per micro-batch (1M events/send)
+BATCH = 1 << 17           # 131072 keys per micro-batch (524288 events/send)
 SLOTS = 4
 SWEEPS = 4                # timed sweeps over all keys x 4 stages
 
@@ -55,7 +55,7 @@ def run_tpu():
     h = rt.get_input_handler("TradeStream")
 
     # one send carries all 4 stages per key, interleaved in arrival order
-    # (the device scans E=4 events per key sequentially); 524288 events/send
+    # (the device scans E=4 events per key sequentially); 4*BATCH events/send
     blocks = N_KEYS // BATCH
     key_block = {b: np.repeat(
         np.arange(b * BATCH, (b + 1) * BATCH, dtype=np.int64), 4)

@@ -52,7 +52,8 @@ _DTYPES = {
 
 NULL_ID = -1  # interned id representing null string
 
-_BUCKETS = (8, 32, 128, 512, 2048, 8192, 32768, 131072, 262144, 524288)
+_BUCKETS = (8, 32, 128, 512, 2048, 8192, 32768, 131072, 262144, 524288,
+            1048576, 2097152)
 
 
 def bucket_size(n: int) -> int:

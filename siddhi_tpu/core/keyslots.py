@@ -121,9 +121,15 @@ class SlotAllocator:
         if self._arena is None:
             self._w8 = w8
             self._arena = np.zeros((self.capacity, w8 * 8), np.uint8)
-        elif w8 != self._w8:
-            raise ValueError(
-                f"key width changed for allocator {self.name!r}")
+        elif w8 > self._w8:
+            # an allocator shared across streams may see wider keys later:
+            # zero-pad existing keys to the new width and re-hash the table
+            # (hashes cover all w8 words, so every binding changes)
+            wider = np.zeros((self.capacity, w8 * 8), np.uint8)
+            wider[:, : self._w8 * 8] = self._arena
+            self._arena = wider
+            self._w8 = w8
+            self._rebuild_table()
 
     # -- lookup/insert -------------------------------------------------------
     def slots_for(self, key_cols: Sequence[np.ndarray],
@@ -155,12 +161,17 @@ class SlotAllocator:
         if n == 0:
             return np.empty((0,), np.int32), None
         words = _key_words(key_cols)
-        self._ensure_arena(words.shape[1])
         live = None if valid is None else \
             np.ascontiguousarray(valid, np.uint8)
         out = np.empty(n, np.int32)
         grouped = None
         with self._lock:
+            if self._arena is not None and words.shape[1] < self._w8:
+                # narrower key than the arena width: zero-pad to match
+                words = np.ascontiguousarray(np.concatenate(
+                    [words, np.zeros((n, self._w8 - words.shape[1]),
+                                     np.uint64)], axis=1))
+            self._ensure_arena(words.shape[1])
             # purge churn turns EMPTY cells into tombstones; once EMPTY runs
             # out, probes for new keys could never terminate.  Rebuild
             # (clearing tombstones) past a load threshold.
@@ -255,14 +266,17 @@ class SlotAllocator:
         self._cell_by_slot[slot] = j
 
     def _py_probe_one(self, h1: int, h2: int) -> int:
+        # bounded: cap2 probes visit every cell; when tombstones have eaten
+        # the last EMPTY cell, exceeding the bound proves absence
         j = h1 & (self._cap2 - 1)
-        while True:
+        for _ in range(self._cap2):
             c = int(self._cells[j, 0])
             if c == int(h1) and int(self._cells[j, 1]) == int(h2):
                 return int(np.int32(np.uint32(self._cells[j, 2])))
             if c == 0:
                 return -1
             j = (j + 1) & (self._cap2 - 1)
+        return -1
 
     def _py_probe(self, h1, h2, live) -> Tuple[np.ndarray, np.ndarray]:
         n = h1.shape[0]
@@ -382,6 +396,11 @@ class SlotAllocator:
         if self._arena is None:
             self._w8 = len(key) // 8
             self._arena = np.zeros((self.capacity, len(key)), np.uint8)
+        elif len(key) > self._w8 * 8:
+            # source allocator widened after the base snapshot; mirror it
+            self._ensure_arena(len(key) // 8)
+        elif len(key) < self._w8 * 8:
+            key = key + b"\x00" * (self._w8 * 8 - len(key))
         if self._used[slot]:
             if self._arena[slot].tobytes() == key:
                 return

@@ -358,6 +358,7 @@ class _LazyBatchPayload(dict):
     materializes everything so the plain-dict contract holds."""
 
     _LAZY = ("ts", "kind", "valid", "cols")
+    _COUNTS = ("n_valid", "n_current", "n_expired", "n_dropped")
 
     def __init__(self, names, ots, okind, ovalid, ocols, counts=None):
         super().__init__()
@@ -395,8 +396,7 @@ class _LazyBatchPayload(dict):
         return v
 
     def _materialize(self):
-        for k in self._LAZY + ("n_valid", "n_current", "n_expired",
-                               "n_dropped"):
+        for k in self._LAZY + self._COUNTS:
             if not dict.__contains__(self, k):
                 self[k]
         return self
@@ -408,7 +408,7 @@ class _LazyBatchPayload(dict):
             return default
 
     def __contains__(self, k):
-        return k in self._LAZY or k.startswith("n_") or \
+        return k in self._LAZY or k in self._COUNTS or \
             dict.__contains__(self, k)
 
     def __iter__(self):
@@ -424,7 +424,10 @@ class _LazyBatchPayload(dict):
         return dict.values(self._materialize())
 
     def __len__(self):
-        return len(dict.keys(self._materialize()))
+        # fixed key set: counting costs no device->host materialization
+        extra = sum(1 for k in dict.keys(self)
+                    if k not in self._LAZY and k not in self._COUNTS)
+        return len(self._LAZY) + len(self._COUNTS) + extra
 
 
 def _emit_output_sync(qr, out, now: int, header=None) -> None:

@@ -10,6 +10,7 @@ import ctypes
 import logging
 import os
 import subprocess
+import time
 
 _dir = os.path.dirname(__file__)
 _src = os.path.join(_dir, "staging.c")
@@ -21,20 +22,36 @@ def _build():
     tag = int(os.stat(_src).st_mtime)
     so = os.path.join(_dir, f"_staging_{tag}.so")
     if not os.path.exists(so):
+        now = time.time()
         for old in os.listdir(_dir):
-            if old.startswith("_staging_") and old.endswith(".so"):
-                try:
-                    os.unlink(os.path.join(_dir, old))
-                except OSError:
-                    pass
-        cmd = ["gcc", "-O3", "-shared", "-fPIC", "-o", so + ".tmp", _src]
+            if not old.startswith("_staging_"):
+                continue
+            p = os.path.join(_dir, old)
+            try:
+                # stale .so from an older source; orphaned .tmp only when
+                # old enough that no concurrent gcc can still be writing it
+                if old.endswith(".so") or now - os.stat(p).st_mtime > 600:
+                    os.unlink(p)
+            except OSError:
+                pass
+        # per-process temp name: concurrent importers must not interleave
+        # writes to one file and publish a corrupt .so via os.replace
+        tmp = f"{so}.tmp{os.getpid()}"
+        cmd = ["gcc", "-O3", "-shared", "-fPIC", "-o", tmp, _src]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-            os.replace(so + ".tmp", so)
+            os.replace(tmp, so)
         except (OSError, subprocess.SubprocessError) as exc:
-            logging.getLogger("siddhi_tpu").warning(
-                "native staging build failed (%s); using numpy fallback", exc)
-            return None
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            # a concurrent importer may have published the .so meanwhile
+            if not os.path.exists(so):
+                logging.getLogger("siddhi_tpu").warning(
+                    "native staging build failed (%s); using numpy fallback",
+                    exc)
+                return None
     try:
         return ctypes.CDLL(so)
     except OSError as exc:
@@ -63,9 +80,6 @@ def _bind(lib):
     lib.sg_group_fill.argtypes = [
         i32p, u8p, c.c_int64, i32p, i32p, i32p,
         c.c_int64, c.c_int64, c.c_int64, c.c_int32, i32p, i32p]
-    lib.sg_pad_copy.restype = None
-    lib.sg_pad_copy.argtypes = [c.c_void_p, c.c_void_p, c.c_int64, c.c_int64,
-                                c.c_int64]
     return lib
 
 

@@ -93,8 +93,11 @@ int64_t sg_slots_for(const uint64_t *words, int64_t n, int64_t w8,
         if (pcache[pidx] == h1 && pcache[pidx + 1] == h2) {
             slot = (int32_t)pcache[pidx + 2];
         } else {
+            /* bounded: cap2 steps visit every cell, so exceeding the bound
+             * (possible when purge-churn tombstones consume the last EMPTY
+             * cells) proves absence instead of spinning forever. */
             uint64_t idx = h1 & mask;
-            for (;;) {
+            for (int64_t probes = 0; probes < cap2; probes++) {
                 uint64_t c = C_H1(cells, idx);
                 if (c == h1 && C_H2(cells, idx) == h2) {
                     slot = C_SLOT(cells, idx); break;
@@ -221,11 +224,4 @@ int32_t sg_group_fill(const int32_t *slots, const uint8_t *valid, int64_t n,
         cnt[touched[k]] = 0;                      /* leave cnt clean */
     return (n_uniq > 0 &&
             touched[n_uniq - 1] == touched[0] + (int32_t)(n_uniq - 1)) ? 1 : 0;
-}
-
-/* Fused stage: pad/copy one column into a bucket-capacity buffer. */
-void sg_pad_copy(const void *src, void *dst, int64_t n, int64_t cap,
-                 int64_t itemsize) {
-    memcpy(dst, src, (size_t)(n * itemsize));
-    memset((char *)dst + n * itemsize, 0, (size_t)((cap - n) * itemsize));
 }
