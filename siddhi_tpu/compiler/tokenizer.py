@@ -13,7 +13,10 @@ import dataclasses
 from typing import List, Optional
 
 
-class SiddhiParserException(Exception):
+from ..exceptions import SiddhiParserException as _BaseParserException
+
+
+class SiddhiParserException(_BaseParserException):
     def __init__(self, message: str, line: int = 0, col: int = 0):
         super().__init__(f"{message} (line {line}, col {col})")
         self.message = message

@@ -45,8 +45,7 @@ AGGREGATOR_NAMES = {
 }
 
 
-class CompileError(Exception):
-    pass
+from ..exceptions import CompileError  # noqa: E402  (canonical home)
 
 
 def promote(t1: str, t2: str) -> str:

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..exceptions import CapacityExceededError
 from ..native import LIB, ptr
 
 _EMPTY = np.uint64(0)
@@ -206,7 +207,7 @@ class SlotAllocator:
                         if group:
                             # re-zero count scratch the aborted pass touched
                             cnt[:] = 0
-                        raise RuntimeError(
+                        raise CapacityExceededError(
                             f"slot capacity {self.capacity} exhausted for "
                             f"{self.name!r}; raise via @capacity annotation")
                     if group:
@@ -237,7 +238,7 @@ class SlotAllocator:
                     slots[r] = s
                     continue
                 if self._meta[1] <= 0:
-                    raise RuntimeError(
+                    raise CapacityExceededError(
                         f"slot capacity {self.capacity} exhausted for "
                         f"{self.name!r}; raise via @capacity annotation")
                 self._meta[1] -= 1

@@ -136,6 +136,9 @@ class PlannedPatternQuery:
     # gather/scatter on TPU is row-serialized (~0.3us/row; 131k-key batch =
     # ~90ms), a contiguous slice is DMA-speed
     dense_steps: Optional[Dict[str, Callable]] = None
+    # False when the per-key emission cap is an implicit default: overflow
+    # then raises instead of dropping rows (@emit(rows=N) opts into capping)
+    emit_explicit: bool = True
 
 
 def plan_pattern_query(
@@ -157,9 +160,11 @@ def plan_pattern_query(
     # partitioned queries compact by default: for K=1 a per-key cap would
     # cap the whole batch.
     compact_rows = 8 if partition_positions else (1 << 30)
+    emit_explicit = False
     for ann in query.annotations:
         if ann.name.lower() == "emit":
             compact_rows = int(ann.element("rows", compact_rows))
+            emit_explicit = True
     spec = linearize(sis, count_cap=count_cap)
     for sid in spec.stream_ids:
         if sid not in schemas:
@@ -281,7 +286,13 @@ def plan_pattern_query(
             ord_ = jnp.zeros((K, 1), jnp.int64)
             sel_state, out, wake = _emit_matches(
                 pexec, sel, spec, emits, ord_, sel_state, st, now)
-            return packer.pack(st), sel_state, out, wake
+            nb32, nb64, nscalars = packer.pack(st)
+            # per-key changed mask so the host marks ONLY mutated keys dirty
+            # (a full-slab dirty would turn every incremental snapshot after
+            # a timer fire into a full one)
+            changed = jnp.any(nb32 != b32, axis=0) | \
+                jnp.any(nb64 != b64, axis=0)
+            return (nb32, nb64, nscalars), sel_state, out, wake, changed
 
         timer_step = jax.jit(tstep, donate_argnums=(0, 1))
 
@@ -301,7 +312,7 @@ def plan_pattern_query(
         timer_step=timer_step, init_state=init_state,
         key_capacity=key_capacity, slots=slots,
         partition_positions=partition_positions,
-        raw_steps=raw_steps, mesh=mesh)
+        raw_steps=raw_steps, mesh=mesh, emit_explicit=emit_explicit)
 
 
 def _first_schema(spec: PatternSpec, schemas) -> ev.Schema:

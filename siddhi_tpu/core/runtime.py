@@ -19,6 +19,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 import jax
 import numpy as np
 
+from ..exceptions import (CannotRestoreStateError, DefinitionNotExistError,
+                          MatchOverflowError, QueryNotExistError)
 from ..query_api.app import SiddhiApp
 from ..query_api.definition import StreamDefinition
 from ..query_api.query import Partition, Query, SingleInputStream
@@ -54,9 +56,13 @@ class QueryCallback:
 
 def _allocator_of(qr):
     """Slot allocator of a query runtime (pattern runtimes hold it
-    directly, planned single queries on the plan)."""
-    return getattr(qr, "slot_allocator", None) or \
-        getattr(qr.planned, "slot_allocator", None)
+    directly, planned single queries on the plan).  Explicit None checks:
+    an EMPTY allocator is len()==0 and must still be returned (a fresh
+    runtime restoring a snapshot hits exactly that state)."""
+    a = getattr(qr, "slot_allocator", None)
+    if a is None:
+        a = getattr(qr.planned, "slot_allocator", None)
+    return a
 
 
 def _wrap_stream_callback(cb) -> Callable[[List[ev.Event]], None]:
@@ -286,9 +292,15 @@ class PatternQueryRuntime:
         if p.timer_step is None:
             return
         pstate, sel_state = self.state
-        pstate, sel_state, out, wake = p.timer_step(
+        pstate, sel_state, out, wake, changed = p.timer_step(
             pstate, sel_state, jax.numpy.asarray(now, jax.numpy.int64))
         self.state = (pstate, sel_state)
+        if self._dirty is not None:
+            # timer-driven expiry/absent firing mutates key NFA state;
+            # without marking, incremental snapshots miss those changes and
+            # a restore resurrects expired pending states.  The device
+            # reports exactly which keys changed.
+            self._dirty |= np.asarray(jax.device_get(changed))
         _emit_output(self, out, now, wake=self._wake_arg(wake))
 
     def _wake_arg(self, wake):
@@ -461,6 +473,15 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
             header = jax.device_get((n_valid, n_dropped))
         nv, nd = int(header[0]), int(header[1])
         if nd:
+            if not getattr(qr.planned, "emit_explicit", True):
+                # the cap was an implicit default: losing matches silently
+                # is a correctness hole, so surface it as a processing error
+                # (fault stream / exception listener via the junction)
+                raise MatchOverflowError(
+                    f"{qr.name}: {nd} pattern match rows exceeded the "
+                    f"implicit per-key emission capacity this batch; set "
+                    f"@emit(rows='N') on the query to raise the cap or "
+                    f"accept capped delivery")
             import logging
             logging.getLogger("siddhi_tpu").warning(
                 "%s: %d pattern match rows exceeded the per-key emission "
@@ -827,6 +848,22 @@ class StreamJunction:
         if listener is not None:
             listener(exc)
 
+    def _handle_error_staged(self, staged: ev.StagedBatch, exc: Exception,
+                             now: int) -> None:
+        """Columnar-path twin of _handle_error: rows decode to host events
+        only when a fault stream actually consumes them."""
+        if self.on_error == "STREAM" and self.app is not None and \
+                ("!" + self.stream_id) in self.app.junctions:
+            idx = np.nonzero(staged.valid)[0]
+            events = []
+            for i in idx.tolist():
+                data = [self.schema.decode_value(t, c[i]) for t, c in
+                        zip(self.schema.types, staged.cols)]
+                events.append(ev.Event(int(staged.ts[i]), data))
+            self._handle_error(events, exc, now)
+            return
+        self._handle_error([], exc, now)
+
 
 class _EmissionDrainer:
     """Background thread pulling device outputs and delivering callbacks.
@@ -905,8 +942,22 @@ class _EmissionDrainer:
                         _emit_output_sync(qr, out, now, header=fetch_h)
                     else:
                         _emit_output_sync(qr, fetch_h, now)
-                except Exception:  # noqa: BLE001 — drainer must survive
-                    traceback.print_exc()
+                except Exception as exc:  # noqa: BLE001 — drainer survives
+                    # route to the app error path (reference: the Disruptor
+                    # ExceptionHandler) — MatchOverflowError and callback
+                    # failures must reach the exception listener, not stderr
+                    import logging
+                    logging.getLogger("siddhi_tpu").error(
+                        "async emission error in %s: %s",
+                        getattr(qr, "name", "?"), exc)
+                    listener = getattr(qr.app, "exception_listener", None)
+                    if listener is not None:
+                        try:
+                            listener(exc)
+                        except Exception:  # noqa: BLE001
+                            traceback.print_exc()
+                    else:
+                        traceback.print_exc()
                 finally:
                     self._q.task_done()
 
@@ -1177,9 +1228,15 @@ class SiddhiAppRuntime:
             return
         in_sid = q.input_stream.unique_stream_id
         from_window = in_sid in self.named_windows
+        # @capacity(window='N') bounds the window state slab for this query
+        wch = 2048
+        cap_ann = q.get_annotation("capacity")
+        if cap_ann is not None and cap_ann.element("window"):
+            wch = int(cap_ann.element("window"))
         planned = plan_single_query(
             q, name, self.app.stream_definition_map, self.schemas,
             self.interner, named_window_input=from_window,
+            window_capacity_hint=wch,
             config_manager=self.config_manager,
             script_functions=self.app.function_definition_map)
         runtime = QueryRuntime(planned, self)
@@ -1480,12 +1537,14 @@ class SiddhiAppRuntime:
     # -- I/O ------------------------------------------------------------------
     def get_input_handler(self, stream_id: str) -> InputHandler:
         if stream_id not in self.junctions:
-            raise KeyError(f"undefined stream {stream_id!r}")
+            raise DefinitionNotExistError(f"undefined stream {stream_id!r}")
         return InputHandler(stream_id, self)
 
     def add_batch_callback(self, query_name: str, cb) -> None:
         """High-throughput query callback receiving columnar numpy batches
         (ts, kind, valid, cols dict) without per-event decoding."""
+        if query_name not in self.query_runtimes:
+            raise QueryNotExistError(f"no query named {query_name!r}")
         self.query_runtimes[query_name].batch_callbacks.append(cb)
 
     def add_callback(self, name: str, cb) -> None:
@@ -1498,12 +1557,12 @@ class SiddhiAppRuntime:
         elif name in self.query_runtimes:
             self.query_runtimes[name].callbacks.append(_wrap_query_callback(cb))
         else:
-            raise KeyError(f"no stream or query named {name!r}")
+            raise QueryNotExistError(f"no stream or query named {name!r}")
 
     def _route_columns(self, stream_id: str, cols, timestamps) -> None:
         junction = self.junctions.get(stream_id)
         if junction is None:
-            raise KeyError(f"undefined stream {stream_id!r}")
+            raise DefinitionNotExistError(f"undefined stream {stream_id!r}")
         n = len(cols[0])
         cap = ev.bucket_size(max(n, 1))
         schema = junction.schema
@@ -1529,7 +1588,10 @@ class SiddhiAppRuntime:
             if self.playback:
                 self._scheduler.drain_playback(now)
             for q in junction.queries:
-                q.process_staged(staged, now)
+                try:
+                    q.process_staged(staged, now)
+                except Exception as exc:  # noqa: BLE001 — fault routing
+                    junction._handle_error_staged(staged, exc, now)
 
     def _route(self, stream_id: str, events: List[ev.Event]) -> None:
         if stream_id in self.named_windows:
@@ -1545,7 +1607,7 @@ class SiddhiAppRuntime:
             return
         junction = self.junctions.get(stream_id)
         if junction is None:
-            raise KeyError(f"undefined stream {stream_id!r}")
+            raise DefinitionNotExistError(f"undefined stream {stream_id!r}")
         if self.playback and events:
             self._playback_time = max(self._playback_time,
                                       max(e.timestamp for e in events))
@@ -1601,7 +1663,8 @@ class SiddhiAppRuntime:
                 alloc = _allocator_of(qr)
                 states[name] = {
                     "state": host_state,
-                    "slots": alloc.snapshot() if alloc else None,
+                    "slots": alloc.snapshot() if alloc is not None else None,
+                    "wake": getattr(qr, "next_wakeup", None),
                 }
             windows = {
                 wid: jax.tree.map(lambda x: np.asarray(x), nw.state)
@@ -1649,7 +1712,9 @@ class SiddhiAppRuntime:
                         "scalars": [np.asarray(s) for s in scalars],
                         "sel_state": jax.tree.map(
                             lambda x: np.asarray(x), qr.state[1]),
-                        "journal": alloc.drain_journal() if alloc else [],
+                        "journal": alloc.drain_journal()
+                        if alloc is not None else [],
+                        "wake": getattr(qr, "next_wakeup", None),
                     }
                     dirty[:] = False
                 else:
@@ -1657,7 +1722,9 @@ class SiddhiAppRuntime:
                         "kind": "full",
                         "state": jax.tree.map(
                             lambda x: np.asarray(x), qr.state),
-                        "slots": alloc.snapshot() if alloc else None,
+                        "slots": alloc.snapshot()
+                        if alloc is not None else None,
+                        "wake": getattr(qr, "next_wakeup", None),
                     }
             from .table import _table_state
             payload = {
@@ -1701,6 +1768,9 @@ class SiddhiAppRuntime:
                         lambda x: jax.numpy.asarray(x), d["state"])
                     if d["slots"] is not None and alloc is not None:
                         alloc.restore(d["slots"])
+                w = d.get("wake")
+                if w is not None and hasattr(qr, "_apply_wake"):
+                    qr._apply_wake(int(w))
             self._restore_shared(payload)
 
     def restore(self, blob: bytes) -> None:
@@ -1717,6 +1787,12 @@ class SiddhiAppRuntime:
                 alloc = _allocator_of(qr)
                 if data["slots"] is not None and alloc is not None:
                     alloc.restore(data["slots"])
+                # re-arm pending timers (absent deadlines, window expiry):
+                # the scheduler of this fresh runtime knows nothing of the
+                # wakeups the snapshotted state still expects
+                w = data.get("wake")
+                if w is not None and hasattr(qr, "_apply_wake"):
+                    qr._apply_wake(int(w))
             self._restore_shared(payload)
 
     def _restore_shared(self, payload) -> None:
@@ -1793,6 +1869,15 @@ class SiddhiManager:
         )
         store = self.persistence_store
         incremental = isinstance(store, IncrementalPersistenceStore)
+        # a failed async write leaves a hole in the increment chain; demote
+        # the affected app to a fresh BASE snapshot instead of stacking
+        # increments on the hole
+        for tag in self._persistor.take_failed_tags():
+            import logging
+            logging.getLogger("siddhi_tpu").warning(
+                "previous persist of %s failed; writing a full base "
+                "snapshot", tag)
+            self._has_base.discard(tag)
         revs = []
         for name, rt in self.runtimes.items():
             rt.pause_sources()
@@ -1802,15 +1887,15 @@ class SiddhiManager:
                     if name not in self._has_base:
                         blob = rt.snapshot()
                         self._persistor.submit(store.save_base, name, rev,
-                                               blob)
+                                               blob, tag=name)
                         self._has_base.add(name)
                     else:
                         blob = rt.snapshot_incremental()
                         self._persistor.submit(store.save_increment, name,
-                                               rev, blob)
+                                               rev, blob, tag=name)
                 else:
                     self._persistor.submit(store.save, name, rev,
-                                           rt.snapshot())
+                                           rt.snapshot(), tag=name)
                 revs.append(rev)
             finally:
                 rt.resume_sources()
@@ -1818,6 +1903,21 @@ class SiddhiManager:
 
     def wait_for_persistence(self) -> None:
         self._persistor.flush()
+
+    def restore_revision(self, revision: str) -> None:
+        """Restore every app from a specific full-snapshot revision
+        (reference: SiddhiAppRuntimeImpl.restoreRevision)."""
+        self.wait_for_persistence()
+        store = self.persistence_store
+        if not hasattr(store, "load"):
+            raise CannotRestoreStateError(
+                "revision restore requires a full-snapshot PersistenceStore")
+        for name, rt in self.runtimes.items():
+            blob = store.load(name, revision)
+            if blob is None:
+                raise CannotRestoreStateError(
+                    f"revision {revision!r} not found for app {name!r}")
+            rt.restore(blob)
 
     def restore_last_revision(self) -> None:
         from ..utils.persistence import IncrementalPersistenceStore

@@ -205,7 +205,9 @@ class ExpressionWindow(WindowProcessor):
     """Sliding expression window (reference: ExpressionWindowProcessor).
 
     Holds events while the expression over the window contents is satisfied;
-    when it is not, events expire oldest-first until it is."""
+    when it is not, events expire oldest-first until it is.  Retention is
+    additionally bounded by the slab capacity (@capacity hint): beyond it the
+    oldest rows force-expire as EXPIRED events — never silent truncation."""
 
     name = "expression"
 
@@ -238,6 +240,11 @@ class ExpressionWindow(WindowProcessor):
             ok = jnp.logical_and(sat, jnp.logical_and(jN >= front, jN <= hi))
             nfront = jnp.where(jnp.any(ok), jnp.argmax(ok).astype(jnp.int64),
                                hi + 1)
+            # capacity bound: never retain more than C rows — the oldest
+            # force-expire through the normal EXPIRED path instead of being
+            # silently truncated when the batch carries over (reference keeps
+            # an unbounded list; a fixed slab needs visible eviction)
+            nfront = jnp.maximum(nfront, hi + 1 - C)
             nfront = jnp.where(kk < ncur, nfront, front)
             return nfront, nfront
 
@@ -292,7 +299,8 @@ class ExpressionBatchWindow(WindowProcessor):
     the collected batch flushes as CURRENT (previous batch replayed as
     EXPIRED first).  Options: include.triggering.event (the breaking event
     joins the flushed batch), stream.current.event (arrivals stream out
-    individually while expiry stays batched)."""
+    individually while expiry stays batched).  A pending run exceeding the
+    slab capacity force-flushes rather than silently truncating."""
 
     name = "expressionBatch"
 
@@ -312,8 +320,10 @@ class ExpressionBatchWindow(WindowProcessor):
         return 3 * (self.capacity + self.batch_capacity)
 
     def init_state(self):
-        return (empty_buffer(self.schema, self.capacity),   # pending
-                empty_buffer(self.schema, self.capacity),   # previous batch
+        # prev holds one flushed batch: up to C pending rows PLUS the
+        # triggering event (include.triggering.event), hence C + 1
+        return (empty_buffer(self.schema, self.capacity),       # pending
+                empty_buffer(self.schema, self.capacity + 1),   # prev batch
                 jnp.asarray(0, jnp.int64))
 
     def process(self, state, rows: Rows, now):
@@ -331,9 +341,14 @@ class ExpressionBatchWindow(WindowProcessor):
             ctx = _RangeCtx(self.schema, comb_cols, comb_ts, hi, N)
             sat_vec = jnp.broadcast_to(_range_eval(self.expr, ctx), (N,))
             sat = jnp.sum(jnp.where(jN == start, sat_vec, False))  # sat[start]
-            flush = jnp.logical_and(kk < ncur,
-                                    jnp.logical_and(start <= hi,
-                                                    jnp.logical_not(sat)))
+            # capacity bound: a pending run longer than the slab force-
+            # flushes (visible CURRENT batch) instead of silently dropping
+            # its overflow when carried to the next step
+            over = (hi - start + 1) > C
+            flush = jnp.logical_and(
+                kk < ncur,
+                jnp.logical_and(start <= hi,
+                                jnp.logical_or(jnp.logical_not(sat), over)))
             nstart = jnp.where(
                 flush, hi + 1 if self.include_trigger else hi, start)
             return ((nstart, nflush + flush.astype(jnp.int64)),
@@ -374,9 +389,10 @@ class ExpressionBatchWindow(WindowProcessor):
         # EXPIRED: prev batch replays at flush 0; flushed batch f replays at
         # flush f+1 (if it happens within this step)
         total_flushes = jnp.sum(flushes.astype(jnp.int64))
+        P = C + 1                                  # prev slab capacity
         prev_rank = jnp.cumsum(prev.alive.astype(jnp.int64)) - 1
         prev_exp = Rows(
-            ts=prev.ts, kind=jnp.full((C,), ev.EXPIRED, jnp.int32),
+            ts=prev.ts, kind=jnp.full((P,), ev.EXPIRED, jnp.int32),
             valid=jnp.logical_and(prev.alive, total_flushes > 0),
             seq=base + prev_rank,
             gslot=prev.gslot, cols=prev.cols)
@@ -398,17 +414,18 @@ class ExpressionBatchWindow(WindowProcessor):
             expire_ts=jnp.full((C,), BIG_SEQ, jnp.int64),
             alive=tvalid, gslot=comb_gslot[tpos],
             cols=tuple(c[tpos] for c in comb_cols))
-        # last flushed batch = entries with f_p == total_flushes-1
+        # last flushed batch = entries with f_p == total_flushes-1; the
+        # P = C+1 slab fits a full pending run plus its triggering event
         last_b = jnp.logical_and(flushed, f_p == total_flushes - 1)
         lrank = jnp.cumsum(last_b.astype(jnp.int64)) - 1
-        tgt = jnp.where(last_b, lrank, C).astype(jnp.int32)
-        fresh = empty_buffer(self.schema, C)
+        tgt = jnp.where(last_b, lrank, P).astype(jnp.int32)
+        fresh = empty_buffer(self.schema, P)
         nprev = Buffer(
             ts=fresh.ts.at[tgt].set(comb_ts, mode="drop"),
             add_seq=fresh.add_seq.at[tgt].set(seq0 + jN, mode="drop"),
             expire_seq=fresh.expire_seq,
             expire_ts=fresh.expire_ts,
-            alive=jnp.zeros((C,), jnp.bool_).at[tgt].set(last_b, mode="drop"),
+            alive=jnp.zeros((P,), jnp.bool_).at[tgt].set(last_b, mode="drop"),
             gslot=fresh.gslot.at[tgt].set(comb_gslot, mode="drop"),
             cols=tuple(f.at[tgt].set(c, mode="drop")
                        for f, c in zip(fresh.cols, comb_cols)),

@@ -1,0 +1,102 @@
+"""Typed exception hierarchy.
+
+Reference (what): CORE/exception/* — ~20 typed exceptions rooted at
+RuntimeException, each carrying query-context info where available
+(e.g. SiddhiAppCreationException, ConnectionUnavailableException,
+CannotRestoreSiddhiAppStateException).  TPU design (how): one Python
+hierarchy rooted at SiddhiError; compile-time errors keep the line/column
+context the tokenizer attaches, runtime errors name the query so fault
+streams (@OnError) can route them.
+"""
+from __future__ import annotations
+
+
+class SiddhiError(Exception):
+    """Root of the framework's exception hierarchy."""
+
+
+# -- compile time -------------------------------------------------------------
+class CompileError(SiddhiError):
+    """Expression/query cannot be compiled to a device function
+    (reference: SiddhiAppCreationException)."""
+
+
+class SiddhiParserException(CompileError):
+    """SiddhiQL text failed to parse (reference:
+    QC/exception/SiddhiParserException)."""
+
+
+class SiddhiAppValidationError(CompileError):
+    """App-level semantic validation failed (reference:
+    SiddhiAppValidationException)."""
+
+
+class DuplicateDefinitionError(CompileError):
+    """Two definitions share an id (reference:
+    DuplicateDefinitionException)."""
+
+
+class DefinitionNotExistError(CompileError, KeyError):
+    """A query references an undefined stream/table/window/aggregation
+    (reference: DefinitionNotExistException).  Subclasses KeyError for
+    backward compatibility with callers catching the untyped lookup error."""
+
+
+class OperationNotSupportedError(CompileError):
+    """Valid SiddhiQL that this engine does not (yet) execute (reference:
+    OperationNotSupportedException)."""
+
+
+# -- runtime ------------------------------------------------------------------
+class SiddhiAppRuntimeError(SiddhiError):
+    """Event-processing failure inside a running app (reference:
+    SiddhiAppRuntimeException)."""
+
+
+class QueryNotExistError(SiddhiError, KeyError):
+    """Callback/on-demand query addressed a query id that is not part of
+    the app (reference: QueryNotExistException).  Subclasses KeyError for
+    backward compatibility with callers catching the untyped lookup error."""
+
+
+class MatchOverflowError(SiddhiAppRuntimeError):
+    """Pattern matches exceeded the implicit per-key emission capacity; the
+    batch would silently lose rows.  Set @emit(rows='N') to raise the cap
+    or explicitly accept capped delivery."""
+
+
+class CapacityExceededError(SiddhiAppRuntimeError, RuntimeError):
+    """A fixed-capacity state slab (key slots, window rows) is full.
+    Subclasses RuntimeError for backward compatibility with callers that
+    caught the untyped error."""
+
+
+class OnDemandQueryCreationError(CompileError):
+    """On-demand (store) query failed to compile (reference:
+    OnDemandQueryCreationException)."""
+
+
+# -- persistence --------------------------------------------------------------
+class PersistenceError(SiddhiError):
+    """Snapshot persist failed (reference: PersistenceStoreException)."""
+
+
+class NoPersistenceStoreError(PersistenceError):
+    """persist() called with no PersistenceStore configured (reference:
+    NoPersistenceStoreException)."""
+
+
+class CannotRestoreStateError(PersistenceError):
+    """Snapshot restore failed or revision missing (reference:
+    CannotRestoreSiddhiAppStateException)."""
+
+
+# -- I/O ----------------------------------------------------------------------
+class ConnectionUnavailableException(SiddhiError):
+    """Source/sink/store backing system unreachable (reference:
+    CORE/exception/ConnectionUnavailableException)."""
+
+
+class MappingFailedError(SiddhiAppRuntimeError):
+    """Source/sink mapper could not convert a payload (reference:
+    MappingFailedException)."""

@@ -125,9 +125,7 @@ def _compile_host(expr: Expression, schema, other_key):
     return lambda row, params: ev_(expr, row, params)
 
 
-class ConnectionUnavailableException(Exception):
-    """Raised by stores when the backing system is unreachable (reference:
-    CORE/exception/ConnectionUnavailableException)."""
+from ..exceptions import ConnectionUnavailableException  # noqa: E402
 
 
 class RecordTable:
@@ -175,15 +173,20 @@ class RecordTable:
 
 def connect_with_retry(store: RecordTable, name: str,
                        max_wait_s: float = 60.0,
+                       max_attempts: int = 20,
                        _sleep=time.sleep) -> None:
     """Exponential backoff connect (reference: BackoffRetryCounter sequence
-    5s,10s,...,1min capped)."""
+    5s,10s,...,1min capped).  Bounded: after `max_attempts` failures the
+    last ConnectionUnavailableException propagates — an unreachable store
+    must fail the app start, not hang its thread forever."""
     wait = 0.05
-    while True:
+    for attempt in range(max_attempts):
         try:
             store.connect()
             return
         except ConnectionUnavailableException:
+            if attempt == max_attempts - 1:
+                raise
             _sleep(wait)
             wait = min(wait * 2, max_wait_s)
 

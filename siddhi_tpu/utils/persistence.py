@@ -186,29 +186,70 @@ class IncrementalFileSystemPersistenceStore(IncrementalPersistenceStore):
 
 class AsyncSnapshotPersistor:
     """Background snapshot writer so persist() does not block the event path
-    (reference: CORE/util/snapshot/AsyncSnapshotPersistor.java:29)."""
+    (reference: CORE/util/snapshot/AsyncSnapshotPersistor.java:29).
+
+    Write failures are RECORDED, not swallowed: `take_errors()` returns and
+    clears them (SiddhiManager.persist uses this to force a fresh BASE
+    snapshot after a failed increment, so the chain never has holes), and
+    `flush()` raises PersistenceError for failures nobody consumed."""
 
     def __init__(self):
         import queue
         import threading
         self._q = queue.Queue()
+        self._errors: List[Tuple[Optional[str], Exception]] = []
+        # tags with a failed write since the last take_failed_tags(); kept
+        # separate from _errors so flush() raising does not erase the
+        # rebase obligation SiddhiManager.persist reads
+        self._failed_tags: set = set()
+        self._errors_dropped = 0
+        self._elock = threading.Lock()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="siddhi-persist")
         self._thread.start()
 
-    def submit(self, fn, *args) -> None:
-        self._q.put((fn, args))
+    def submit(self, fn, *args, tag: Optional[str] = None) -> None:
+        self._q.put((fn, args, tag))
+
+    def take_errors(self) -> List[Tuple[Optional[str], Exception]]:
+        """Failures since the last call, as (tag, exception) pairs."""
+        with self._elock:
+            errs, self._errors = self._errors, []
+            return errs
+
+    def take_failed_tags(self) -> set:
+        """Tags of failed writes since the last call (chain-repair signal)."""
+        with self._elock:
+            tags, self._failed_tags = self._failed_tags, set()
+            return tags
 
     def flush(self) -> None:
         self._q.join()
+        with self._elock:
+            dropped, self._errors_dropped = self._errors_dropped, 0
+        errs = self.take_errors()
+        if errs:
+            from ..exceptions import PersistenceError
+            raise PersistenceError(
+                f"{len(errs) + dropped} snapshot write(s) failed: " +
+                "; ".join(f"{t or '?'}: {e!r}" for t, e in errs[:10]))
 
     def _run(self):
         while True:
-            fn, args = self._q.get()
+            fn, args, tag = self._q.get()
             try:
                 fn(*args)
-            except Exception:  # noqa: BLE001 — persistor must survive
-                import traceback
-                traceback.print_exc()
+            except Exception as exc:  # noqa: BLE001 — persistor must survive
+                import logging
+                logging.getLogger("siddhi_tpu").error(
+                    "async snapshot write failed for %s: %r", tag or "?", exc)
+                with self._elock:
+                    # bounded: a persist loop against a permanently failing
+                    # store must not pin unbounded exception objects
+                    if len(self._errors) < 100:
+                        self._errors.append((tag, exc))
+                    else:
+                        self._errors_dropped += 1
+                    self._failed_tags.add(tag)
             finally:
                 self._q.task_done()

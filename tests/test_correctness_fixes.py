@@ -1,0 +1,265 @@
+"""Regression tests for the round-2 verdict's correctness bugs: timer-dirty
+incremental snapshots, pattern emission overflow, persistor write failures,
+expression-window capacity overflow, and bounded store connect retry."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.exceptions import (ConnectionUnavailableException,
+                                   MatchOverflowError, PersistenceError)
+from siddhi_tpu.utils.persistence import InMemoryIncrementalPersistenceStore
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+ABSENT_QL = """
+@app:playback
+define stream S1 (key long, v int);
+define stream S2 (key long, v int);
+partition with (key of S1, key of S2)
+begin
+  @info(name='q')
+  from e1=S1[v == 1] -> not S2 for 1 sec
+  select e1.key as k
+  insert into Out;
+end;
+"""
+
+
+def test_timer_mutation_included_in_incremental_snapshot():
+    """on_timer (absent firing / expiry) mutates per-key NFA state; the
+    increment after it must carry the change or a restore resurrects the
+    already-fired pending state and double-fires."""
+    m1 = SiddhiManager()
+    m1.set_persistence_store(InMemoryIncrementalPersistenceStore())
+    rt = m1.create_siddhi_app_runtime(ABSENT_QL)
+    fired = []
+    rt.add_callback("q", lambda ts, i, o: fired.extend(
+        [e.data for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S1")
+    h.send([7, 1], timestamp=1000)            # pending absent for key 7
+    m1.persist()                              # BASE (resets dirty)
+    m1.wait_for_persistence()
+    h.send([8, 9], timestamp=3000)            # clock advance -> timer fires
+    rt.flush()
+    assert fired == [[7]]                     # absent fired exactly once
+    m1.persist()                              # INCREMENT (must carry key 7)
+    m1.wait_for_persistence()
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(m1.persistence_store)
+    rt2 = m2.create_siddhi_app_runtime(ABSENT_QL)
+    fired2 = []
+    rt2.add_callback("q", lambda ts, i, o: fired2.extend(
+        [e.data for e in (i or [])]))
+    rt2.start()
+    m2.restore_last_revision()
+    # advance the restored clock past the (already-fired) deadline: a stale
+    # pending state for key 7 would fire again here
+    rt2.get_input_handler("S1").send([9, 9], timestamp=5000)
+    rt2.flush()
+    assert fired2 == []
+    m1.shutdown()
+    m2.shutdown()
+
+
+def test_pattern_emission_overflow_raises_without_emit_annotation(manager):
+    """With the implicit per-key emission cap, overflowing matches must
+    surface as a MatchOverflowError, not a warning that drops rows."""
+    rt = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream T (key long, v int);
+    partition with (key of T)
+    begin
+      @info(name='q')
+      from every e1=T[v == 1] -> e2=T[v == 2]
+      select e1.key as k
+      insert into M;
+    end;
+    """)
+    errs = []
+    rt.set_exception_listener(errs.append)
+    n = []
+    rt.add_batch_callback("q", lambda ts, b: n.append(b["n_current"]))
+    rt.start()
+    h = rt.get_input_handler("T")
+    # 20 completed matches for ONE key in ONE batch > implicit cap of 8
+    keys = np.zeros(40, np.int64)
+    vols = np.tile(np.array([1, 2], np.int32), 20)
+    h.send_columns([keys, vols],
+                   timestamps=np.arange(1000, 1040, dtype=np.int64))
+    rt.flush()
+    assert any(isinstance(e, MatchOverflowError) for e in errs), errs
+
+    # with @emit the cap is explicit: capped delivery, warning only
+    rt2 = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream T2 (key long, v int);
+    partition with (key of T2)
+    begin
+      @emit(rows='4')
+      @info(name='q2')
+      from every e1=T2[v == 1] -> e2=T2[v == 2]
+      select e1.key as k
+      insert into M2;
+    end;
+    """)
+    errs2 = []
+    rt2.set_exception_listener(errs2.append)
+    got = []
+    rt2.add_batch_callback("q2", lambda ts, b: got.append(b["n_current"]))
+    rt2.start()
+    h2 = rt2.get_input_handler("T2")
+    h2.send_columns([keys, vols],
+                    timestamps=np.arange(1000, 1040, dtype=np.int64))
+    rt2.flush()
+    assert errs2 == []
+    assert sum(got) == 4          # capped, delivered
+
+
+class _FlakyIncrementalStore(InMemoryIncrementalPersistenceStore):
+    def __init__(self, fail_increments: int):
+        super().__init__()
+        self.fail_increments = fail_increments
+        self.base_writes = 0
+
+    def save_base(self, app_name, revision, blob):
+        self.base_writes += 1
+        super().save_base(app_name, revision, blob)
+
+    def save_increment(self, app_name, revision, blob):
+        if self.fail_increments > 0:
+            self.fail_increments -= 1
+            raise IOError("disk full")
+        super().save_increment(app_name, revision, blob)
+
+
+def test_persistor_failure_surfaces_and_rebases():
+    """A failed async increment write must (1) raise from
+    wait_for_persistence and (2) force the next persist to write a fresh
+    base so the chain has no hole."""
+    m = SiddhiManager()
+    store = _FlakyIncrementalStore(fail_increments=1)
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(ABSENT_QL)
+    rt.start()
+    h = rt.get_input_handler("S1")
+    h.send([1, 1], timestamp=1000)
+    m.persist()                                   # base ok
+    m.wait_for_persistence()
+    h.send([2, 1], timestamp=1100)
+    m.persist()                                   # increment -> IOError
+    with pytest.raises(PersistenceError):
+        m.wait_for_persistence()
+    h.send([3, 1], timestamp=1200)
+    m.persist()                                   # must re-base, not stack
+    m.wait_for_persistence()
+    assert store.base_writes == 2
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(store)
+    rt2 = m2.create_siddhi_app_runtime(ABSENT_QL)
+    rt2.start()
+    m2.restore_last_revision()
+    # keys 1..3 all have live pending state in the restored runtime: all
+    # three fire their absent when the clock passes the deadline
+    fired = []
+    rt2.add_callback("q", lambda ts, i, o: fired.extend(
+        [e.data for e in (i or [])]))
+    rt2.get_input_handler("S1").send([9, 9], timestamp=9000)
+    rt2.flush()
+    assert sorted(fired) == [[1], [2], [3]]
+    m.shutdown()
+    m2.shutdown()
+
+
+def test_expression_window_capacity_forces_visible_eviction(manager):
+    """Retention beyond the slab capacity force-expires oldest rows as
+    EXPIRED events instead of silently truncating them."""
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (sym string, price float);
+    @capacity(window='4')
+    @info(name='q') from S#window.expression('count() <= 100')
+    select sym, price insert all events into Out;
+    """)
+    cur, exp = [], []
+    rt.add_callback("q", lambda ts, i, o: (
+        cur.extend([e.data for e in (i or [])]),
+        exp.extend([e.data for e in (o or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(6):
+        h.send([f"s{i}", float(i)], timestamp=1000 + i)
+    rt.flush()
+    assert [d[0] for d in cur] == [f"s{i}" for i in range(6)]
+    # expression never evicts; capacity 4 must evict s0 and s1 visibly
+    assert [d[0] for d in exp] == ["s0", "s1"]
+
+
+def test_expression_batch_window_capacity_force_flushes(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (sym string, price float);
+    @capacity(window='3')
+    @info(name='q') from S#window.expressionBatch('count() <= 100')
+    select sym, price insert into Out;
+    """)
+    cur = []
+    rt.add_callback("q", lambda ts, i, o: cur.append(
+        [e.data[0] for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(7):
+        h.send([f"s{i}", float(i)], timestamp=1000 + i)
+    rt.flush()
+    # expression never breaks; capacity 3 must flush pending runs visibly
+    flushed = [b for b in cur if b]
+    assert flushed, "capacity overflow must force-flush, not truncate"
+    assert [s for b in flushed for s in b] == [f"s{i}" for i in range(6)]
+
+
+def test_expression_batch_include_trigger_keeps_full_prev_batch(manager):
+    """include.triggering.event makes a force-flushed batch C+1 rows; the
+    prev slab must hold all of them for the next EXPIRED replay."""
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (sym string, price float);
+    @capacity(window='3')
+    @info(name='q')
+    from S#window.expressionBatch('count() <= 100', true)
+    select sym, price insert all events into Out;
+    """)
+    cur, exp = [], []
+    rt.add_callback("q", lambda ts, i, o: (
+        cur.append([e.data[0] for e in (i or [])]),
+        exp.append([e.data[0] for e in (o or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(8):
+        h.send([f"s{i}", float(i)], timestamp=1000 + i)
+    rt.flush()
+    flushes = [b for b in cur if b]
+    assert flushes[0] == ["s0", "s1", "s2", "s3"]     # C+1 rows w/ trigger
+    # the SECOND flush must replay the ENTIRE first batch as EXPIRED
+    replays = [b for b in exp if b]
+    assert replays and replays[0] == ["s0", "s1", "s2", "s3"], replays
+
+
+def test_connect_with_retry_is_bounded():
+    from siddhi_tpu.io.store import RecordTable, connect_with_retry
+
+    class _Dead(RecordTable):
+        attempts = 0
+
+        def connect(self):
+            _Dead.attempts += 1
+            raise ConnectionUnavailableException("down")
+
+    with pytest.raises(ConnectionUnavailableException):
+        connect_with_retry(_Dead(), "dead", max_attempts=5,
+                           _sleep=lambda s: None)
+    assert _Dead.attempts == 5

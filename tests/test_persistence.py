@@ -140,11 +140,18 @@ def test_tables_survive_snapshot():
     m2.shutdown()
 
 
-def test_async_persistor_runs_and_survives_errors():
+def test_async_persistor_surfaces_errors_and_survives():
+    from siddhi_tpu.exceptions import PersistenceError
     p = AsyncSnapshotPersistor()
     seen = []
     p.submit(seen.append, "a")
-    p.submit(lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    p.submit(lambda: (_ for _ in ()).throw(RuntimeError("boom")),
+             tag="bad-app")
     p.submit(seen.append, "b")
-    p.flush()
-    assert seen == ["a", "b"]
+    with pytest.raises(PersistenceError):   # failure is not swallowed
+        p.flush()
+    assert seen == ["a", "b"]               # ...but the thread survives
+    assert p.take_failed_tags() == {"bad-app"}
+    p.submit(seen.append, "c")              # still operational
+    p.flush()                               # no new errors -> no raise
+    assert seen == ["a", "b", "c"]
