@@ -7,15 +7,26 @@ aggregate by ts every sec...year` maintains running aggregates per duration
 bucket (seconds..years); avg decomposes into sum+count base attributes
 (incremental/AvgIncrementalAttributeAggregator.java:57-95); queries join
 against a duration's buckets `within` a time range (`per "days"`).
+Out-of-order events (OutOfOrderEventsDataAggregator.java:177), bucket
+purging (IncrementalDataPurger.java:307), restart rebuild from backing
+tables (IncrementalExecutorsInitialiser.java:203) and distributed shardId
+mode (AggregationParser.java:173-197) are part of the surface.
 
 TPU-native design (how): the reference cascades one executor per duration,
-rolling finer buckets into coarser on rollover.  Here the device computes the
-per-event base values (compiled expression stack -> [n_base, B] block); the
-host merges per-(group, bucket) partials — computed with vectorized
-np.unique/ufunc.at — into one dict store per duration.  No cascade is needed:
-sum/count/min/max merge identically into every duration directly.  Join and
-on-demand reads materialize a padded columnar snapshot (AGG_TIMESTAMP + the
-declared outputs) that drops into the existing table-join device path.
+rolling finer buckets into coarser on rollover, which is why it needs
+special out-of-order handling (only the current bucket is live in memory).
+Here each duration keeps a DEVICE-RESIDENT slab [n_base, capacity] of
+running base values; (group-key, bucket-start) pairs resolve to slab slots
+through the native SlotAllocator staging path and the per-event merge is a
+single jitted scatter (`.at[idx].add/min/max`) on device — no cascade, no
+per-bucket dicts, and any bucket (past or present) is updatable, so
+out-of-order arrival is the normal path, not a special case.  Purging
+frees slots back to the allocator and resets slab columns to the identity.
+With a @store annotation the slabs write through to per-duration record
+tables (rows tagged with the configured shardId); on start the slabs
+rebuild by merging table rows across every shard.  Join and on-demand
+reads materialize a padded columnar snapshot (AGG_TIMESTAMP + declared
+outputs) that drops into the existing table-join device path.
 """
 from __future__ import annotations
 
@@ -196,6 +207,78 @@ class _BaseAgg:
             np.add.at(acc, idx, vals)
 
 
+# reference retention defaults (IncrementalDataPurger.java:307 /
+# aggregation docs); None = keep forever ("all")
+_DEFAULT_RETENTION_MS = {
+    "SECONDS": 120_000,
+    "MINUTES": 24 * 3_600_000,
+    "HOURS": 30 * 86_400_000,
+    "DAYS": 366 * 86_400_000,
+    "MONTHS": None,
+    "YEARS": None,
+}
+
+_TIME_UNITS_MS = {
+    "ms": 1, "millisecond": 1, "milliseconds": 1,
+    "sec": 1000, "second": 1000, "seconds": 1000,
+    "min": 60_000, "minute": 60_000, "minutes": 60_000,
+    "hour": 3_600_000, "hours": 3_600_000,
+    "day": 86_400_000, "days": 86_400_000,
+    "month": 30 * 86_400_000, "months": 30 * 86_400_000,
+    "year": 365 * 86_400_000, "years": 365 * 86_400_000,
+}
+
+
+def parse_time_ms(s: str) -> Optional[int]:
+    """'120 sec' / '24 hours' / 'all' -> milliseconds (None = unbounded)."""
+    s = str(s).strip().lower()
+    if s == "all":
+        return None
+    parts = s.split()
+    if len(parts) == 2 and parts[1] in _TIME_UNITS_MS:
+        return int(float(parts[0]) * _TIME_UNITS_MS[parts[1]])
+    if s.isdigit():
+        return int(s)
+    raise CompileError(f"cannot parse time value {s!r}")
+
+
+class _DurationStore:
+    """Device-resident bucket slab for one duration: running base values
+    [n_base, capacity] indexed by slot, with (group-bits..., bucket) keys
+    resolved through the native SlotAllocator (reference role: the
+    per-duration BaseIncrementalValueStore maps + backing table)."""
+
+    def __init__(self, agg_name: str, dur: str, identities: np.ndarray,
+                 capacity: int):
+        from .keyslots import SlotAllocator
+        self.dur = dur
+        self.capacity = capacity
+        self.alloc = SlotAllocator(capacity, f"{agg_name}:{dur}")
+        self.identities = identities                    # [n_base] f64
+        self.slab = jnp.asarray(
+            np.tile(identities[:, None], (1, capacity)))
+        # slots written since the last table flush (@store write-through)
+        self.dirty = np.zeros(capacity, np.bool_)
+        # slots written since the last (incremental) snapshot baseline
+        self.snap_dirty = np.zeros(capacity, np.bool_)
+
+    def decode_keys(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(slots [n], key_words [n, ng+1] int64) for live slots."""
+        mapping = self.alloc.snapshot()
+        n = len(mapping)
+        if n == 0:
+            return np.zeros((0,), np.int64), np.zeros((0, 1), np.int64)
+        slots = np.fromiter(mapping.values(), np.int64, n)
+        words = np.frombuffer(b"".join(mapping.keys()), np.int64)
+        return slots, words.reshape(n, -1)
+
+    def reset_slots(self, slots: np.ndarray) -> None:
+        if len(slots):
+            self.slab = self.slab.at[:, jnp.asarray(slots)].set(
+                jnp.asarray(self.identities)[:, None])
+            self.dirty[slots] = False
+
+
 class _Output:
     """One declared output attribute and how to finalize it from base
     values (reference: IncrementalAttributeAggregator SPI)."""
@@ -269,9 +352,62 @@ class AggregationRuntime:
 
         self.durations = [normalize_duration(d) for d in adef.time_periods] \
             or ["SECONDS"]
-        # store per duration: {(gkey..., bucket_start): np.ndarray[n_base]}
-        self.stores: Dict[str, Dict[tuple, np.ndarray]] = {
-            d: {} for d in self.durations}
+        self._identities = np.array([b.identity() for b in self.base],
+                                    np.float64)
+        cap_ann = adef.get_annotation("capacity") if \
+            hasattr(adef, "get_annotation") else None
+        self.bucket_capacity = int(cap_ann.element("buckets")) \
+            if cap_ann is not None and cap_ann.element("buckets") else 1 << 16
+        self._dstores: Dict[str, _DurationStore] = {
+            d: _DurationStore(adef.id, d, self._identities,
+                              self.bucket_capacity)
+            for d in self.durations}
+
+        # retention per duration: defaults from the reference, overridable
+        # with @retentionPeriod(sec='120 sec', min='24 hours', ..., or 'all')
+        self.retention_ms: Dict[str, Optional[int]] = {
+            d: _DEFAULT_RETENTION_MS[d] for d in self.durations}
+        ret_ann = adef.get_annotation("retentionPeriod") if \
+            hasattr(adef, "get_annotation") else None
+        if ret_ann is not None:
+            alias = {"sec": "SECONDS", "min": "MINUTES", "hours": "HOURS",
+                     "days": "DAYS", "months": "MONTHS", "years": "YEARS"}
+            for k, dur in alias.items():
+                v = ret_ann.element(k)
+                if v is not None and dur in self.retention_ms:
+                    self.retention_ms[dur] = parse_time_ms(v)
+        # @purge(enable='true'|'false', interval='10 sec')
+        purge_ann = adef.get_annotation("purge") if \
+            hasattr(adef, "get_annotation") else None
+        self.purge_enabled = True
+        self.purge_interval_ms = 15_000
+        if purge_ann is not None:
+            if purge_ann.element("enable") is not None:
+                self.purge_enabled = str(
+                    purge_ann.element("enable")).lower() == "true"
+            if purge_ann.element("interval") is not None:
+                iv = parse_time_ms(purge_ann.element("interval"))
+                if not iv or iv <= 0:
+                    raise CompileError(
+                        f"@purge interval must be a positive time value, "
+                        f"got {purge_ann.element('interval')!r}")
+                self.purge_interval_ms = iv
+
+        # distributed mode: rows written to the backing store are tagged
+        # with this process's shardId; reads merge across shards
+        # (reference: AggregationParser :173-197, shardId system config)
+        sysconf = {}
+        if getattr(app, "config_manager", None) is not None:
+            try:
+                sysconf = app.config_manager.extract_system_configs() or {}
+            except Exception:   # noqa: BLE001 — config is best-effort
+                sysconf = {}
+        self.shard_id = str(sysconf.get("shardId", ""))
+        self._store_tables: Dict[str, object] = {}
+        store_ann = adef.get_annotation("store") if \
+            hasattr(adef, "get_annotation") else None
+        if store_ann is not None:
+            self._init_store_tables(store_ann)
 
         # device step: batch -> (valid mask, stacked base values)
         filters = self._filters
@@ -292,6 +428,27 @@ class AggregationRuntime:
             return keep, jnp.stack(vals) if vals else jnp.zeros((0,) + ts.shape)
 
         self._step = jax.jit(step)
+
+        # device merge: one scatter per base row into the duration slab
+        kinds = tuple(b.kind for b in self.base)
+        cap = self.bucket_capacity
+
+        def merge(slab, idx, vals):
+            # idx: [B] int32, -1 (invalid) mapped out-of-bounds -> dropped
+            ii = jnp.where(idx >= 0, idx, cap)
+            rows = []
+            for bi, k in enumerate(kinds):
+                r = slab[bi]
+                if k == "min":
+                    r = r.at[ii].min(vals[bi], mode="drop")
+                elif k == "max":
+                    r = r.at[ii].max(vals[bi], mode="drop")
+                else:
+                    r = r.at[ii].add(vals[bi], mode="drop")
+                rows.append(r)
+            return jnp.stack(rows)
+
+        self._merge = jax.jit(merge, donate_argnums=(0,))
 
     # -- construction ---------------------------------------------------------
     def _decompose(self, selector, scope: Scope) -> None:
@@ -358,26 +515,31 @@ class AggregationRuntime:
 
     # -- ingestion ------------------------------------------------------------
     def process_staged(self, staged: ev.StagedBatch, now: int) -> None:
+        """Merge a batch into every duration slab.  Any bucket (past or
+        future) is addressable, so out-of-order events need no special
+        path (reference: OutOfOrderEventsDataAggregator.java:177)."""
         batch = staged.to_device(self.in_schema)
-        keep, vals = self._step(
+        keep_d, vals_d = self._step(
             batch.ts, batch.kind, batch.valid, batch.cols,
             jnp.asarray(now, jnp.int64))
-        keep = np.asarray(keep)
+        keep = np.asarray(keep_d)
         if not keep.any():
             return
-        vals = np.asarray(vals)          # [n_base, B]
         ts = (staged.cols[self.ts_pos].astype(np.int64)
               if self.ts_pos >= 0 else staged.ts)
         gcols = [staged.cols[p] for p in self.group_positions]
 
-        idx = np.nonzero(keep)[0]
-        ts = ts[idx]
-        vals = vals[:, idx]
-        gcols = [c[idx] for c in gcols]
-
         with self._lock:
             for dur in self.durations:
-                self._merge_duration(dur, ts, gcols, vals)
+                ds = self._dstores[dur]
+                buckets = truncate_buckets(ts, dur)
+                key_cols = [self._bits(c) for c in gcols] + [buckets]
+                slots = ds.alloc.slots_for(key_cols, valid=keep)
+                ds.slab = self._merge(ds.slab, jnp.asarray(slots), vals_d)
+                live = slots[slots >= 0]
+                if live.size:
+                    ds.dirty[live] = True
+                    ds.snap_dirty[live] = True
 
     @staticmethod
     def _bits(col: np.ndarray) -> np.ndarray:
@@ -386,28 +548,34 @@ class AggregationRuntime:
             return col.astype(np.float64).view(np.int64)
         return col.astype(np.int64)
 
-    def _merge_duration(self, dur: str, ts, gcols, vals) -> None:
-        buckets = truncate_buckets(ts, dur)
-        # dense (group..., bucket) segmenting
-        key_cols = [self._bits(c) for c in gcols] + [buckets]
-        stacked = np.stack(key_cols)
-        uniq, inv = np.unique(stacked, axis=1, return_inverse=True)
-        n = uniq.shape[1]
-        store = self.stores[dur]
-        partial = np.empty((len(self.base), n))
-        for bi, b in enumerate(self.base):
-            acc = np.full((n,), b.identity())
-            b.np_reduce_at(acc, inv, vals[bi])
-            partial[bi] = acc
-        for j in range(n):
-            key = tuple(int(uniq[ci, j]) for ci in range(len(key_cols)))
-            old = store.get(key)
-            if old is None:
-                store[key] = partial[:, j].copy()
-            else:
-                store[key] = np.array([
-                    b.merge(old[bi], partial[bi, j])
-                    for bi, b in enumerate(self.base)])
+    # -- purging (reference: IncrementalDataPurger.java:307) ------------------
+    def on_timer(self, now: int) -> None:
+        if self.purge_enabled:
+            self.purge_old(now)
+        if self._store_tables:
+            self.flush_to_store()
+        self.app._scheduler.notify_at(now + self.purge_interval_ms, self)
+
+    def purge_old(self, now: int) -> None:
+        """Free buckets past their duration's retention period; their slots
+        recycle through the allocator free list."""
+        with self._lock:
+            for dur in self.durations:
+                ret = self.retention_ms.get(dur)
+                if ret is None:
+                    continue
+                ds = self._dstores[dur]
+                slots, words = ds.decode_keys()
+                if not len(slots):
+                    continue
+                old = words[:, -1] < (now - ret)
+                if old.any():
+                    # store rows for purged buckets vanish at the next
+                    # flush (flush_to_store rewrites this shard wholesale)
+                    doomed = slots[old]
+                    ds.alloc.purge(doomed.tolist())
+                    ds.reset_slots(doomed)
+                    ds.dirty[doomed] = True     # force a table rewrite
 
     # -- reads ----------------------------------------------------------------
     @property
@@ -425,33 +593,46 @@ class AggregationRuntime:
             sdef.attribute(n, t)
         return ev.Schema(sdef, self.app.interner)
 
+    def _local_rows(self, per: str) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys [n, ng+1] int64 — group bits then bucket, base [n, n_base])
+        from this process's device slab."""
+        ds = self._dstores[per]
+        with self._lock:
+            slots, words = ds.decode_keys()
+            slab = np.asarray(ds.slab)
+        if not len(slots):
+            return (np.zeros((0, len(self.group_positions) + 1), np.int64),
+                    np.zeros((0, len(self.base))))
+        return words, slab[:, slots].T
+
     def snapshot_rows(self, per: str, within: Optional[Tuple[int, int]]
                       ) -> Tuple[np.ndarray, List[np.ndarray]]:
         """Materialize (bucket_ts[n], out_cols) for duration `per` within
         the [start, end) range (reference: AggregationRuntime.find +
-        IncrementalDataAggregator combining table + running values)."""
+        IncrementalDataAggregator combining table + running values).  In
+        distributed mode rows from OTHER shards merge in from the backing
+        table (reference: shardId reads, AggregationParser :464-470)."""
         per = normalize_duration(per)
-        if per not in self.stores:
+        if per not in self._dstores:
             raise CompileError(
                 f"aggregation {self.definition.id!r} has no duration "
                 f"{per!r}; declared: {self.durations}")
-        with self._lock:
-            items = list(self.stores[per].items())
+        keys, base = self._local_rows(per)
+        if self._store_tables:
+            okeys, obase = self._other_shard_rows(per)
+            if len(okeys):
+                keys, base = self._merge_rows(
+                    np.concatenate([keys, okeys]),
+                    np.concatenate([base, obase]))
         if within is not None:
             s, e = within
-            items = [(k, v) for k, v in items if s <= k[-1] < e]
-        n = len(items)
-        ng = len(self.group_positions)
-        ts = np.array([k[-1] for k, _ in items], np.int64) if n else \
-            np.zeros((0,), np.int64)
-        base = np.stack([v for _, v in items]) if n else \
-            np.zeros((0, len(self.base)))
-        gkeys = [np.array([k[gi] for k, _ in items], np.int64) if n else
-                 np.zeros((0,), np.int64) for gi in range(ng)]
+            m = (keys[:, -1] >= s) & (keys[:, -1] < e)
+            keys, base = keys[m], base[m]
+        ts = keys[:, -1].copy() if len(keys) else np.zeros((0,), np.int64)
         cols: List[np.ndarray] = [ts]
         for o in self.outputs:
             if o.kind == "group":
-                bits = gkeys[o.group_pos]
+                bits = keys[:, o.group_pos].copy()
                 if o.type in ("FLOAT", "DOUBLE"):
                     cols.append(bits.view(np.float64).astype(
                         ev.np_dtype(o.type)))
@@ -460,3 +641,205 @@ class AggregationRuntime:
             else:
                 cols.append(o.finalize(base).astype(ev.np_dtype(o.type)))
         return ts, cols
+
+    def _merge_rows(self, keys: np.ndarray, base: np.ndarray
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Merge duplicate (group..., bucket) rows with each base's rule."""
+        uniq, inv = np.unique(keys, axis=0, return_inverse=True)
+        out = np.tile(self._identities, (len(uniq), 1))
+        for bi, b in enumerate(self.base):
+            b.np_reduce_at(out[:, bi], inv, base[:, bi])
+        return uniq, out
+
+    # -- snapshot compatibility (runtime.snapshot reads/writes `stores`) ------
+    @property
+    def stores(self) -> Dict[str, Dict[tuple, np.ndarray]]:
+        out: Dict[str, Dict[tuple, np.ndarray]] = {}
+        for dur in self.durations:
+            keys, base = self._local_rows(dur)
+            out[dur] = {tuple(int(w) for w in keys[i]): base[i].copy()
+                        for i in range(len(keys))}
+        return out
+
+    @stores.setter
+    def stores(self, value: Dict[str, Dict[tuple, np.ndarray]]) -> None:
+        with self._lock:
+            for dur in self.durations:
+                ds = self._dstores[dur]
+                ds.alloc.restore({})
+                ds.slab = jnp.asarray(
+                    np.tile(self._identities[:, None],
+                            (1, self.bucket_capacity)))
+                ds.dirty[:] = False
+                mapping = value.get(dur) or {}
+                if not mapping:
+                    continue
+                keys = np.array(list(mapping.keys()), np.int64)
+                rows = np.stack([np.asarray(v, np.float64)
+                                 for v in mapping.values()])
+                cols = [np.ascontiguousarray(keys[:, i])
+                        for i in range(keys.shape[1])]
+                slots = ds.alloc.slots_for(cols)
+                ds.slab = ds.slab.at[:, jnp.asarray(slots)].set(
+                    jnp.asarray(rows.T))
+
+    def snapshot_delta(self) -> Dict[str, Dict[tuple, np.ndarray]]:
+        """Buckets written since the last snapshot baseline (per duration),
+        as absolute rows; resets the baseline.  Keeps incremental persists
+        proportional to CHANGE, not slab capacity."""
+        out: Dict[str, Dict[tuple, np.ndarray]] = {}
+        with self._lock:
+            for dur in self.durations:
+                ds = self._dstores[dur]
+                idx = np.nonzero(ds.snap_dirty)[0]
+                if not len(idx):
+                    out[dur] = {}
+                    continue
+                ds.snap_dirty[:] = False
+                slots, words = ds.decode_keys()
+                live = np.isin(slots, idx)     # dirty AND currently bound
+                if not live.any():
+                    out[dur] = {}
+                    continue
+                slab = np.asarray(ds.slab)
+                lslots, lwords = slots[live], words[live]
+                rows = slab[:, lslots].T
+                out[dur] = {tuple(int(x) for x in lwords[i]): rows[i].copy()
+                            for i in range(len(lslots))}
+        return out
+
+    def apply_delta(self, value: Dict[str, Dict[tuple, np.ndarray]]) -> None:
+        """Overwrite the given buckets with rows from an incremental
+        snapshot (values are absolute, not diffs)."""
+        with self._lock:
+            for dur, mapping in (value or {}).items():
+                ds = self._dstores.get(dur)
+                if ds is None or not mapping:
+                    continue
+                keys = np.array(list(mapping.keys()), np.int64)
+                rows = np.stack([np.asarray(v, np.float64)
+                                 for v in mapping.values()])
+                cols = [np.ascontiguousarray(keys[:, i])
+                        for i in range(keys.shape[1])]
+                slots = ds.alloc.slots_for(cols)
+                ds.slab = ds.slab.at[:, jnp.asarray(slots)].set(
+                    jnp.asarray(rows.T))
+
+    def clear_snapshot_baseline(self) -> None:
+        with self._lock:
+            for ds in self._dstores.values():
+                ds.snap_dirty[:] = False
+
+    # -- @store backing tables (reference: AggregationParser table-per-
+    #    duration + IncrementalExecutorsInitialiser.java:203) ----------------
+    def _store_schema(self):
+        from ..query_api.definition import StreamDefinition
+        sdef = StreamDefinition(self.definition.id + "_STORE")
+        sdef.attribute("SHARD_ID", "STRING")
+        sdef.attribute("AGG_TIMESTAMP", "LONG")
+        for n, t in zip(self.group_names, self.group_types):
+            sdef.attribute(n, t)
+        for i in range(len(self.base)):
+            sdef.attribute(f"_b{i}", "DOUBLE")
+        return sdef
+
+    def _init_store_tables(self, store_ann) -> None:
+        from ..io.store import connect_with_retry, create_store
+        props = {k: v for k, v in (store_ann.elements or {}).items()
+                 if k != "type"}
+        sdef = self._store_schema()
+        schema = ev.Schema(sdef, self.app.interner)
+        for dur in self.durations:
+            from ..query_api.definition import TableDefinition
+            tdef = TableDefinition(f"{self.definition.id}_{dur}")
+            st = create_store(store_ann.element("type"), tdef, schema, props)
+            connect_with_retry(st, tdef.id)
+            self._store_tables[dur] = st
+        self.rebuild_from_store()
+
+    def _row_decoders(self):
+        dec = []
+        for t in self.group_types:
+            if t.upper() == "STRING":
+                dec.append(self.app.interner.lookup)
+            else:
+                dec.append(None)
+        return dec
+
+    def flush_to_store(self) -> None:
+        """Write this shard's live buckets through to the per-duration
+        tables.  Rewrite is wholesale per shard but skipped entirely for
+        durations with no writes since the last flush (dirty mask)."""
+        dec = self._row_decoders()
+        for dur, st in self._store_tables.items():
+            ds = self._dstores[dur]
+            if not ds.dirty.any():
+                continue
+            ds.dirty[:] = False
+            keys, base = self._local_rows(dur)
+            rows = []
+            for i in range(len(keys)):
+                gvals = []
+                for gi, d in enumerate(dec):
+                    bits = int(keys[i, gi])
+                    if d is not None:
+                        gvals.append(d(bits))
+                    elif self.group_types[gi].upper() in ("FLOAT", "DOUBLE"):
+                        gvals.append(float(
+                            np.int64(bits).view(np.float64)))
+                    else:
+                        gvals.append(bits)
+                rows.append(tuple([self.shard_id, int(keys[i, -1])] + gvals +
+                                  [float(v) for v in base[i]]))
+            stale = [r for r in st.read_all() if r[0] == self.shard_id]
+            if stale:
+                st.delete_rows(stale)
+            if rows:
+                st.add(rows)
+
+    def _table_keyed_rows(self, per: str, include_own: bool
+                          ) -> Tuple[np.ndarray, np.ndarray]:
+        st = self._store_tables.get(per)
+        ng = len(self.group_positions)
+        if st is None:
+            return (np.zeros((0, ng + 1), np.int64),
+                    np.zeros((0, len(self.base))))
+        keys, base = [], []
+        for r in st.read_all():
+            if (r[0] == self.shard_id) != include_own:
+                continue
+            gbits = []
+            for gi, t in enumerate(self.group_types):
+                v = r[2 + gi]
+                tu = t.upper()
+                if tu == "STRING":
+                    gbits.append(self.app.interner.intern(v))
+                elif tu in ("FLOAT", "DOUBLE"):
+                    gbits.append(int(np.float64(v).view(np.int64)))
+                else:
+                    gbits.append(int(v))
+            keys.append(gbits + [int(r[1])])
+            base.append([float(x) for x in r[2 + ng:2 + ng + len(self.base)]])
+        if not keys:
+            return (np.zeros((0, ng + 1), np.int64),
+                    np.zeros((0, len(self.base))))
+        return np.array(keys, np.int64), np.array(base, np.float64)
+
+    def _other_shard_rows(self, per: str):
+        return self._table_keyed_rows(per, include_own=False)
+
+    def rebuild_from_store(self) -> None:
+        """Recreate this shard's in-memory slabs from its table rows
+        (reference: IncrementalExecutorsInitialiser.java:203)."""
+        with self._lock:
+            for dur in self.durations:
+                keys, base = self._table_keyed_rows(dur, include_own=True)
+                if not len(keys):
+                    continue
+                ds = self._dstores[dur]
+                cols = [np.ascontiguousarray(keys[:, i])
+                        for i in range(keys.shape[1])]
+                slots = ds.alloc.slots_for(cols)
+                ds.slab = ds.slab.at[:, jnp.asarray(slots)].set(
+                    jnp.asarray(base.T))
+                ds.dirty[slots] = True

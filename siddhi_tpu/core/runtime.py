@@ -467,6 +467,7 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
     if not (qr.callbacks or qr.batch_callbacks or target_live):
         return
     counts = None
+    overflow_exc = None
     if len(out) == 6:
         n_valid, n_dropped, ots, okind, ovalid, ocols = out
         if header is None:
@@ -475,64 +476,74 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
         if nd:
             if not getattr(qr.planned, "emit_explicit", True):
                 # the cap was an implicit default: losing matches silently
-                # is a correctness hole, so surface it as a processing error
-                # (fault stream / exception listener via the junction)
-                raise MatchOverflowError(
+                # is a correctness hole.  Deliver the in-capacity rows
+                # first, THEN surface the loss as a processing error (fault
+                # stream / exception listener via the junction) — raised in
+                # the finally below so the error reports partial loss, not
+                # total loss.
+                overflow_exc = MatchOverflowError(
                     f"{qr.name}: {nd} pattern match rows exceeded the "
                     f"implicit per-key emission capacity this batch; set "
                     f"@emit(rows='N') on the query to raise the cap or "
                     f"accept capped delivery")
-            import logging
-            logging.getLogger("siddhi_tpu").warning(
-                "%s: %d pattern match rows exceeded the per-key emission "
-                "capacity this batch and were dropped", qr.name, nd)
-        if nv == 0:
-            return
+            else:
+                import logging
+                logging.getLogger("siddhi_tpu").warning(
+                    "%s: %d pattern match rows exceeded the per-key "
+                    "emission capacity this batch and were dropped",
+                    qr.name, nd)
         # pattern matches are always CURRENT-kind rows
         counts = {"n_valid": nv, "n_current": nv, "n_expired": 0,
                   "n_dropped": nd}
-    else:
-        ots, okind, ovalid, ocols = out
-        ovalid_np = np.asarray(ovalid)
-        if not ovalid_np.any():
+    try:
+        if len(out) == 6:
+            if nv == 0:
+                return
+        else:
+            ots, okind, ovalid, ocols = out
+            ovalid_np = np.asarray(ovalid)
+            if not ovalid_np.any():
+                return
+        if qr.batch_callbacks:
+            payload = _LazyBatchPayload(p.out_schema.names, ots, okind,
+                                        ovalid, ocols, counts)
+            for bcb in qr.batch_callbacks:
+                bcb(now, payload)
+        if not qr.callbacks and not target_live:
             return
-    if qr.batch_callbacks:
-        payload = _LazyBatchPayload(p.out_schema.names, ots, okind,
-                                    ovalid, ocols, counts)
-        for bcb in qr.batch_callbacks:
-            bcb(now, payload)
-    if not qr.callbacks and not target_live:
-        return
-    if len(out) == 6:
-        # pattern outputs are compacted [R,K] rank-major on device; fetch
-        # them now and restore timestamp order for event delivery with a
-        # host-side stable sort of just the valid rows (O(matches), runs on
-        # the drainer thread)
-        ts_np, okind, ovalid_np, ocols = jax.device_get(
-            (ots, okind, ovalid, ocols))
-        idxv = np.nonzero(ovalid_np)[0]
-        order = idxv[np.argsort(ts_np[idxv], kind="stable")]
-        ots = ts_np[order]
-        okind = np.asarray(okind)[order]
-        ocols = tuple(np.asarray(c)[order] for c in ocols)
-        ovalid = np.ones(order.shape[0], np.bool_)
-    batch = ev.EventBatch(ots, okind, ovalid, ocols)
-    pairs = ev.unpack(p.out_schema, batch,
-                      want_kinds=(ev.CURRENT, ev.EXPIRED))
-    if not pairs:
-        return
-    if getattr(qr, "table_op", None) is not None:
-        current = [e for k, e in pairs if k == ev.CURRENT]
-        expired = [e for k, e in pairs if k == ev.EXPIRED]
-        for cb in qr.callbacks:
-            cb(now, current or None, expired or None)
-        _apply_table_op(qr, ots, okind, ovalid, ocols, now)
-        return
-    limiter = getattr(qr, "rate_limiter", None)
-    if limiter is not None:
-        limiter.process(pairs, now)
-        return
-    _deliver_pairs(qr, pairs, now)
+        if len(out) == 6:
+            # pattern outputs are compacted [R,K] rank-major on device;
+            # fetch them now and restore timestamp order for event delivery
+            # with a host-side stable sort of just the valid rows
+            # (O(matches), runs on the drainer thread)
+            ts_np, okind, ovalid_np, ocols = jax.device_get(
+                (ots, okind, ovalid, ocols))
+            idxv = np.nonzero(ovalid_np)[0]
+            order = idxv[np.argsort(ts_np[idxv], kind="stable")]
+            ots = ts_np[order]
+            okind = np.asarray(okind)[order]
+            ocols = tuple(np.asarray(c)[order] for c in ocols)
+            ovalid = np.ones(order.shape[0], np.bool_)
+        batch = ev.EventBatch(ots, okind, ovalid, ocols)
+        pairs = ev.unpack(p.out_schema, batch,
+                          want_kinds=(ev.CURRENT, ev.EXPIRED))
+        if not pairs:
+            return
+        if getattr(qr, "table_op", None) is not None:
+            current = [e for k, e in pairs if k == ev.CURRENT]
+            expired = [e for k, e in pairs if k == ev.EXPIRED]
+            for cb in qr.callbacks:
+                cb(now, current or None, expired or None)
+            _apply_table_op(qr, ots, okind, ovalid, ocols, now)
+            return
+        limiter = getattr(qr, "rate_limiter", None)
+        if limiter is not None:
+            limiter.process(pairs, now)
+            return
+        _deliver_pairs(qr, pairs, now)
+    finally:
+        if overflow_exc is not None:
+            raise overflow_exc
 
 
 def _aggregation_view(agg, per: str, within) -> Tuple:
@@ -1133,6 +1144,11 @@ class SiddhiAppRuntime:
                     self._a.process_staged(staged, now)
 
             self.junctions[agg.input_stream_id].subscribe_query(_ASub(agg))
+            if agg.purge_enabled or agg._store_tables:
+                # periodic retention purge + store write-through
+                # (reference: IncrementalDataPurger scheduled executor)
+                self._scheduler.notify_at(
+                    self.timestamp_millis() + agg.purge_interval_ms, agg)
 
         # triggers define a stream `<id> (triggered_time long)` (reference:
         # QAPI/definition/TriggerDefinition -> DefinitionParserHelper)
@@ -1687,6 +1703,8 @@ class SiddhiAppRuntime:
                 alloc = _allocator_of(qr)
                 if alloc is not None:
                     alloc.journal.clear()
+            for a in self.aggregations.values():
+                a.clear_snapshot_baseline()
             return pickle.dumps(payload)
 
     def snapshot_incremental(self) -> bytes:
@@ -1732,9 +1750,10 @@ class SiddhiAppRuntime:
                 "windows": {
                     wid: jax.tree.map(lambda x: np.asarray(x), nw.state)
                     for wid, nw in self.named_windows.items()},
-                "aggregations": {
-                    aid: {d: dict(s) for d, s in a.stores.items()}
-                    for aid, a in self.aggregations.items()},
+                # delta: only buckets written since the last baseline
+                "aggregations": {aid: a.snapshot_delta()
+                                 for aid, a in self.aggregations.items()},
+                "agg_delta": True,
                 "tables": {tid: _table_state(t)
                            for tid, t in self.tables.items()},
                 "interner": list(self.interner._to_str),
@@ -1802,9 +1821,14 @@ class SiddhiAppRuntime:
             if nw is not None:
                 nw.state = jax.tree.map(
                     lambda x: jax.numpy.asarray(x), wstate)
+        agg_delta = payload.get("agg_delta", False)
         for aid, stores in payload.get("aggregations", {}).items():
             agg = self.aggregations.get(aid)
-            if agg is not None:
+            if agg is None:
+                continue
+            if agg_delta:
+                agg.apply_delta(stores)
+            else:
                 agg.stores = {d: dict(s) for d, s in stores.items()}
         for tid, tdata in payload.get("tables", {}).items():
             t = self.tables.get(tid)

@@ -96,6 +96,8 @@ def test_pattern_emission_overflow_raises_without_emit_annotation(manager):
                    timestamps=np.arange(1000, 1040, dtype=np.int64))
     rt.flush()
     assert any(isinstance(e, MatchOverflowError) for e in errs), errs
+    # the in-capacity rows are still delivered (partial loss, not total)
+    assert sum(n) == 8, n
 
     # with @emit the cap is explicit: capped delivery, warning only
     rt2 = manager.create_siddhi_app_runtime("""
