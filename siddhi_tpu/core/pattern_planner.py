@@ -139,6 +139,10 @@ class PlannedPatternQuery:
     # False when the per-key emission cap is an implicit default: overflow
     # then raises instead of dropping rows (@emit(rows=N) opts into capping)
     emit_explicit: bool = True
+    # range partitions: stream_id -> host fn(staged) -> (key_cols, valid)
+    # overriding positional key extraction (reference:
+    # RangePartitionExecutor.java:45)
+    partition_key_fns: Optional[Dict[str, Callable]] = None
 
 
 def plan_pattern_query(
@@ -150,6 +154,7 @@ def plan_pattern_query(
     slots: int = 8,
     count_cap: int = 8,
     partition_positions: Optional[Dict[str, List[int]]] = None,
+    partition_key_fns: Optional[Dict[str, Callable]] = None,
     mesh=None,
     script_functions=None,
 ) -> PlannedPatternQuery:
@@ -312,6 +317,7 @@ def plan_pattern_query(
         timer_step=timer_step, init_state=init_state,
         key_capacity=key_capacity, slots=slots,
         partition_positions=partition_positions,
+        partition_key_fns=partition_key_fns,
         raw_steps=raw_steps, mesh=mesh, emit_explicit=emit_explicit)
 
 

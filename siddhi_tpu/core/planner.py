@@ -56,10 +56,37 @@ class PlannedQuery:
     batch_capacity: int
     needs_timer: bool
     in_deps: List[str] = dataclasses.field(default_factory=list)
+    # range partitions: host fn(staged) -> (key_id col int32, valid mask);
+    # rows matching no range are excluded (reference:
+    # RangePartitionExecutor.java:45 returns null -> event dropped)
+    partition_key_fn: Optional[Callable] = None
+    # keyed windows (windows inside partitions): one window state per
+    # partition key, vmapped over the key axis
+    keyed_window: bool = False
+    window_key_allocator: Optional[SlotAllocator] = None
+    window_key_positions: Optional[List[int]] = None
+    key_capacity: int = 0
 
 
 def _env_for(scope_key: str, cols, ts):
     return {scope_key: cols, "__ts__": ts}
+
+
+def _apply_chain(chain, env, sid, cols, keep, data_row):
+    """Run a filter/stream-fn handler chain over columnar rows.  Filters
+    only gate `data_row` rows (TIMER/RESET pass through untouched)."""
+    for entry in chain:
+        if entry[0] == "filter":
+            m = entry[1].fn(env)
+            keep = jnp.logical_and(
+                keep, jnp.logical_or(jnp.logical_not(data_row), m))
+        else:
+            _, dtypes, fn = entry
+            new_cols, keep = fn(env, keep)
+            cols = cols + tuple(
+                jnp.asarray(c, d) for c, d in zip(new_cols, dtypes))
+            env[sid] = cols
+    return env, cols, keep
 
 
 def plan_single_query(
@@ -72,6 +99,9 @@ def plan_single_query(
     group_slots: int = 4096,
     window_capacity_hint: int = 2048,
     partition_positions: Optional[List[int]] = None,
+    partition_key_fn: Optional[Callable] = None,
+    window_key_allocator: Optional[SlotAllocator] = None,
+    key_capacity: int = 0,
     named_window_input: bool = False,
     config_manager=None,
     script_functions=None,
@@ -179,14 +209,18 @@ def plan_single_query(
         raise CompileError(
             "group by on stream-function-appended attributes is not yet "
             "supported")
+    keyed_window = bool(
+        (partition_positions or partition_key_fn) and seen_window)
+    if keyed_window and (window_key_allocator is None or key_capacity <= 0):
+        raise CompileError(
+            "windows inside partitions need the partition's key allocator")
     if partition_positions:
-        if seen_window:
-            raise CompileError(
-                "windows inside partitions land in a later phase")
         if sel.has_aggregation or gpos:
             gpos = [p for p in partition_positions if p not in gpos] + gpos
-    allocator = SlotAllocator(group_slots, name=f"{name}:groupby") if gpos \
-        else None
+    needs_alloc = bool(gpos) or (
+        partition_key_fn is not None and (sel.has_aggregation or gpos))
+    allocator = SlotAllocator(group_slots, name=f"{name}:groupby") \
+        if needs_alloc else None
 
     out_event_type = (query.output_stream.output_event_type
                       if query.output_stream and
@@ -210,17 +244,8 @@ def plan_single_query(
             # expired rows must pass the same filters so signed aggregation
             # stays balanced (reference: filter sits after the shared window)
             is_current = jnp.logical_or(is_current, kind == ev.EXPIRED)
-        for entry in pre_chain:
-            if entry[0] == "filter":
-                m = entry[1].fn(env)
-                keep = jnp.logical_and(
-                    keep, jnp.logical_or(jnp.logical_not(is_current), m))
-            else:
-                _, dtypes, fn = entry
-                new_cols, keep = fn(env, keep)
-                cols = cols + tuple(
-                    jnp.asarray(c, d) for c, d in zip(new_cols, dtypes))
-                env[sid] = cols
+        env, cols, keep = _apply_chain(pre_chain, env, sid, cols, keep,
+                                       is_current)
         rows = Rows(ts=ts, kind=kind, valid=keep,
                     seq=jnp.zeros_like(ts), gslot=gslot, cols=cols)
         wstate, wout = wproc.process(wstate, rows, now)
@@ -231,31 +256,88 @@ def plan_single_query(
             if k.startswith("__in__:"):
                 env2[k] = v
         if post_chain:
-            keep2 = orows.valid
-            oc = orows.kind == ev.CURRENT
-            oe = orows.kind == ev.EXPIRED
-            data_row = jnp.logical_or(oc, oe)
-            ocols = orows.cols
-            for entry in post_chain:
-                if entry[0] == "filter":
-                    m = entry[1].fn(env2)
-                    keep2 = jnp.logical_and(
-                        keep2, jnp.logical_or(jnp.logical_not(data_row), m))
-                else:
-                    _, dtypes, fn = entry
-                    new_cols, keep2 = fn(env2, keep2)
-                    ocols = ocols + tuple(
-                        jnp.asarray(c, d) for c, d in zip(new_cols, dtypes))
-                    env2[sid] = ocols
+            data_row = jnp.logical_or(orows.kind == ev.CURRENT,
+                                      orows.kind == ev.EXPIRED)
+            env2, ocols, keep2 = _apply_chain(
+                post_chain, env2, sid, orows.cols, orows.valid, data_row)
             orows = orows._replace(valid=keep2, cols=ocols)
         astate, (ots, okind, ovalid, ocols) = sel.process(astate, orows, env2)
         return ((wstate, astate), (ots, okind, ovalid, ocols),
                 wout.next_wakeup)
 
-    jit_step = jax.jit(step, donate_argnums=(0,))
+    if keyed_window:
+        # ---- keyed window: one window state per partition key ------------
+        # The window processor is a pure (state, rows, now) -> (state', out)
+        # function, so per-key isolation is jax.vmap over a [K, ...] state
+        # slab with events arranged [Kb, E] per key (same layout as the
+        # pattern NFA path).  Reference semantics: each partition key owns a
+        # private window instance (PartitionRuntimeImpl clone-per-key).
+        K = key_capacity
 
-    def init_state():
-        return (wproc.init_state(), sel.init_state())
+        def kstep(state, ts, kind, valid, cols, gslot, key_idx, sel_idx,
+                  now, in_tabs=()):
+            wslab, astate = state
+            env = {sid: cols, "__ts__": ts, "__now__": now,
+                   "__kind__": kind}
+            for dep, (tcol0, tvalid) in zip(in_deps, in_tabs):
+                def probe(vals, _tc=tcol0, _tv=tvalid):
+                    return jnp.any(jnp.logical_and(
+                        vals[:, None] == _tc[None, :], _tv[None, :]),
+                        axis=1)
+                env["__in__:" + dep] = probe
+            env, cols, keep = _apply_chain(pre_chain, env, sid, cols, valid,
+                                           kind == ev.CURRENT)
+            sidx = jnp.clip(sel_idx, 0)
+            take = lambda a: a[sidx]                      # noqa: E731
+            evalid = jnp.logical_and(sel_idx >= 0, take(keep))
+            rows_k = Rows(ts=take(ts), kind=take(kind), valid=evalid,
+                          seq=jnp.zeros_like(take(ts)), gslot=take(gslot),
+                          cols=tuple(take(c) for c in cols))
+            kidx = jnp.clip(key_idx, 0, K - 1)
+            st_k = jax.tree.map(lambda x: x[kidx], wslab)
+            st_k2, wout = jax.vmap(
+                wproc.process, in_axes=(0, 0, None))(st_k, rows_k, now)
+            # pad rows (key_idx == K) drop on scatter-back
+            wslab = jax.tree.map(
+                lambda s, n: s.at[key_idx].set(n, mode="drop"),
+                wslab, st_k2)
+            ork = wout.rows
+            flat = lambda a: a.reshape((-1,) + a.shape[2:])  # noqa: E731
+            pad_live = (key_idx < K)[:, None]
+            orows = Rows(
+                ts=flat(ork.ts), kind=flat(ork.kind),
+                valid=flat(jnp.logical_and(ork.valid, pad_live)),
+                seq=flat(ork.seq), gslot=flat(ork.gslot),
+                cols=tuple(flat(c) for c in ork.cols))
+            env2 = {sid: orows.cols, "__ts__": orows.ts, "__now__": now,
+                    "__kind__": orows.kind}
+            for k2, v2 in env.items():
+                if k2.startswith("__in__:"):
+                    env2[k2] = v2
+            if post_chain:
+                data_row = jnp.logical_or(orows.kind == ev.CURRENT,
+                                          orows.kind == ev.EXPIRED)
+                env2, ocols, keep2 = _apply_chain(
+                    post_chain, env2, sid, orows.cols, orows.valid,
+                    data_row)
+                orows = orows._replace(valid=keep2, cols=ocols)
+            astate, outs = sel.process(astate, orows, env2)
+            return ((wslab, astate), outs, jnp.min(wout.next_wakeup))
+
+        jit_step = jax.jit(kstep, donate_argnums=(0,))
+
+        def init_state():
+            single = wproc.init_state()
+            slab = jax.tree.map(
+                lambda x: jnp.array(jnp.broadcast_to(
+                    jnp.asarray(x)[None],
+                    (K,) + jnp.asarray(x).shape)), single)
+            return (slab, sel.init_state())
+    else:
+        jit_step = jax.jit(step, donate_argnums=(0,))
+
+        def init_state():
+            return (wproc.init_state(), sel.init_state())
 
     return PlannedQuery(
         name=name,
@@ -273,4 +355,9 @@ def plan_single_query(
         batch_capacity=batch_capacity,
         needs_timer=wproc.needs_timer,
         in_deps=in_deps,
+        partition_key_fn=partition_key_fn,
+        keyed_window=keyed_window,
+        window_key_allocator=window_key_allocator,
+        window_key_positions=list(partition_positions or []),
+        key_capacity=key_capacity,
     )

@@ -120,6 +120,9 @@ class QueryRuntime:
         self.callbacks: List[Callable] = []
         self.batch_callbacks: List[Callable] = []
         self.next_wakeup: int = _NO_WAKEUP_INT
+        # set by _PartitionPurger: fn(slots, now) recording key liveness
+        self._touch = None
+        self._touch_group = None
 
     @property
     def name(self):
@@ -130,11 +133,30 @@ class QueryRuntime:
         dbg = getattr(self.app, "_debugger", None)
         if dbg is not None:
             dbg.check_break_point(self.name, "IN", staged)
-        if p.group_by_positions and p.slot_allocator is not None:
+        if p.keyed_window:
+            self._process_keyed(staged, now)
+            return
+        valid = staged.valid
+        if p.partition_key_fn is not None:
+            # range partition: derived key column; rows matching no range
+            # are excluded from the query entirely
+            kcols, kvalid = p.partition_key_fn(staged)
+            valid = valid & kvalid
+            if p.slot_allocator is not None:
+                key_cols = list(kcols) + [staged.cols[i]
+                                          for i in p.group_by_positions]
+                gslot = p.slot_allocator.slots_for(key_cols, valid)
+            else:
+                gslot = np.zeros((staged.ts.shape[0],), np.int32)
+            staged = ev.StagedBatch(staged.ts, staged.kind, valid,
+                                    staged.cols, staged.n)
+        elif p.group_by_positions and p.slot_allocator is not None:
             key_cols = [staged.cols[i] for i in p.group_by_positions]
-            gslot = p.slot_allocator.slots_for(key_cols, staged.valid)
+            gslot = p.slot_allocator.slots_for(key_cols, valid)
         else:
             gslot = np.zeros((staged.ts.shape[0],), np.int32)
+        if self._touch is not None:
+            self._touch(gslot, now)
         batch = staged.to_device(p.in_schema)
         in_tabs = tuple(
             (self.app.tables[d].cols[0], self.app.tables[d].valid)
@@ -153,12 +175,67 @@ class QueryRuntime:
                 wake_arg = wake
         self._emit(out, now, wake_arg)
 
+    def _process_keyed(self, staged: ev.StagedBatch, now: int,
+                       all_keys: bool = False) -> None:
+        """Keyed-window path: events group per partition key into [Kb, E]
+        and the window state slab advances under vmap (planner.kstep)."""
+        p = self.planned
+        valid = staged.valid
+        if p.partition_key_fn is not None:
+            kcols, kvalid = p.partition_key_fn(staged)
+            valid = valid & kvalid
+            kcols = list(kcols)
+        else:
+            kcols = [staged.cols[i] for i in p.window_key_positions]
+        if all_keys:
+            # timer tick: advance EVERY key's window; each key sees the
+            # TIMER row (staged row 0) so flush-on-timer windows
+            # (cron/timeBatch) fire per key, and `now` drives time expiry
+            key_idx = np.arange(p.key_capacity, dtype=np.int32)
+            sel = np.zeros((p.key_capacity, 1), np.int32)
+        else:
+            _, key_idx, sel = p.window_key_allocator.slots_and_group(
+                kcols, valid, pad=p.key_capacity)
+        if self._touch is not None and not all_keys:
+            self._touch(key_idx, now)
+        if p.slot_allocator is not None:
+            if p.partition_key_fn is not None:
+                gk = kcols + [staged.cols[i] for i in p.group_by_positions]
+            else:
+                gk = [staged.cols[i] for i in p.group_by_positions]
+            gslot = p.slot_allocator.slots_for(gk, valid)
+            if self._touch_group is not None and not all_keys:
+                self._touch_group(gslot, now)
+        else:
+            gslot = np.zeros((staged.ts.shape[0],), np.int32)
+        batch = ev.StagedBatch(staged.ts, staged.kind, valid, staged.cols,
+                               staged.n).to_device(p.in_schema)
+        in_tabs = tuple(
+            (self.app.tables[d].cols[0], self.app.tables[d].valid)
+            for d in p.in_deps)
+        self.state, out, wake = p.step(
+            self.state, batch.ts, batch.kind, batch.valid, batch.cols,
+            jax.numpy.asarray(gslot), jax.numpy.asarray(key_idx),
+            jax.numpy.asarray(sel),
+            jax.numpy.asarray(now, jax.numpy.int64), in_tabs)
+        wake_arg = None
+        if p.needs_timer:
+            if getattr(p.window, "host_scheduled", False):
+                # cron-style windows schedule on the host clock
+                self._apply_wake(p.window.host_next_wakeup(now))
+            else:
+                wake_arg = wake
+        self._emit(out, now, wake_arg)
+
     def on_timer(self, now: int) -> None:
         p = self.planned
         staged = ev.pack_np(p.in_schema, [], capacity=8)
         staged.ts[0] = now
         staged.kind[0] = ev.TIMER
         staged.valid[0] = True
+        if p.keyed_window:
+            self._process_keyed(staged, now, all_keys=True)
+            return
         self.process_staged(staged, now)
 
     def _apply_wake(self, w: int) -> None:
@@ -188,6 +265,8 @@ class PatternQueryRuntime:
         # per-key dirty mask since the last (incremental) snapshot
         self._dirty = np.zeros(planned.key_capacity, np.bool_) \
             if planned.partition_positions else None
+        # set by _PartitionPurger: fn(slots, now) recording key liveness
+        self._touch = None
 
     @property
     def name(self):
@@ -203,10 +282,18 @@ class PatternQueryRuntime:
         raw_cols = tuple(jax.numpy.asarray(c) for c in staged.cols)
         raw_ts = jax.numpy.asarray(staged.ts)
         if p.partition_positions:
-            pos = p.partition_positions[stream_id]
+            kf = (p.partition_key_fns or {}).get(stream_id)
+            if kf is not None:
+                key_cols, kvalid = kf(staged)
+                valid = staged.valid & kvalid
+            else:
+                pos = p.partition_positions[stream_id]
+                key_cols = [staged.cols[i] for i in pos]
+                valid = staged.valid
             _, key_idx_np, sel = self.slot_allocator.slots_and_group(
-                [staged.cols[i] for i in pos], staged.valid,
-                pad=p.key_capacity)
+                key_cols, valid, pad=p.key_capacity)
+            if self._touch is not None:
+                self._touch(key_idx_np, now)
             sel_d = jax.numpy.asarray(sel)
             # contiguous-slot fast path: dynamic-slice state access instead
             # of row-serialized gather/scatter (see dense_steps)
@@ -214,7 +301,10 @@ class PatternQueryRuntime:
             nuniq = int((key_idx_np < p.key_capacity).sum())
             if self._dirty is not None and nuniq:
                 self._dirty[key_idx_np[:nuniq]] = True
-            if (p.dense_steps is not None and nuniq > 0 and
+            # nuniq >= 2: the Kb=1 dense specialization trips an XLA:CPU
+            # fused-dynamic-slice codegen bug (RET_CHECK llvm_module), and a
+            # 1-row gather is as fast as a 1-row slice anyway
+            if (p.dense_steps is not None and nuniq > 1 and
                     int(key_idx_np[0]) + Kb <= p.key_capacity and
                     int(key_idx_np[nuniq - 1]) ==
                     int(key_idx_np[0]) + nuniq - 1):
@@ -251,9 +341,17 @@ class PatternQueryRuntime:
         p = self.planned
         n = p.mesh.devices.size
         B = staged.ts.shape[0]
-        pos = p.partition_positions[stream_id]
-        slots = self.slot_allocator.slots_for(
-            [staged.cols[i] for i in pos], staged.valid)
+        kf = (p.partition_key_fns or {}).get(stream_id)
+        if kf is not None:
+            key_cols, kvalid = kf(staged)
+            valid = staged.valid & kvalid
+        else:
+            pos = p.partition_positions[stream_id]
+            key_cols = [staged.cols[i] for i in pos]
+            valid = staged.valid
+        slots = self.slot_allocator.slots_for(key_cols, valid)
+        if self._touch is not None:
+            self._touch(slots, now)
         if self._dirty is not None:
             live = slots[slots >= 0]
             if live.size:
@@ -876,6 +974,122 @@ class StreamJunction:
         self._handle_error([], exc, now)
 
 
+class _PartitionPurger:
+    """Idle partition-key GC (reference: @purge config,
+    PartitionRuntimeImpl.java:120-147).
+
+    Tracks the last event time per key slot across a partition's queries;
+    keys idle past `idle.period` free their allocator slots and their state
+    columns reset to initial values — slot capacity recycles instead of
+    ratcheting up until CapacityExceededError."""
+
+    def __init__(self, app, shared_alloc, runtimes, interval_ms: int,
+                 idle_ms: int):
+        self.app = app
+        self.shared_alloc = shared_alloc
+        self.runtimes = runtimes
+        self.interval_ms = interval_ms
+        self.idle_ms = idle_ms
+        self._seen_shared = np.zeros(shared_alloc.capacity, np.int64)
+        self._seen_q: Dict[int, np.ndarray] = {}
+        self._init_cols: Dict[int, Tuple] = {}
+        for qr in runtimes:
+            if isinstance(qr, PatternQueryRuntime):
+                qr._touch = self._make_touch(self._seen_shared)
+                (b32i, b64i, _), _ = qr.planned.init_state(1)
+                self._init_cols[id(qr)] = (jax.numpy.asarray(b32i),
+                                           jax.numpy.asarray(b64i))
+                continue
+            if getattr(qr.planned, "keyed_window", False):
+                # keyed-window runtimes share the partition key allocator
+                qr._touch = self._make_touch(self._seen_shared)
+            # per-query group-by allocator (keyed-window queries have BOTH:
+            # the shared window-key axis and their own group slots)
+            alloc = getattr(qr.planned, "slot_allocator", None)
+            if alloc is not None:
+                seen = np.zeros(alloc.capacity, np.int64)
+                self._seen_q[id(qr)] = seen
+                if getattr(qr.planned, "keyed_window", False):
+                    qr._touch_group = self._make_touch(seen)
+                else:
+                    qr._touch = self._make_touch(seen)
+        app._scheduler.notify_at(
+            app.timestamp_millis() + interval_ms, self)
+
+    @staticmethod
+    def _make_touch(seen: np.ndarray):
+        cap = seen.shape[0]
+
+        def touch(slots: np.ndarray, now: int) -> None:
+            live = slots[(slots >= 0) & (slots < cap)]
+            if live.size:
+                seen[live] = now
+        return touch
+
+    @staticmethod
+    def _idle_slots(alloc, seen: np.ndarray, now: int,
+                    cutoff: int) -> np.ndarray:
+        used = np.nonzero(alloc._used)[0]
+        # slots never touched since this purger saw them (e.g. restored
+        # from a snapshot) start aging NOW, not at epoch — else a restore
+        # followed by one purge tick would wipe every restored key
+        fresh = used[seen[used] == 0]
+        if fresh.size:
+            seen[fresh] = now
+        return used[seen[used] < cutoff]
+
+    def on_timer(self, now: int) -> None:
+        cutoff = now - self.idle_ms
+        idle = self._idle_slots(self.shared_alloc, self._seen_shared, now,
+                                cutoff)
+        if idle.size:
+            self.shared_alloc.purge(idle.tolist())
+            for qr in self.runtimes:
+                if isinstance(qr, PatternQueryRuntime):
+                    self._reset_pattern_keys(qr, idle)
+                elif getattr(qr.planned, "keyed_window", False):
+                    self._reset_keyed_window(qr, idle)
+        for qr in self.runtimes:
+            if isinstance(qr, PatternQueryRuntime):
+                continue
+            alloc = getattr(qr.planned, "slot_allocator", None)
+            seen = self._seen_q.get(id(qr))
+            if alloc is None or seen is None:
+                continue
+            qidle = self._idle_slots(alloc, seen, now, cutoff)
+            if qidle.size:
+                alloc.purge(qidle.tolist())
+                self._reset_selector_slots(qr, qidle)
+        self.app._scheduler.notify_at(now + self.interval_ms, self)
+
+    def _reset_pattern_keys(self, qr, idx: np.ndarray) -> None:
+        (b32, b64, scalars), sel_state = qr.state
+        init32, init64 = self._init_cols[id(qr)]
+        jidx = jax.numpy.asarray(idx)
+        b32 = b32.at[:, jidx].set(init32)
+        b64 = b64.at[:, jidx].set(init64)
+        qr.state = ((b32, b64, scalars), sel_state)
+        if qr._dirty is not None:
+            qr._dirty[idx] = True
+
+    def _reset_selector_slots(self, qr, idx: np.ndarray) -> None:
+        wstate, astate = qr.state
+        specs = qr.planned.selector_exec.bank.specs
+        jidx = jax.numpy.asarray(idx)
+        astate = tuple(a.at[jidx].set(s.init)
+                       for a, s in zip(astate, specs))
+        qr.state = (wstate, astate)
+
+    def _reset_keyed_window(self, qr, idx: np.ndarray) -> None:
+        wslab, astate = qr.state
+        single = qr.planned.window.init_state()
+        jidx = jax.numpy.asarray(idx)
+        wslab = jax.tree.map(
+            lambda s, i0: s.at[jidx].set(jax.numpy.asarray(i0)),
+            wslab, single)
+        qr.state = (wslab, astate)
+
+
 class _EmissionDrainer:
     """Background thread pulling device outputs and delivering callbacks.
     Bounded queue gives backpressure (reference: Disruptor ring buffer
@@ -1180,6 +1394,7 @@ class SiddhiAppRuntime:
         # plan queries
         self.query_runtimes: Dict[str, QueryRuntime] = {}
         self._timed_limiters: List = []
+        self._partition_purgers: List[_PartitionPurger] = []
         qi = 0
         for element in app.execution_element_list:
             if isinstance(element, Query):
@@ -1391,6 +1606,7 @@ class SiddhiAppRuntime:
         becomes an explicit key axis: pattern queries get per-key NFA slabs,
         aggregations compose the partition key into their group key."""
         from ..query_api.query import (
+            JoinInputStream,
             RangePartitionType,
             StateInputStream,
             ValuePartitionType,
@@ -1398,25 +1614,55 @@ class SiddhiAppRuntime:
         from ..query_api.expression import Variable as V
         from .pattern_planner import plan_pattern_query
 
-        # partition key attribute position per stream
+        # partition key attribute position per stream (value partitions) or
+        # a derived-key fn (range partitions: first matching range's label,
+        # reference: RangePartitionExecutor.java:45; non-matching rows drop)
         positions: Dict[str, List[int]] = {}
+        key_fns: Dict[str, Callable] = {}
         for sid, pt in part.partition_type_map.items():
+            schema = self.schemas.get(sid)
+            if schema is None:
+                raise CompileError(f"undefined partitioned stream {sid!r}")
             if isinstance(pt, RangePartitionType):
-                raise CompileError(
-                    "range partitions land in a later phase")
+                from .executor import Scope, compile_expression
+                scope = Scope()
+                scope.interner = self.interner
+                scope.add_source(sid, schema)
+                conds = []
+                for rp in pt.ranges:
+                    c = compile_expression(rp.condition, scope)
+                    if c.type != "BOOL":
+                        raise CompileError(
+                            "range partition conditions must be boolean")
+                    conds.append((self.interner.intern(rp.partition_key),
+                                  c))
+
+                def make_fn(sid=sid, conds=conds):
+                    def fn(staged):
+                        env = {sid: tuple(staged.cols),
+                               "__ts__": staged.ts, "__now__": staged.ts}
+                        ids = np.full(staged.ts.shape[0], -1, np.int32)
+                        for label, c in conds:
+                            m = np.asarray(c.fn(env)).astype(bool)
+                            ids = np.where((ids < 0) & m, label, ids)
+                        return [ids], ids >= 0
+                    return fn
+                key_fns[sid] = make_fn()
+                positions[sid] = []
+                continue
             assert isinstance(pt, ValuePartitionType)
             if not isinstance(pt.expression, V):
                 raise CompileError(
                     "partition-by expression must be a plain attribute in "
                     "this build")
-            schema = self.schemas.get(sid)
-            if schema is None:
-                raise CompileError(f"undefined partitioned stream {sid!r}")
             positions[sid] = [schema.position(pt.expression.attribute_name)]
 
         # capacity annotation: @capacity(keys='..', slots='..') on the
         # partition or any of its queries
         keys_cap, nfa_slots = 4096, 8
+        # per-key window slab rows for windows inside the partition (small
+        # default: the slab is keys x window-capacity)
+        win_cap = 128
         all_anns = list(part.annotations)
         for q in part.query_list:
             all_anns.extend(q.annotations)
@@ -1424,11 +1670,13 @@ class SiddhiAppRuntime:
             if ann.name.lower() == "capacity":
                 keys_cap = int(ann.element("keys", keys_cap))
                 nfa_slots = int(ann.element("slots", nfa_slots))
+                win_cap = int(ann.element("window", win_cap))
         if self.mesh is not None:
             n = self.mesh.devices.size
             keys_cap = ((keys_cap + n - 1) // n) * n
 
         shared_allocator = SlotAllocator(keys_cap, name="partition")
+        part_runtimes: List = []
 
         for q in part.query_list:
             qname = self._query_name(q, qi)
@@ -1436,20 +1684,25 @@ class SiddhiAppRuntime:
             if isinstance(q.input_stream, StateInputStream):
                 spec_streams = q.input_stream.all_stream_ids
                 ppos = {}
+                pfns = {}
                 for sid in spec_streams:
                     if sid not in positions:
                         raise CompileError(
                             f"pattern stream {sid!r} has no partition key")
                     ppos[sid] = positions[sid]
+                    if sid in key_fns:
+                        pfns[sid] = key_fns[sid]
                 planned = plan_pattern_query(
                     q, qname, self.schemas, self.interner,
                     key_capacity=keys_cap, slots=nfa_slots,
-                    partition_positions=ppos, mesh=self.mesh,
+                    partition_positions=ppos,
+                    partition_key_fns=pfns or None, mesh=self.mesh,
                     script_functions=self.app.function_definition_map)
                 runtime = PatternQueryRuntime(planned, self,
                                               slot_allocator=shared_allocator)
                 runtime.async_emit = self._async_enabled(q)
                 self.query_runtimes[qname] = runtime
+                part_runtimes.append(runtime)
                 for sid in planned.spec.stream_ids:
                     class _Sub:
                         def __init__(self, qr, stream):
@@ -1460,27 +1713,99 @@ class SiddhiAppRuntime:
                     self.junctions[sid].subscribe_query(_Sub(runtime, sid))
                 self._attach_rate_limiter(q, runtime)
                 self._define_output_for(planned, qname)
+            elif isinstance(q.input_stream, JoinInputStream):
+                # partitioned join: lower to a plain join whose `on`
+                # condition additionally requires equal partition keys on
+                # both sides — only same-key rows match, the partition
+                # isolation semantics of the reference's per-key clone
+                # (PartitionParser.java:137).  NOTE: join-side window
+                # CAPACITY is shared across keys here (tune @capacity),
+                # unlike the reference's per-key window instances.
+                jis = q.input_stream
+                lsis, rsis = jis.left_input_stream, jis.right_input_stream
+                lsid = lsis.unique_stream_id
+                rsid = rsis.unique_stream_id
+                if lsid in key_fns or rsid in key_fns:
+                    raise CompileError(
+                        "range-partitioned joins are not supported")
+                from ..query_api.expression import Expression as E
+                sides = []
+                for sis, ssid in ((lsis, lsid), (rsis, rsid)):
+                    if ssid in self.tables or \
+                            ssid in self.named_windows or \
+                            ssid in self.aggregations:
+                        continue        # shared collections: no key column
+                    pos = positions.get(ssid)
+                    if not pos:
+                        # mirror the single-stream branch: a plain stream
+                        # side without a partition key would silently join
+                        # across partitions
+                        raise CompileError(
+                            f"stream {ssid!r} has no partition key")
+                    schema = self.schemas[ssid]
+                    ref = sis.stream_reference_id or ssid
+                    sides.append(E.variable(
+                        schema.names[pos[0]]).of_stream(ref))
+                if len(sides) == 2:
+                    eq = E.compare(sides[0], "==", sides[1])
+                    jis.on_compare = E.and_(jis.on_compare, eq) \
+                        if jis.on_compare is not None else eq
+                self._add_join_query(q, qname)
+                part_runtimes.append(self.query_runtimes[qname])
+                continue
             else:
                 ist = q.input_stream
                 if not isinstance(ist, SingleInputStream):
                     raise CompileError(
-                        "joins inside partitions land in a later phase")
+                        "only single-stream, pattern and join queries are "
+                        "supported inside partitions")
                 sid = ist.unique_stream_id
                 ppos = positions.get(sid)
                 if ppos is None and not ist.is_inner_stream:
                     raise CompileError(
                         f"stream {sid!r} has no partition key")
+                from ..query_api.query import Window as _QWindow
+                has_window = any(isinstance(h, _QWindow)
+                                 for h in ist.stream_handlers)
                 planned = plan_single_query(
                     q, qname, self.app.stream_definition_map, self.schemas,
                     self.interner, group_slots=max(keys_cap, 4096),
+                    # keyed windows see per-key E-row batches, so their
+                    # window shapes key off a small batch capacity; the
+                    # flat (no-window) path keeps the full default
+                    batch_capacity=64 if has_window else 512,
+                    window_capacity_hint=win_cap,
                     partition_positions=ppos,
+                    partition_key_fn=key_fns.get(sid),
+                    window_key_allocator=shared_allocator,
+                    key_capacity=keys_cap,
                     config_manager=self.config_manager,
                     script_functions=self.app.function_definition_map)
                 runtime = QueryRuntime(planned, self)
                 self.query_runtimes[qname] = runtime
+                part_runtimes.append(runtime)
                 self.junctions[sid].subscribe_query(runtime)
                 self._attach_rate_limiter(q, runtime)
                 self._define_output_for(planned, qname)
+
+        # @purge(enable, interval='1 sec', idle.period='10 min'): idle-key
+        # GC recycling slots through the allocators (reference:
+        # PartitionRuntimeImpl.java:120-147).  Accepted on the partition or
+        # any of its queries.
+        for ann in all_anns:
+            if ann.name.lower() == "purge":
+                enabled = str(ann.element("enable", "true")).lower() == "true"
+                if not enabled:
+                    break
+                from ..core.aggregation import parse_time_ms
+                interval = parse_time_ms(
+                    ann.element("interval", "1 sec")) or 1000
+                idle = parse_time_ms(
+                    ann.element("idle.period", "5 min")) or 300_000
+                purger = _PartitionPurger(
+                    self, shared_allocator, part_runtimes, interval, idle)
+                self._partition_purgers.append(purger)
+                break
         return qi
 
     def _define_output_for(self, planned, name: str):

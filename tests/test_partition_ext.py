@@ -1,0 +1,328 @@
+"""Round-3 partition completeness: range partitions and @purge idle-key GC
+(reference: RangePartitionExecutor.java:45, PartitionRuntimeImpl.java:120-147,
+TEST/query/partition/PartitionTestCase1 patterns)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def test_range_partition_single_query(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string, price float, vol int);
+    partition with (
+        vol < 100 as 'small' or
+        vol >= 100 and vol < 1000 as 'medium' or
+        vol >= 1000 as 'large' of S)
+    begin
+      @info(name='q') from S select sym, sum(vol) as total insert into Out;
+    end;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["a", 1.0, 50], timestamp=1000)     # small: 50
+    h.send(["b", 1.0, 500], timestamp=1001)    # medium: 500
+    h.send(["c", 1.0, 60], timestamp=1002)     # small: 110
+    h.send(["d", 1.0, 2000], timestamp=1003)   # large: 2000
+    rt.flush()
+    totals = [g[1] for g in got]
+    assert totals == [50, 500, 110, 2000], got
+
+
+def test_range_partition_excludes_unmatched_rows(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string, vol int);
+    partition with (vol < 10 as 'small' of S)
+    begin
+      @info(name='q') from S select sym, count() as n insert into Out;
+    end;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["in", 5], timestamp=1000)
+    h.send(["out", 50], timestamp=1001)   # matches no range: dropped
+    h.send(["in2", 7], timestamp=1002)
+    rt.flush()
+    assert [g[0] for g in got] == ["in", "in2"]
+    assert [g[1] for g in got] == [1, 2]
+
+
+def test_range_partition_pattern(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream T (key long, price float, vol int);
+    partition with (
+        vol < 100 as 'small' or vol >= 100 as 'big' of T)
+    begin
+      @info(name='p')
+      from every e1=T[price > 10.0] -> e2=T[price > e1.price]
+      select e1.price as p1, e2.price as p2
+      insert into M;
+    end;
+    """)
+    got = []
+    rt.add_callback("p", lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("T")
+    # 'small' range: e1 at 20, then 25 completes
+    h.send([1, 20.0, 5], timestamp=1000)
+    # 'big' range: e1 at 30 — must NOT pair with small's events
+    h.send([2, 30.0, 500], timestamp=1001)
+    h.send([3, 25.0, 7], timestamp=1002)     # completes small: (20, 25)
+    h.send([4, 40.0, 600], timestamp=1003)   # completes big: (30, 40)
+    rt.flush()
+    assert sorted(got) == [(20.0, 25.0), (30.0, 40.0)], got
+
+
+PURGE_QL = """
+@app:playback
+define stream T (key long, price float, vol int);
+partition with (key of T)
+begin
+  @capacity(keys='16', slots='4')
+  @purge(enable='true', interval='1 sec', idle.period='5 sec')
+  @info(name='p')
+  from every e1=T[vol == 1] -> e2=T[vol == 2 and price >= e1.price]
+  select e1.key as k insert into M;
+end;
+"""
+
+
+def test_purge_recycles_pattern_slots(manager):
+    from siddhi_tpu.exceptions import CapacityExceededError
+    rt = manager.create_siddhi_app_runtime(PURGE_QL)
+    got = []
+    rt.add_callback("p", lambda ts, i, o: got.extend(
+        [e.data[0] for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("T")
+    qr = rt.query_runtimes["p"]
+    # fill all 16 key slots
+    ks = np.arange(16, dtype=np.int64)
+    h.send_columns([ks, np.full(16, 5.0, np.float32),
+                    np.ones(16, np.int32)],
+                   timestamps=np.full(16, 1000, np.int64))
+    rt.flush()
+    assert len(qr.slot_allocator) == 16
+    # advance the playback clock far past idle.period; timers fire on send
+    h.send_columns([np.array([0], np.int64),
+                    np.array([5.0], np.float32),
+                    np.array([1], np.int32)],
+                   timestamps=np.array([20_000], np.int64))
+    rt.flush()
+    # idle keys (1..15) purged; key 0 was just touched
+    assert len(qr.slot_allocator) == 1
+    # freed slots are reusable: 13 NEW keys fit again (2 slots headroom
+    # for the probes below)
+    ks2 = np.arange(100, 113, dtype=np.int64)
+    h.send_columns([ks2, np.full(13, 5.0, np.float32),
+                    np.ones(13, np.int32)],
+                   timestamps=np.full(13, 21_000, np.int64))
+    rt.flush()
+    assert len(qr.slot_allocator) == 14
+    # purged keys' NFA state was RESET: an e2 for old key 3 must not match
+    h.send_columns([np.array([3], np.int64),
+                    np.array([9.0], np.float32),
+                    np.array([2], np.int32)],
+                   timestamps=np.array([21_500], np.int64))
+    rt.flush()
+    assert got == []
+    # new pending on a recycled slot works end-to-end
+    h.send_columns([np.array([200, 200], np.int64),
+                    np.array([5.0, 6.0], np.float32),
+                    np.array([1, 2], np.int32)],
+                   timestamps=np.array([22_000, 22_001], np.int64))
+    rt.flush()
+    assert got == [200]
+
+
+def test_length_window_inside_partition(manager):
+    """Each partition key owns a PRIVATE window.length(2): key A's third
+    event must expire A's first event, never B's
+    (reference: TEST/query/partition WindowPartitionTestCase)."""
+    rt = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string, price float);
+    partition with (sym of S)
+    begin
+      @info(name='q') from S#window.length(2)
+      select sym, sum(price) as total
+      insert all events into Out;
+    end;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.append(
+        ([tuple(e.data) for e in (i or [])],
+         [tuple(e.data) for e in (o or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["A", 1.0], timestamp=1000)
+    h.send(["B", 10.0], timestamp=1001)
+    h.send(["A", 2.0], timestamp=1002)
+    h.send(["A", 4.0], timestamp=1003)    # expires A@1.0 only
+    h.send(["B", 20.0], timestamp=1004)
+    rt.flush()
+    cur = [r for ins, _ in got for r in ins]
+    # per-key running sums over a per-key length-2 window
+    assert cur == [("A", 1.0), ("B", 10.0), ("A", 3.0), ("A", 6.0),
+                   ("B", 30.0)], cur
+    # only A's first event expired; the remove row carries the
+    # post-removal aggregate BEFORE the new arrival joins (1+2-1 = 2)
+    exp = [r for _, outs in got for r in outs]
+    assert exp == [("A", 2.0)], exp
+
+
+def test_time_batch_window_inside_partition(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string, v int);
+    partition with (sym of S)
+    begin
+      @info(name='q') from S#window.lengthBatch(2)
+      select sym, sum(v) as total
+      insert into Out;
+    end;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["A", 1], timestamp=1000)
+    h.send(["B", 10], timestamp=1001)
+    h.send(["A", 2], timestamp=1002)     # A's batch of 2 flushes
+    h.send(["B", 20], timestamp=1003)    # B's batch of 2 flushes
+    h.send(["A", 5], timestamp=1004)     # pending
+    rt.flush()
+    # flushed batches emit per-row running aggregates (the last row holds
+    # the full batch total), per key — B's batch never mixes with A's
+    assert got == [("A", 1), ("A", 3), ("B", 10), ("B", 30)], got
+
+
+def test_range_partition_with_window(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string, vol int);
+    partition with (vol < 100 as 'small' or vol >= 100 as 'big' of S)
+    begin
+      @info(name='q') from S#window.lengthBatch(2)
+      select sym, sum(vol) as total insert into Out;
+    end;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["a", 1], timestamp=1000)      # small
+    h.send(["b", 500], timestamp=1001)    # big
+    h.send(["c", 2], timestamp=1002)      # small flushes
+    h.send(["d", 900], timestamp=1003)    # big flushes
+    rt.flush()
+    # per-range lengthBatch(2): 'small' = {a:1, c:2}, 'big' = {b:500, d:900}
+    assert got == [("a", 1), ("c", 3), ("b", 500), ("d", 1400)], got
+
+
+def test_single_key_batches_complete_pattern(manager):
+    """Kb=1 batches must run (regression: the dense-path specialization for
+    a single key tripped an XLA:CPU fused-dynamic-slice codegen crash that
+    was silently swallowed by fault routing)."""
+    rt = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream T (key long, price float, vol int);
+    partition with (key of T)
+    begin
+      @capacity(keys='8', slots='4')
+      @info(name='p')
+      from every e1=T[vol == 1] -> e2=T[vol == 2]
+      select e1.key as k insert into M;
+    end;
+    """)
+    got, errs = [], []
+    rt.set_exception_listener(errs.append)
+    rt.add_callback("p", lambda ts, i, o: got.extend(
+        [e.data[0] for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("T")
+    ks = np.arange(8, dtype=np.int64)
+    h.send_columns([ks, np.full(8, 1.0, np.float32),
+                    np.full(8, 9, np.int32)],    # vol=9: seeds nothing
+                   timestamps=np.full(8, 1000, np.int64))
+    # one-key batches, e1 and e2 in SEPARATE sends
+    h.send([7, 1.5, 1], timestamp=2000)
+    h.send([7, 2.0, 2], timestamp=2001)
+    rt.flush()
+    assert errs == [], errs
+    assert got == [7], got
+
+
+def test_join_inside_partition(manager):
+    """Partitioned join: only rows with EQUAL partition keys join
+    (reference: TEST/query/partition JoinPartitionTestCase)."""
+    rt = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream L (sym string, price float);
+    define stream R (sym string, qty int);
+    partition with (sym of L, sym of R)
+    begin
+      @info(name='j')
+      from L#window.length(10) join R#window.length(10)
+      select L.sym as s, L.price as p, R.qty as q
+      insert into Out;
+    end;
+    """)
+    got = []
+    rt.add_callback("j", lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    hl = rt.get_input_handler("L")
+    hr = rt.get_input_handler("R")
+    hl.send(["A", 10.0], timestamp=1000)
+    hl.send(["B", 20.0], timestamp=1001)
+    hr.send(["A", 7], timestamp=1002)     # joins only with A's row
+    hr.send(["C", 9], timestamp=1003)     # no L partner: nothing
+    rt.flush()
+    assert got == [("A", 10.0, 7)], got
+
+
+def test_purge_recycles_groupby_slots(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (key long, v int);
+    partition with (key of S)
+    begin
+      @purge(enable='true', interval='1 sec', idle.period='5 sec')
+      @info(name='q') from S select key, sum(v) as total insert into Out;
+    end;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1, 10], timestamp=1000)
+    h.send([1, 5], timestamp=1100)
+    rt.flush()
+    assert got[-1] == (1, 15)
+    # idle long past the idle.period -> key 1's accumulator resets
+    h.send([2, 1], timestamp=30_000)
+    rt.flush()
+    h.send([1, 7], timestamp=31_000)
+    rt.flush()
+    assert got[-1] == (1, 7), got     # NOT 22: purged state restarted
