@@ -338,6 +338,19 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
             return out
         return CompiledExpr(fn, t)
 
+    if full == "createSet":
+        raise CompileError(
+            "createSet is only valid inside unionSet(createSet(attr))")
+
+    if full == "sizeOfSet":
+        src = carg(0)
+        if src.type != "SET":
+            raise CompileError(
+                "sizeOfSet expects a set value "
+                "(e.g. sizeOfSet(unionSet(createSet(attr))))")
+        # the SET pseudo-value IS the running distinct count
+        return CompiledExpr(src.fn, "LONG")
+
     if full == "eventTimestamp":
         def fn(env):
             return env["__ts__"]

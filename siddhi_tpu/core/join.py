@@ -64,6 +64,13 @@ class PlannedJoinQuery:
     needs_timer: bool
     within_range: Optional[Tuple[int, int]] = None
     per_duration: Optional[str] = None
+    # group-by in joins: per-side group keys resolve to per-side slots on
+    # the host; the joined row's group slot composes on device as
+    # gl * (Kr + 1) + gr (the +1 factor is the outer-join null group)
+    slot_allocator: Optional[Any] = None      # left-side group allocator
+    slot_allocator2: Optional[Any] = None     # right-side group allocator
+    gl_pos: List[int] = dataclasses.field(default_factory=list)
+    gr_pos: List[int] = dataclasses.field(default_factory=list)
 
 
 def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
@@ -149,11 +156,45 @@ def plan_join_query(
     if jis.on_compare is not None:
         on = compile_expression(jis.on_compare, scope)
 
-    if query.selector.group_by_list:
-        raise CompileError("group-by in join queries lands in a later phase")
-    sel = SelectorExec(query.selector, scope, left.schema, 64,
+    # group-by in joins (reference: JoinProcessor + QuerySelector
+    # processGroupBy, JoinProcessor.java:107-190): group attrs resolve to
+    # per-side slot ids at ingestion; the joined row's slot composes the two
+    gl_pos: List[int] = []
+    gr_pos: List[int] = []
+    for v in query.selector.group_by_list:
+        key, pos, _ = scope.resolve(v)
+        if key == left.key:
+            if left.is_table:
+                raise CompileError(
+                    "join group-by attributes must come from stream sides")
+            gl_pos.append(pos)
+        elif key == right.key:
+            if right.is_table:
+                raise CompileError(
+                    "join group-by attributes must come from stream sides")
+            gr_pos.append(pos)
+        else:
+            raise CompileError(
+                f"cannot resolve group-by attribute {v.attribute_name!r} "
+                f"to a join side")
+    if gl_pos and gr_pos:
+        Kl = Kr = 63
+    elif gl_pos:
+        Kl, Kr = 2047, 0
+    elif gr_pos:
+        Kl, Kr = 0, 2047
+    else:
+        Kl = Kr = 0
+    from .keyslots import SlotAllocator
+    gl_alloc = SlotAllocator(Kl, name=f"{name}:gl") if gl_pos else None
+    gr_alloc = SlotAllocator(Kr, name=f"{name}:gr") if gr_pos else None
+    sel = SelectorExec(query.selector, scope, left.schema,
+                       max((Kl + 1) * (Kr + 1), 64),
                        (query.output_stream.target_id
                         if query.output_stream else name), interner)
+    if sel.bank.pair_sources:
+        raise CompileError(
+            "distinctCount/unionSet in join queries lands in a later phase")
 
     out_target = query.output_stream.target_id if query.output_stream else ""
     out_def = StreamDefinition(out_target or f"#{name}.out")
@@ -170,8 +211,10 @@ def plan_join_query(
             (jt == "LEFT_OUTER_JOIN" and this_is_left) or
             (jt == "RIGHT_OUTER_JOIN" and not this_is_left) or
             jt == "FULL_OUTER_JOIN")
+        K_other = Kr if this_is_left else Kl
 
-        def step(state, ts, kind, valid, cols, other_table_cols, now):
+        def step(state, ts, kind, valid, cols, gslot, other_table_cols,
+                 now):
             wl_state, wr_state, sel_state = state
             this_state = wl_state if this_is_left else wr_state
             other_state = wr_state if this_is_left else wl_state
@@ -183,17 +226,18 @@ def plan_join_query(
                 keep = jnp.logical_and(keep, jnp.logical_or(
                     jnp.logical_not(is_cur), f.fn(env0)))
             rows = Rows(ts=ts, kind=kind, valid=keep,
-                        seq=jnp.zeros_like(ts), gslot=jnp.zeros(
-                            ts.shape, jnp.int32), cols=cols)
+                        seq=jnp.zeros_like(ts), gslot=gslot, cols=cols)
             this_state, wout = this.window.process(this_state, rows, now)
             orows = wout.rows                       # [R]
 
-            # other side's buffer
+            # other side's buffer (gslot rides the window buffer rows)
             if other.is_table:
                 o_cols, o_ts, o_alive = other_table_cols
+                o_gslot = jnp.zeros(o_ts.shape, jnp.int32)
             else:
                 obuf: Buffer = other_state[0]
                 o_cols, o_ts, o_alive = obuf.cols, obuf.ts, obuf.alive
+                o_gslot = obuf.gslot
 
             R = orows.ts.shape[0]
             C = o_ts.shape[0]
@@ -244,12 +288,21 @@ def plan_join_query(
                 "__ts__": orows.ts[li],
                 "__now__": now,
             }
+            # composed group slot: gl * (Kr + 1) + gr; unmatched outer rows
+            # take the other side's null-group id (K_other)
+            tg = orows.gslot[li]
+            og = jnp.where(null_tail, K_other,
+                           o_gslot[jnp.clip(ri, 0, C - 1)])
+            if this_is_left:
+                comp = tg * (Kr + 1) + og
+            else:
+                comp = og * (Kr + 1) + tg
             jrows = Rows(
                 ts=orows.ts[li],
                 kind=orows.kind[li],
                 valid=all_valid,
                 seq=orows.seq[li] * (C + 1) + ri,
-                gslot=jnp.zeros((N,), jnp.int32),
+                gslot=comp.astype(jnp.int32),
                 cols=(),
             )
             sel_state, out = sel.process(sel_state, jrows, sel_env)
@@ -288,12 +341,14 @@ def plan_join_query(
         selector_exec=sel,
         step_left=step_left, step_right=step_right,
         init_state=init_state, batch_capacity=batch_capacity,
+        slot_allocator=gl_alloc, slot_allocator2=gr_alloc,
+        gl_pos=gl_pos, gr_pos=gr_pos,
         needs_timer=(left.window is not None and left.window.needs_timer) or
                     (right.window is not None and right.window.needs_timer))
 
 
 def _make_feed_only(side: JoinSide, is_left: bool):
-    def step(state, ts, kind, valid, cols, other_table_cols, now):
+    def step(state, ts, kind, valid, cols, gslot, other_table_cols, now):
         wl_state, wr_state, sel_state = state
         this_state = wl_state if is_left else wr_state
         env0 = {side.key: cols, "__ts__": ts, "__now__": now}
@@ -303,7 +358,7 @@ def _make_feed_only(side: JoinSide, is_left: bool):
             keep = jnp.logical_and(keep, jnp.logical_or(
                 jnp.logical_not(is_cur), f.fn(env0)))
         rows = Rows(ts=ts, kind=kind, valid=keep, seq=jnp.zeros_like(ts),
-                    gslot=jnp.zeros(ts.shape, jnp.int32), cols=cols)
+                    gslot=gslot, cols=cols)
         this_state, wout = side.window.process(this_state, rows, now)
         out_empty = (
             jnp.zeros((1,), jnp.int64), jnp.zeros((1,), jnp.int32),

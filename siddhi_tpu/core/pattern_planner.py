@@ -184,6 +184,10 @@ def plan_pattern_query(
     sel = SelectorExec(query.selector, pexec.scope,
                        _first_schema(spec, schemas), group_slots,
                        out_target or name, interner)
+    if sel.bank.pair_sources:
+        raise CompileError(
+            "distinctCount/unionSet in pattern queries lands in a later "
+            "phase")
 
     out_def = StreamDefinition(out_target or f"#{name}.out")
     for n, t in zip(sel.out_names, sel.out_types):

@@ -66,6 +66,10 @@ class PlannedQuery:
     window_key_allocator: Optional[SlotAllocator] = None
     window_key_positions: Optional[List[int]] = None
     key_capacity: int = 0
+    # distinctCount: (pair allocator, value-column position) per call —
+    # (group, value) pairs resolve to refcount slots on the host
+    pair_allocs: List[Tuple[SlotAllocator, int]] = \
+        dataclasses.field(default_factory=list)
 
 
 def _env_for(scope_key: str, cols, ts):
@@ -222,6 +226,18 @@ def plan_single_query(
     allocator = SlotAllocator(group_slots, name=f"{name}:groupby") \
         if needs_alloc else None
 
+    # distinctCount pair slots: (group, value) -> refcount slot
+    pair_allocs: List[Tuple[SlotAllocator, int]] = []
+    if sel.bank.pair_sources:
+        if seen_window or keyed_window:
+            raise CompileError(
+                "distinctCount over windowed queries lands in a later "
+                "phase (expired-row pair slots need buffer plumbing)")
+        for j, v in enumerate(sel.bank.pair_sources):
+            _, pos, _ = scope.resolve(v)
+            pair_allocs.append((SlotAllocator(
+                sel.bank.K * 8, name=f"{name}:distinct{j}"), pos))
+
     out_event_type = (query.output_stream.output_event_type
                       if query.output_stream and
                       query.output_stream.output_event_type
@@ -230,7 +246,8 @@ def plan_single_query(
     # ---- the fused step -----------------------------------------------------
     wproc = window_proc
 
-    def step(state, ts, kind, valid, cols, gslot, now, in_tabs=()):
+    def step(state, ts, kind, valid, cols, gslot, now, in_tabs=(),
+             pslots=()):
         wstate, astate = state
         env = {sid: cols, "__ts__": ts, "__now__": now, "__kind__": kind}
         for dep, (tcol0, tvalid) in zip(in_deps, in_tabs):
@@ -255,6 +272,9 @@ def plan_single_query(
         for k, v in env.items():
             if k.startswith("__in__:"):
                 env2[k] = v
+        # distinctCount pair slots (unwindowed: orows is the input order)
+        for j in range(len(pair_allocs)):
+            env2[f"__pslot__{j}"] = pslots[j]
         if post_chain:
             data_row = jnp.logical_or(orows.kind == ev.CURRENT,
                                       orows.kind == ev.EXPIRED)
@@ -360,4 +380,5 @@ def plan_single_query(
         window_key_allocator=window_key_allocator,
         window_key_positions=list(partition_positions or []),
         key_capacity=key_capacity,
+        pair_allocs=pair_allocs,
     )

@@ -35,20 +35,31 @@ class OutputRateLimiter:
 
 class PerEventsLimiter(OutputRateLimiter):
     """`output [all|first|last] every N events` (reference:
-    ratelimit/event/*PerEventOutputRateLimiter.java).  Counts CURRENT
-    output events; at each full window of N, ALL flushes the buffer, FIRST
-    emits only the window's first event, LAST only its Nth."""
+    ratelimit/event/*PerEventOutputRateLimiter.java, incl. the
+    First/LastGroupByPerEvent variants).  Counts CURRENT output events; at
+    each full window of N, ALL flushes the buffer, FIRST emits only the
+    window's first event, LAST only its Nth.  With group-by, FIRST emits
+    each GROUP's first event within the window and LAST emits each group's
+    latest event at the window boundary."""
 
-    def __init__(self, deliver, n: int, behavior: str):
+    def __init__(self, deliver, n: int, behavior: str,
+                 group_positions: Optional[List[int]] = None):
         super().__init__(deliver)
         self.n = n
         self.behavior = behavior
+        self.group_positions = group_positions
         self._buf: List[Tuple[int, ev.Event]] = []
         self._count = 0
         self._first_sent = False
+        self._group_first: set = set()
+        self._group_last: dict = {}
+
+    def _key(self, e: ev.Event):
+        return tuple(e.data[i] for i in self.group_positions)
 
     def process(self, pairs, now):
         out: List[Tuple[int, ev.Event]] = []
+        grouped = bool(self.group_positions)
         for kind, e in pairs:
             if self.behavior == "ALL":
                 self._buf.append((kind, e))
@@ -58,44 +69,79 @@ class PerEventsLimiter(OutputRateLimiter):
                     self._buf.clear()
                     self._count = 0
             elif self.behavior == "FIRST":
-                if not self._first_sent:
-                    out.append((kind, e))
-                    self._first_sent = True
+                if grouped:
+                    k = self._key(e)
+                    if k not in self._group_first:
+                        out.append((kind, e))
+                        self._group_first.add(k)
+                else:
+                    if not self._first_sent:
+                        out.append((kind, e))
+                        self._first_sent = True
                 self._count += 1
                 if self._count == self.n:
                     self._count = 0
                     self._first_sent = False
+                    self._group_first.clear()
             else:  # LAST
+                if grouped:
+                    self._group_last[self._key(e)] = (kind, e)
                 self._count += 1
                 if self._count == self.n:
-                    out.append((kind, e))
+                    if grouped:
+                        out.extend(self._group_last.values())
+                        self._group_last.clear()
+                    else:
+                        out.append((kind, e))
                     self._count = 0
         if out:
             self.deliver(out, now)
 
 
 class PerTimeLimiter(OutputRateLimiter):
-    """`output [all|first|last] every <t>` (reference: ratelimit/time/*).
-    Scheduler-driven: every t ms the buffered (ALL), first (FIRST) or most
-    recent (LAST) output is flushed."""
+    """`output [all|first|last] every <t>` (reference: ratelimit/time/*,
+    incl. First/LastGroupByPerTime variants).  Scheduler-driven: every t ms
+    the buffered (ALL), first (FIRST) or most recent (LAST) output is
+    flushed.  With group-by, FIRST emits each group's first event of the
+    interval immediately; LAST flushes each group's latest at the tick."""
 
     needs_timer = True
 
-    def __init__(self, deliver, interval_ms: int, behavior: str):
+    def __init__(self, deliver, interval_ms: int, behavior: str,
+                 group_positions: Optional[List[int]] = None):
         super().__init__(deliver)
         self.interval = interval_ms
         self.behavior = behavior
+        self.group_positions = group_positions
         self._buf: List[Tuple[int, ev.Event]] = []
+        self._group_first: set = set()
+        self._group_last: dict = {}
         self._schedule: Optional[Callable[[int], None]] = None
 
+    def _key(self, e: ev.Event):
+        return tuple(e.data[i] for i in self.group_positions)
+
     def process(self, pairs, now):
+        grouped = bool(self.group_positions)
         if self.behavior == "FIRST":
-            # emit immediately the first event of each interval
-            if not self._buf and pairs:
+            if grouped:
+                out = []
+                for kind, e in pairs:
+                    k = self._key(e)
+                    if k not in self._group_first:
+                        self._group_first.add(k)
+                        out.append((kind, e))
+                if out:
+                    self.deliver(out, now)
+            elif not self._buf and pairs:
+                # emit immediately the first event of each interval
                 self.deliver([pairs[0]], now)
                 self._buf = [pairs[0]]       # marks "sent this interval"
         elif self.behavior == "LAST":
-            if pairs:
+            if grouped:
+                for kind, e in pairs:
+                    self._group_last[self._key(e)] = (kind, e)
+            elif pairs:
                 self._buf = [pairs[-1]]
         else:
             self._buf.extend(pairs)
@@ -103,6 +149,10 @@ class PerTimeLimiter(OutputRateLimiter):
     def on_timer(self, now: int) -> None:
         if self.behavior == "FIRST":
             self._buf = []
+            self._group_first.clear()
+        elif self.behavior == "LAST" and self._group_last:
+            self.deliver(list(self._group_last.values()), now)
+            self._group_last.clear()
         elif self._buf:
             self.deliver(self._buf, now)
             self._buf = []
@@ -148,10 +198,10 @@ def create_rate_limiter(output_rate, deliver,
         return None
     if output_rate.type == "EVENTS":
         return PerEventsLimiter(deliver, int(output_rate.value),
-                                output_rate.behavior)
+                                output_rate.behavior, group_positions)
     if output_rate.type == "TIME":
         return PerTimeLimiter(deliver, int(output_rate.value),
-                              output_rate.behavior)
+                              output_rate.behavior, group_positions)
     if output_rate.type == "SNAPSHOT":
         return SnapshotLimiter(deliver, int(output_rate.value),
                                group_positions)

@@ -157,6 +157,11 @@ class QueryRuntime:
             gslot = np.zeros((staged.ts.shape[0],), np.int32)
         if self._touch is not None:
             self._touch(gslot, now)
+        # distinctCount: (group, value) -> pair refcount slots
+        pslots = tuple(
+            jax.numpy.asarray(alloc.slots_for(
+                [gslot, staged.cols[pos]], valid))
+            for alloc, pos in p.pair_allocs)
         batch = staged.to_device(p.in_schema)
         in_tabs = tuple(
             (self.app.tables[d].cols[0], self.app.tables[d].valid)
@@ -164,7 +169,7 @@ class QueryRuntime:
         self.state, out, wake = p.step(
             self.state, batch.ts, batch.kind, batch.valid, batch.cols,
             jax.numpy.asarray(gslot), jax.numpy.asarray(now, jax.numpy.int64),
-            in_tabs)
+            in_tabs, pslots)
         # the device-computed wake scalar rides the emission fetch (a sync
         # int(wake) here would stall the send path one tunnel RTT per batch)
         wake_arg = None
@@ -181,32 +186,36 @@ class QueryRuntime:
         and the window state slab advances under vmap (planner.kstep)."""
         p = self.planned
         valid = staged.valid
-        if p.partition_key_fn is not None:
+        kcols: List[np.ndarray] = []
+        if all_keys:
+            # timer tick: advance EVERY key's window; each key sees the
+            # TIMER row (staged row 0) so flush-on-timer windows
+            # (cron/timeBatch) fire per key, and `now` drives time expiry.
+            # The partition key fn is NOT applied: a TIMER row's zeroed
+            # columns would fail every range condition and kill the row.
+            key_idx = np.arange(p.key_capacity, dtype=np.int32)
+            sel = np.zeros((p.key_capacity, 1), np.int32)
+        elif p.partition_key_fn is not None:
             kcols, kvalid = p.partition_key_fn(staged)
             valid = valid & kvalid
             kcols = list(kcols)
         else:
             kcols = [staged.cols[i] for i in p.window_key_positions]
-        if all_keys:
-            # timer tick: advance EVERY key's window; each key sees the
-            # TIMER row (staged row 0) so flush-on-timer windows
-            # (cron/timeBatch) fire per key, and `now` drives time expiry
-            key_idx = np.arange(p.key_capacity, dtype=np.int32)
-            sel = np.zeros((p.key_capacity, 1), np.int32)
-        else:
+        if not all_keys:
             _, key_idx, sel = p.window_key_allocator.slots_and_group(
                 kcols, valid, pad=p.key_capacity)
         if self._touch is not None and not all_keys:
             self._touch(key_idx, now)
-        if p.slot_allocator is not None:
+        if p.slot_allocator is not None and not all_keys:
             if p.partition_key_fn is not None:
                 gk = kcols + [staged.cols[i] for i in p.group_by_positions]
             else:
                 gk = [staged.cols[i] for i in p.group_by_positions]
             gslot = p.slot_allocator.slots_for(gk, valid)
-            if self._touch_group is not None and not all_keys:
+            if self._touch_group is not None:
                 self._touch_group(gslot, now)
         else:
+            # timer ticks carry no data rows: no group slots to resolve
             gslot = np.zeros((staged.ts.shape[0],), np.int32)
         batch = ev.StagedBatch(staged.ts, staged.kind, valid, staged.cols,
                                staged.n).to_device(p.in_schema)
@@ -746,9 +755,18 @@ class JoinQueryRuntime:
         step = p.step_left if is_left else p.step_right
         if step is None:
             return
+        # per-side group-by slots (joined rows compose both sides' ids)
+        galloc = p.slot_allocator if is_left else p.slot_allocator2
+        gpos = p.gl_pos if is_left else p.gr_pos
+        if galloc is not None:
+            gslot = galloc.slots_for(
+                [staged.cols[i] for i in gpos], staged.valid)
+        else:
+            gslot = np.zeros((staged.ts.shape[0],), np.int32)
         batch = staged.to_device(side.schema)
         self.state, out, wake = step(
             self.state, batch.ts, batch.kind, batch.valid, batch.cols,
+            jax.numpy.asarray(gslot),
             self._other_table(is_left),
             jax.numpy.asarray(now, jax.numpy.int64))
         _emit_output(self, out, now,
@@ -1000,6 +1018,19 @@ class _PartitionPurger:
                 self._init_cols[id(qr)] = (jax.numpy.asarray(b32i),
                                            jax.numpy.asarray(b64i))
                 continue
+            if not hasattr(qr, "_touch"):
+                # join runtimes have no liveness hook: purging their group
+                # allocator would judge ACTIVE slots idle and corrupt
+                # aggregates; leave them out of the GC
+                continue
+            if getattr(qr.planned, "pair_allocs", None):
+                # distinctCount pair slots key on the group slot; recycling
+                # group slots under them would corrupt refcounts
+                import logging
+                logging.getLogger("siddhi_tpu").warning(
+                    "@purge skips query %s: distinctCount state is not "
+                    "purgeable yet", qr.name)
+                continue
             if getattr(qr.planned, "keyed_window", False):
                 # keyed-window runtimes share the partition key allocator
                 qr._touch = self._make_touch(self._seen_shared)
@@ -1076,7 +1107,11 @@ class _PartitionPurger:
         wstate, astate = qr.state
         specs = qr.planned.selector_exec.bank.specs
         jidx = jax.numpy.asarray(idx)
-        astate = tuple(a.at[jidx].set(s.init)
+        # pair-indexed specs (distinctCount refcounts) live in a different
+        # slot space; queries carrying them are excluded from purge at
+        # registration, this guard is defense in depth
+        astate = tuple(a if s.slot_src is not None
+                       else a.at[jidx].set(s.init)
                        for a, s in zip(astate, specs))
         qr.state = (wstate, astate)
 
@@ -1487,13 +1522,25 @@ class SiddhiAppRuntime:
         if q.output_rate is None:
             return
         group_positions = None
-        if q.output_rate.type == "SNAPSHOT" and q.selector.group_by_list:
+        if q.selector.group_by_list:
+            # positions of projected group-by attributes in the OUTPUT row
+            # (the GroupBy limiter variants key on them; reference:
+            # ratelimit/event/FirstGroupByPerEventOutputRateLimiter etc.)
             from ..query_api.expression import Variable as V
             gb_names = {v.attribute_name for v in q.selector.group_by_list}
             group_positions = [
                 i for i, oa in enumerate(q.selector.selection_list)
                 if isinstance(oa.expression, V)
                 and oa.expression.attribute_name in gb_names] or None
+            if group_positions is None and \
+                    q.output_rate.behavior in ("FIRST", "LAST"):
+                # the grouped limiter keys on the group attrs in the OUTPUT
+                # row; without them it would silently degrade to ungrouped
+                # first/last (reference keys on the internal group key)
+                raise CompileError(
+                    f"output {q.output_rate.behavior.lower()} with group "
+                    f"by requires projecting the group-by attribute(s) in "
+                    f"the select clause")
         lim = create_rate_limiter(
             q.output_rate,
             lambda pairs, now, _rt=runtime: _deliver_pairs(_rt, pairs, now),
@@ -2002,9 +2049,15 @@ class SiddhiAppRuntime:
             for name, qr in self.query_runtimes.items():
                 host_state = jax.tree.map(lambda x: np.asarray(x), qr.state)
                 alloc = _allocator_of(qr)
+                alloc2 = getattr(qr.planned, "slot_allocator2", None)
                 states[name] = {
                     "state": host_state,
                     "slots": alloc.snapshot() if alloc is not None else None,
+                    "slots2": alloc2.snapshot()
+                    if alloc2 is not None else None,
+                    "slots_pairs": [
+                        a.snapshot() for a, _ in
+                        getattr(qr.planned, "pair_allocs", [])] or None,
                     "wake": getattr(qr, "next_wakeup", None),
                 }
             windows = {
@@ -2061,12 +2114,18 @@ class SiddhiAppRuntime:
                     }
                     dirty[:] = False
                 else:
+                    alloc2 = getattr(qr.planned, "slot_allocator2", None)
                     deltas[name] = {
                         "kind": "full",
                         "state": jax.tree.map(
                             lambda x: np.asarray(x), qr.state),
                         "slots": alloc.snapshot()
                         if alloc is not None else None,
+                        "slots2": alloc2.snapshot()
+                        if alloc2 is not None else None,
+                        "slots_pairs": [
+                            a.snapshot() for a, _ in
+                            getattr(qr.planned, "pair_allocs", [])] or None,
                         "wake": getattr(qr, "next_wakeup", None),
                     }
             from .table import _table_state
@@ -2112,6 +2171,15 @@ class SiddhiAppRuntime:
                         lambda x: jax.numpy.asarray(x), d["state"])
                     if d["slots"] is not None and alloc is not None:
                         alloc.restore(d["slots"])
+                    alloc2 = getattr(qr.planned, "slot_allocator2", None)
+                    if d.get("slots2") is not None and alloc2 is not None:
+                        alloc2.restore(d["slots2"])
+                    pairs = d.get("slots_pairs")
+                    if pairs:
+                        for (a, _), snap in zip(
+                                getattr(qr.planned, "pair_allocs", []),
+                                pairs):
+                            a.restore(snap)
                 w = d.get("wake")
                 if w is not None and hasattr(qr, "_apply_wake"):
                     qr._apply_wake(int(w))
@@ -2131,6 +2199,14 @@ class SiddhiAppRuntime:
                 alloc = _allocator_of(qr)
                 if data["slots"] is not None and alloc is not None:
                     alloc.restore(data["slots"])
+                alloc2 = getattr(qr.planned, "slot_allocator2", None)
+                if data.get("slots2") is not None and alloc2 is not None:
+                    alloc2.restore(data["slots2"])
+                pairs = data.get("slots_pairs")
+                if pairs:
+                    for (a, _), snap in zip(
+                            getattr(qr.planned, "pair_allocs", []), pairs):
+                        a.restore(snap)
                 # re-arm pending timers (absent deadlines, window expiry):
                 # the scheduler of this fresh runtime knows nothing of the
                 # wakeups the snapshotted state still expects

@@ -71,8 +71,13 @@ class _AggSpec:
     op: Callable                  # associative op
     init: Any                     # identity scalar
     dtype: Any
-    # vals_fn(env, sign) -> [B] contribution per row
+    # vals_fn(env, sign) -> [B] contribution per row; may read
+    # env['__scanres__'][i] (running values of earlier specs)
     vals_fn: Callable
+    # segment by a pair-slot column (env['__pslot__<j>']) instead of the
+    # group slot — used by distinctCount's per-(group, value) refcounts
+    slot_src: Optional[int] = None
+    K_override: Optional[int] = None
 
 
 class AggregatorBank:
@@ -83,6 +88,9 @@ class AggregatorBank:
         self.K = group_slots
         self.specs: List[_AggSpec] = []
         self._index: Dict[str, int] = {}
+        # distinctCount: Variables whose (group, value) pairs get host
+        # slot allocation; planner resolves them to column positions
+        self.pair_sources: List[Variable] = []
 
     def _add(self, spec: _AggSpec) -> int:
         if spec.key in self._index:
@@ -93,7 +101,8 @@ class AggregatorBank:
 
     def init_state(self):
         return tuple(
-            jnp.full((self.K,), s.init, dtype=s.dtype) for s in self.specs)
+            jnp.full((s.K_override or self.K,), s.init, dtype=s.dtype)
+            for s in self.specs)
 
     # -- aggregator compilation ----------------------------------------------
     def compile_call(self, fn_expr: AttributeFunction, scope: Scope,
@@ -101,6 +110,30 @@ class AggregatorBank:
         """Returns (result_type, result_fn(scan_results)->array, name).
         `scan_results` is the tuple of per-row running values, one per spec."""
         name = fn_expr.name
+        if name == "distinctCount":
+            orig = fn_expr.parameters[0]
+            if not isinstance(orig, Variable):
+                raise CompileError(
+                    "distinctCount needs a plain attribute argument")
+            i_dc = self._distinct_spec(orig, expr_key)
+            return "LONG", (lambda res, _i=i_dc: res[_i]), name
+        if name == "unionSet":
+            # reference: UnionSetAttributeAggregatorExecutor over
+            # createSet(attr) values.  The set itself cannot materialize in
+            # a columnar output; sizeOfSet(unionSet(createSet(x))) — the
+            # reference's canonical composition — maps onto the exact
+            # distinct machinery, so the 'SET' pseudo-value carries the
+            # running distinct count.  (Handled before arg compilation:
+            # bare createSet deliberately fails to compile.)
+            inner = fn_expr.parameters[0]
+            if not (isinstance(inner, AttributeFunction) and
+                    not inner.namespace and inner.name == "createSet" and
+                    len(inner.parameters) == 1 and
+                    isinstance(inner.parameters[0], Variable)):
+                raise CompileError(
+                    "unionSet expects createSet(<attribute>) in this build")
+            i_dc = self._distinct_spec(inner.parameters[0], expr_key)
+            return "SET", (lambda res, _i=i_dc: res[_i]), name
         args = [compile_expression(p, scope) for p in fn_expr.parameters]
 
         def fvals(c: CompiledExpr, dtype):
@@ -183,11 +216,32 @@ class AggregatorBank:
                 return "BOOL", (lambda res, _i=i: res[_i] > 0), name
             return "BOOL", (lambda res, _i=i: res[_i] == 0), name
 
-        if name == "distinctCount":
-            raise CompileError(
-                "distinctCount is not yet supported on device")
-
         raise CompileError(f"unknown aggregator {name!r}")
+
+    def _distinct_spec(self, var: Variable, expr_key: str) -> int:
+        """Exact distinct count (reference: DistinctCountAttribute-
+        AggregatorExecutor's per-value refcount map).  TPU design:
+        (group, value) pairs resolve to pair slots on the host; a
+        pair-segmented scan maintains refcounts, and 0<->1 refcount
+        transitions feed a group-segmented scan as +-1 contributions."""
+        j = len(self.pair_sources)
+        self.pair_sources.append(var)
+        i_ref = self._add(_AggSpec(
+            f"ref:{expr_key}", jnp.add, 0, jnp.int64,
+            lambda env, sign: jnp.asarray(sign, jnp.int64),
+            slot_src=j, K_override=self.K * 8))
+
+        def dvals(env, sign, _r=i_ref):
+            r = env["__scanres__"][_r]
+            return jnp.where(
+                jnp.logical_and(jnp.asarray(sign) > 0, r == 1),
+                jnp.asarray(1, jnp.int64),
+                jnp.where(
+                    jnp.logical_and(jnp.asarray(sign) < 0, r == 0),
+                    jnp.asarray(-1, jnp.int64),
+                    jnp.asarray(0, jnp.int64)))
+        return self._add(_AggSpec(
+            f"dc:{expr_key}", jnp.add, 0, jnp.int64, dvals))
 
     # -- runtime -------------------------------------------------------------
     def process(self, state, rows: Rows, env) -> Tuple[Any, Tuple]:
@@ -206,29 +260,39 @@ class AggregatorBank:
         epoch_before = reset_epoch - is_reset.astype(jnp.int64)
         total_resets = reset_epoch[-1]
 
-        # segment id: (slot, epoch); rows already seq-ordered
-        seg = gslot.astype(jnp.int64) * (B + 2) + epoch_before
-        order = jnp.argsort(seg, stable=True)
-        unorder = jnp.zeros((B,), jnp.int32).at[order].set(
-            jnp.arange(B, dtype=jnp.int32))
-        seg_s = seg[order]
-        first = jnp.concatenate([
-            jnp.ones((1,), jnp.bool_), seg_s[1:] != seg_s[:-1]])
+        def layout(slot_vec):
+            # segment id: (slot, epoch); rows already seq-ordered
+            seg = slot_vec.astype(jnp.int64) * (B + 2) + epoch_before
+            order = jnp.argsort(seg, stable=True)
+            unorder = jnp.zeros((B,), jnp.int32).at[order].set(
+                jnp.arange(B, dtype=jnp.int32))
+            seg_s = seg[order]
+            first = jnp.concatenate([
+                jnp.ones((1,), jnp.bool_), seg_s[1:] != seg_s[:-1]])
+            return (order, unorder, seg_s, first, sign[order],
+                    slot_vec[order], epoch_before[order])
 
-        sign_s = sign[order]
-        gslot_s = gslot[order]
-        epoch_s = epoch_before[order]
+        layouts = {None: layout(gslot)}
+        for j in range(len(self.pair_sources)):
+            ps = env.get(f"__pslot__{j}")
+            if ps is not None:
+                layouts[j] = layout(
+                    jnp.where(ps >= 0, ps, 0).astype(jnp.int32))
 
+        env = dict(env)
+        env["__scanres__"] = results = []
         new_state = []
-        results = []
         for spec, st in zip(self.specs, state):
+            (order, unorder, seg_s, first, sign_s, slot_s,
+             epoch_s) = layouts[spec.slot_src]
+            K = spec.K_override or self.K
             vals = spec.vals_fn(env, sign)
             # rows that don't contribute carry the identity
             vals = jnp.where(sign != 0, vals,
                              jnp.asarray(spec.init, spec.dtype))
             v_s = vals[order]
             # inject carry state at heads of epoch-0 segments
-            carry = st[gslot_s]
+            carry = st[slot_s]
             v_s = jnp.where(
                 jnp.logical_and(first, epoch_s == 0),
                 spec.op(carry, v_s), v_s)
@@ -237,18 +301,15 @@ class AggregatorBank:
 
             # new state: per slot, value after the last row in the final epoch
             contrib = jnp.logical_and(sign_s != 0, epoch_s == total_resets)
-            # last contributing row of each slot (sorted order): next row with
-            # different slot or non-contributing
             idx = jnp.arange(B)
-            last_of_slot = jnp.zeros((self.K,), jnp.int32)
             # scatter-max of sorted index per slot for contributing rows
-            last_idx = jnp.full((self.K,), -1, jnp.int32).at[
-                jnp.where(contrib, gslot_s, self.K).astype(jnp.int32)
+            last_idx = jnp.full((K,), -1, jnp.int32).at[
+                jnp.where(contrib, slot_s, K).astype(jnp.int32)
             ].max(jnp.where(contrib, idx, -1).astype(jnp.int32), mode="drop")
             has = last_idx >= 0
             gathered = scanned[jnp.clip(last_idx, 0, B - 1)]
             base = jnp.where(total_resets > 0,
-                             jnp.full((self.K,), spec.init, spec.dtype), st)
+                             jnp.full((K,), spec.init, spec.dtype), st)
             # carry survives only if no reset happened
             ns = jnp.where(has, gathered, base)
             new_state.append(ns)
@@ -337,11 +398,23 @@ class SelectorExec:
             self._compiled_proj.append(
                 _compile_with_pseudo(rewritten, scope, self._agg_results))
         self.out_types = [c.type for c in self._compiled_proj]
+        if "SET" in self.out_types:
+            raise CompileError(
+                "set values cannot materialize in columnar outputs; wrap "
+                "with sizeOfSet(...)")
 
         self.having = None
         if selector.having_expression is not None:
-            hre = _rewrite_aggregators(
-                selector.having_expression, self._agg_calls, "__agg")
+            # having may reference select ALIASES (reference: having runs
+            # over the output event); substitute them with the projected
+            # expression before aggregator rewriting
+            alias_map = {}
+            for oa, (expr, _) in zip(sel_list, self._proj):
+                if oa.rename:
+                    alias_map[oa.rename] = oa.expression
+            hre = _substitute_aliases(
+                selector.having_expression, alias_map, scope)
+            hre = _rewrite_aggregators(hre, self._agg_calls, "__agg")
             # new aggs may have been appended by having
             while len(self._agg_results) < len(self._agg_calls):
                 i = len(self._agg_results)
@@ -417,6 +490,28 @@ class SelectorExec:
                 keep = jnp.logical_and(keep, rank < lo + self.selector.limit)
             valid = jnp.logical_and(valid, keep)
         return ts, kind, valid, out_cols
+
+
+def _substitute_aliases(e: Expression, alias_map, scope) -> Expression:
+    """Replace unqualified Variables naming a select alias with the aliased
+    expression, unless the name also resolves to a real input attribute
+    (input attributes win, matching single-source behavior)."""
+    if isinstance(e, Variable) and e.stream_id is None and \
+            e.attribute_name in alias_map:
+        try:
+            scope.resolve(e)
+            return e              # a real input attribute shadows the alias
+        except CompileError:
+            return alias_map[e.attribute_name]
+    for f in getattr(e, "__dataclass_fields__", {}):
+        v = getattr(e, f)
+        if isinstance(v, Expression):
+            setattr(e, f, _substitute_aliases(v, alias_map, scope))
+        elif isinstance(v, list):
+            setattr(e, f, [
+                _substitute_aliases(x, alias_map, scope)
+                if isinstance(x, Expression) else x for x in v])
+    return e
 
 
 def _expr_fingerprint(e: Expression) -> str:

@@ -110,3 +110,74 @@ def test_output_snapshot_every_time_grouped():
     snap = {e.data[0]: e.data[1] for e in full}
     assert snap == {"a": 3, "b": 10}
     manager.shutdown()
+
+
+def test_output_first_group_by_every_events():
+    """FIRST + group-by: each GROUP's first event per window (reference:
+    FirstGroupByPerEventOutputRateLimiter)."""
+    ql = """
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, sum(v) as total group by k
+    output first every 4 events insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("In")
+    h.send(["a", 1])      # first of group a -> emit (a, 1)
+    h.send(["b", 10])     # first of group b -> emit (b, 10)
+    h.send(["a", 2])      # suppressed
+    h.send(["b", 20])     # suppressed; window of 4 complete -> reset
+    h.send(["a", 3])      # first of a in new window -> emit (a, 6)
+    rt.flush()
+    assert [tuple(e.data) for e in got] == [("a", 1), ("b", 10), ("a", 6)]
+    manager.shutdown()
+
+
+def test_output_last_group_by_every_events():
+    """LAST + group-by: each group's latest at the window boundary
+    (reference: LastGroupByPerEventOutputRateLimiter)."""
+    ql = """
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, sum(v) as total group by k
+    output last every 4 events insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("In")
+    h.send(["a", 1])
+    h.send(["b", 10])
+    h.send(["a", 2])      # a's running sum: 3
+    h.send(["b", 20])     # window boundary: emit latest per group
+    rt.flush()
+    assert sorted(tuple(e.data) for e in got) == [("a", 3), ("b", 30)]
+    manager.shutdown()
+
+
+def test_output_last_group_by_every_time():
+    """LAST + group-by per-time: latest per group flushed at the tick
+    (reference: LastGroupByPerTimeOutputRateLimiter)."""
+    ql = """
+    define stream In (k string, v int);
+    @info(name='q')
+    from In select k, sum(v) as total group by k
+    output last every 1 sec insert into Out;
+    """
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("In")
+    h.send(["a", 1])
+    h.send(["a", 2])
+    h.send(["b", 5])
+    lim = rt.query_runtimes["q"].rate_limiter
+    lim.on_timer(int(time.time() * 1000))
+    rt.flush()
+    assert sorted(tuple(e.data) for e in got) == [("a", 3), ("b", 5)]
+    manager.shutdown()
