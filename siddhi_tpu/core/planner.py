@@ -235,6 +235,10 @@ def plan_single_query(
                 "phase (expired-row pair slots need buffer plumbing)")
         for j, v in enumerate(sel.bank.pair_sources):
             _, pos, _ = scope.resolve(v)
+            if pos >= len(in_schema.names):
+                raise CompileError(
+                    "distinctCount on stream-function-appended attributes "
+                    "is not yet supported")
             pair_allocs.append((SlotAllocator(
                 sel.bank.K * 8, name=f"{name}:distinct{j}"), pos))
 

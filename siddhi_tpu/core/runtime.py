@@ -755,12 +755,15 @@ class JoinQueryRuntime:
         step = p.step_left if is_left else p.step_right
         if step is None:
             return
-        # per-side group-by slots (joined rows compose both sides' ids)
+        # per-side group-by slots (joined rows compose both sides' ids);
+        # TIMER rows carry zeroed columns — allocating for them would burn
+        # a phantom slot for the all-zeros key on every tick
         galloc = p.slot_allocator if is_left else p.slot_allocator2
         gpos = p.gl_pos if is_left else p.gr_pos
         if galloc is not None:
+            gvalid = staged.valid & (staged.kind != ev.TIMER)
             gslot = galloc.slots_for(
-                [staged.cols[i] for i in gpos], staged.valid)
+                [staged.cols[i] for i in gpos], gvalid)
         else:
             gslot = np.zeros((staged.ts.shape[0],), np.int32)
         batch = staged.to_device(side.schema)
@@ -1527,11 +1530,23 @@ class SiddhiAppRuntime:
             # (the GroupBy limiter variants key on them; reference:
             # ratelimit/event/FirstGroupByPerEventOutputRateLimiter etc.)
             from ..query_api.expression import Variable as V
-            gb_names = {v.attribute_name for v in q.selector.group_by_list}
+
+            def _matches(oa_expr) -> bool:
+                # match qualified group-by vars by (stream, attr) so a
+                # same-named attribute from another join side cannot
+                # satisfy the check
+                if not isinstance(oa_expr, V):
+                    return False
+                for v in q.selector.group_by_list:
+                    if v.attribute_name != oa_expr.attribute_name:
+                        continue
+                    if v.stream_id is None or oa_expr.stream_id is None \
+                            or v.stream_id == oa_expr.stream_id:
+                        return True
+                return False
             group_positions = [
                 i for i, oa in enumerate(q.selector.selection_list)
-                if isinstance(oa.expression, V)
-                and oa.expression.attribute_name in gb_names] or None
+                if _matches(oa.expression)] or None
             if group_positions is None and \
                     q.output_rate.behavior in ("FIRST", "LAST"):
                 # the grouped limiter keys on the group attrs in the OUTPUT
