@@ -19,17 +19,31 @@ from . import event as ev
 
 class OutputRateLimiter:
     """Base: `process` receives (kind, Event) pairs in emission order and
-    forwards whatever is due to `deliver`."""
+    forwards whatever is due to `deliver`.
+
+    `process` (query/drainer thread) and `on_timer` (scheduler thread)
+    mutate the same buffers; subclasses call them through the public
+    entry points which serialize on the limiter's own RLock."""
 
     needs_timer = False
 
     def __init__(self, deliver: Callable[[List[Tuple[int, ev.Event]], int], None]):
+        import threading
         self.deliver = deliver
+        self._lk = threading.RLock()
 
     def process(self, pairs: List[Tuple[int, ev.Event]], now: int) -> None:
+        with self._lk:
+            self._process(pairs, now)
+
+    def on_timer(self, now: int) -> None:
+        with self._lk:
+            self._on_timer(now)
+
+    def _process(self, pairs, now) -> None:
         raise NotImplementedError
 
-    def on_timer(self, now: int) -> None:  # pragma: no cover - overridden
+    def _on_timer(self, now: int) -> None:  # pragma: no cover - overridden
         pass
 
 
@@ -57,7 +71,7 @@ class PerEventsLimiter(OutputRateLimiter):
     def _key(self, e: ev.Event):
         return tuple(e.data[i] for i in self.group_positions)
 
-    def process(self, pairs, now):
+    def _process(self, pairs, now):
         out: List[Tuple[int, ev.Event]] = []
         grouped = bool(self.group_positions)
         for kind, e in pairs:
@@ -121,7 +135,7 @@ class PerTimeLimiter(OutputRateLimiter):
     def _key(self, e: ev.Event):
         return tuple(e.data[i] for i in self.group_positions)
 
-    def process(self, pairs, now):
+    def _process(self, pairs, now):
         grouped = bool(self.group_positions)
         if self.behavior == "FIRST":
             if grouped:
@@ -146,7 +160,7 @@ class PerTimeLimiter(OutputRateLimiter):
         else:
             self._buf.extend(pairs)
 
-    def on_timer(self, now: int) -> None:
+    def _on_timer(self, now: int) -> None:
         if self.behavior == "FIRST":
             self._buf = []
             self._group_first.clear()
@@ -179,12 +193,12 @@ class SnapshotLimiter(OutputRateLimiter):
             return tuple(e.data[i] for i in self.group_positions)
         return ()
 
-    def process(self, pairs, now):
+    def _process(self, pairs, now):
         for kind, e in pairs:
             if kind == ev.CURRENT:
                 self._latest[self._key(e)] = e
 
-    def on_timer(self, now: int) -> None:
+    def _on_timer(self, now: int) -> None:
         if self._latest:
             self.deliver([(ev.CURRENT, e) for e in self._latest.values()],
                          now)

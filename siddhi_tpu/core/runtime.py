@@ -54,6 +54,59 @@ class QueryCallback:
         raise NotImplementedError
 
 
+def _sub_lock(sub):
+    """Per-query processing lock of a junction subscriber (wrappers hold
+    the runtime in _qr; aggregations lock internally -> None)."""
+    target = getattr(sub, "_qr", None) or sub
+    return getattr(target, "_qlock", None)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _query_lock(lk, stream_id: str, timeout: float = 30.0):
+    """Bounded query-lock acquisition: a worker holding query X's lock and
+    synchronously routing into query Y can form a cycle with another
+    worker.  Rather than deadlocking forever, fail loudly with the remedy
+    (mark a stream in the cycle @async to break it)."""
+    if not lk.acquire(timeout=timeout):
+        from ..exceptions import SiddhiAppRuntimeError
+        raise SiddhiAppRuntimeError(
+            f"query lock timeout dispatching {stream_id!r}: likely a "
+            f"cyclic synchronous insert-into topology under concurrent "
+            f"ingestion; annotate a stream in the cycle with @async to "
+            f"break it")
+    try:
+        yield
+    finally:
+        lk.release()
+
+
+def _acquire_all(locks):
+    """All-or-nothing multi-lock acquisition with backoff.  Ingestion
+    workers take query locks in routing order (a query emitting into a
+    downstream stream holds its own lock while taking the next), so a
+    fixed-order blocking acquisition here could deadlock; try-acquire and
+    retry instead."""
+    import contextlib
+    while True:
+        acquired = []
+        for lk in locks:
+            if lk.acquire(timeout=0.05):
+                acquired.append(lk)
+            else:
+                break
+        if len(acquired) == len(locks):
+            stack = contextlib.ExitStack()
+            for lk in acquired:
+                stack.callback(lk.release)
+            return stack
+        for lk in reversed(acquired):
+            lk.release()
+        time.sleep(0.001)
+
+
 def _allocator_of(qr):
     """Slot allocator of a query runtime (pattern runtimes hold it
     directly, planned single queries on the plan).  Explicit None checks:
@@ -120,6 +173,10 @@ class QueryRuntime:
         self.callbacks: List[Callable] = []
         self.batch_callbacks: List[Callable] = []
         self.next_wakeup: int = _NO_WAKEUP_INT
+        # per-query processing lock: parallel ingestion serializes PER
+        # QUERY, not per app (reference: per-query ReentrantLock chosen in
+        # QueryParser.java:159-215 instead of one engine-wide lock)
+        self._qlock = threading.RLock()
         # set by _PartitionPurger: fn(slots, now) recording key liveness
         self._touch = None
         self._touch_group = None
@@ -271,6 +328,7 @@ class PatternQueryRuntime:
         self.batch_callbacks: List[Callable] = []
         self.next_wakeup: int = _NO_WAKEUP_INT
         self.slot_allocator = slot_allocator  # shared per partition
+        self._qlock = threading.RLock()
         # per-key dirty mask since the last (incremental) snapshot
         self._dirty = np.zeros(planned.key_capacity, np.bool_) \
             if planned.partition_positions else None
@@ -731,6 +789,7 @@ class JoinQueryRuntime:
         self.callbacks: List[Callable] = []
         self.batch_callbacks: List[Callable] = []
         self.next_wakeup: int = _NO_WAKEUP_INT
+        self._qlock = threading.RLock()
         self.table_op = None
 
     @property
@@ -934,12 +993,88 @@ class StreamJunction:
         self.app = app
         self.queries: List[QueryRuntime] = []
         self.stream_callbacks: List[Callable] = []
+        # @async(buffer.size, workers): bounded ingress queue + worker
+        # threads (the reference's Disruptor ring,
+        # StreamJunction.java:276-313).  None => synchronous dispatch.
+        self._async_q = None
+        self._async_workers: List[threading.Thread] = []
+
+    def enable_async(self, buffer_size: int = 256, workers: int = 1) -> None:
+        """Decouple ingestion: sends enqueue (bounded, blocking when full =
+        backpressure) and worker threads dispatch to the queries.  With
+        workers > 1, cross-batch ordering within the stream is relaxed —
+        same trade as the reference's multi-consumer Disruptor."""
+        if self._async_q is not None:
+            return
+        import queue
+        self._async_q = queue.Queue(maxsize=max(1, buffer_size))
+        for i in range(max(1, workers)):
+            t = threading.Thread(
+                target=self._drain_async, daemon=True,
+                name=f"siddhi-ingest-{self.stream_id}-{i}")
+            t.start()
+            self._async_workers.append(t)
+
+    def enqueue(self, tag: str, payload, now: int) -> None:
+        self._async_q.put((tag, payload, now))
+
+    def _drain_async(self) -> None:
+        while True:
+            tag, payload, now = self._async_q.get()
+            try:
+                if tag == "stop":
+                    return
+                if tag == "staged":
+                    self.dispatch_staged(payload, now)
+                else:
+                    self.publish(payload, now)
+            except Exception:  # noqa: BLE001 — worker must survive
+                import traceback
+                traceback.print_exc()
+            finally:
+                self._async_q.task_done()
+
+    def flush_async(self) -> None:
+        if self._async_q is not None:
+            self._async_q.join()
+
+    def pending_async(self) -> int:
+        return self._async_q.unfinished_tasks if self._async_q is not None \
+            else 0
+
+    def stop_async(self) -> None:
+        """Drain remaining batches, then terminate the workers (clean
+        shutdown keeps at-least-once delivery for accepted sends)."""
+        if self._async_q is None:
+            return
+        self._async_q.join()
+        for _ in self._async_workers:
+            self._async_q.put(("stop", None, 0))
+        for t in self._async_workers:
+            t.join(timeout=2.0)
+        self._async_workers.clear()
+        self._async_q = None
 
     def subscribe_query(self, q: QueryRuntime) -> None:
         self.queries.append(q)
 
     def subscribe_callback(self, cb: Callable) -> None:
         self.stream_callbacks.append(cb)
+
+    def dispatch_staged(self, staged: ev.StagedBatch, now: int) -> None:
+        """Run every subscribed query over a staged batch, serialized per
+        QUERY (not per app) so queries on different streams — or workers of
+        different streams — process concurrently."""
+        for q in self.queries:
+            lk = _sub_lock(q)
+            try:
+                if lk is not None:
+                    with _query_lock(lk, self.stream_id):
+                        q.process_staged(staged, now)
+                else:
+                    q.process_staged(staged, now)
+            except Exception as exc:  # noqa: BLE001 — fault routing
+                self._handle_error_staged(staged, exc, now)
 
     def publish(self, events: List[ev.Event], now: int) -> None:
         stats = self.app.stats if self.app is not None else None
@@ -950,15 +1085,19 @@ class StreamJunction:
         if self.queries:
             staged = ev.pack_np(self.schema, events)
             for q in self.queries:
+                lk = _sub_lock(q)
                 try:
                     if stats is not None and stats.detail:
                         t0 = time.perf_counter_ns()
+                    if lk is not None:
+                        with _query_lock(lk, self.stream_id):
+                            q.process_staged(staged, now)
+                    else:
                         q.process_staged(staged, now)
+                    if stats is not None and stats.detail:
                         stats.query_latency(
                             getattr(q, "name", self.stream_id), len(events),
                             time.perf_counter_ns() - t0)
-                    else:
-                        q.process_staged(staged, now)
                 except Exception as exc:  # noqa: BLE001 — fault routing
                     self._handle_error(events, exc, now)
 
@@ -1074,26 +1213,31 @@ class _PartitionPurger:
 
     def on_timer(self, now: int) -> None:
         cutoff = now - self.idle_ms
-        idle = self._idle_slots(self.shared_alloc, self._seen_shared, now,
-                                cutoff)
-        if idle.size:
-            self.shared_alloc.purge(idle.tolist())
+        # barrier over every runtime this purger mutates: state resets must
+        # not interleave with their ingestion workers
+        locks = [qr._qlock for qr in self.runtimes
+                 if getattr(qr, "_qlock", None) is not None]
+        with _acquire_all(locks):
+            idle = self._idle_slots(self.shared_alloc, self._seen_shared,
+                                    now, cutoff)
+            if idle.size:
+                self.shared_alloc.purge(idle.tolist())
+                for qr in self.runtimes:
+                    if isinstance(qr, PatternQueryRuntime):
+                        self._reset_pattern_keys(qr, idle)
+                    elif getattr(qr.planned, "keyed_window", False):
+                        self._reset_keyed_window(qr, idle)
             for qr in self.runtimes:
                 if isinstance(qr, PatternQueryRuntime):
-                    self._reset_pattern_keys(qr, idle)
-                elif getattr(qr.planned, "keyed_window", False):
-                    self._reset_keyed_window(qr, idle)
-        for qr in self.runtimes:
-            if isinstance(qr, PatternQueryRuntime):
-                continue
-            alloc = getattr(qr.planned, "slot_allocator", None)
-            seen = self._seen_q.get(id(qr))
-            if alloc is None or seen is None:
-                continue
-            qidle = self._idle_slots(alloc, seen, now, cutoff)
-            if qidle.size:
-                alloc.purge(qidle.tolist())
-                self._reset_selector_slots(qr, qidle)
+                    continue
+                alloc = getattr(qr.planned, "slot_allocator", None)
+                seen = self._seen_q.get(id(qr))
+                if alloc is None or seen is None:
+                    continue
+                qidle = self._idle_slots(alloc, seen, now, cutoff)
+                if qidle.size:
+                    alloc.purge(qidle.tolist())
+                    self._reset_selector_slots(qr, qidle)
         self.app._scheduler.notify_at(now + self.interval_ms, self)
 
     def _reset_pattern_keys(self, qr, idx: np.ndarray) -> None:
@@ -1285,7 +1429,16 @@ class _Scheduler:
                     continue
                 heapq.heappop(self._heap)
             try:
-                with self.app._lock:
+                # serialize against the target's ingestion workers; targets
+                # without a query lock get their own (NOT the app lock — a
+                # timer target holding the app lock while taking query
+                # locks downstream could deadlock against a worker emitting
+                # into a named window)
+                lk = getattr(q, "_qlock", None)
+                if lk is None:
+                    lk = q.__dict__.setdefault(
+                        "_qlock", threading.RLock())
+                with lk:
                     q.on_timer(max(ts, self.app.timestamp_millis()))
             except Exception:  # noqa: BLE001 - scheduler must survive
                 import traceback
@@ -1900,6 +2053,18 @@ class SiddhiAppRuntime:
             self._scheduler.start()
             self._started = True
             now = self.timestamp_millis()
+            # @async(buffer.size, workers) streams get an ingress queue +
+            # workers (reference: Disruptor ring per junction).  Playback
+            # keeps synchronous dispatch: event-time must stay ordered.
+            if not self.playback:
+                for sid, j in self.junctions.items():
+                    sdef = self.app.stream_definition_map.get(sid)
+                    ann = sdef.get_annotation("async") \
+                        if sdef is not None else None
+                    if ann is not None:
+                        j.enable_async(
+                            int(ann.element("buffer.size", 256) or 256),
+                            int(ann.element("workers", 1) or 1))
             for sk in self.sinks:
                 sk.start()
             for src in self.sources:
@@ -1913,6 +2078,8 @@ class SiddhiAppRuntime:
         if self._started:
             for src in self.sources:
                 src.stop()
+            for j in self.junctions.values():
+                j.stop_async()       # drain accepted sends, stop workers
             for sk in self.sinks:
                 sk.stop()
             self._drainer.stop()
@@ -1929,8 +2096,25 @@ class SiddhiAppRuntime:
             src.resume()
 
     def flush(self) -> None:
-        """Wait until all asynchronously emitted output has been delivered."""
-        self._drainer.flush()
+        """Wait until all asynchronously ingested batches are processed and
+        all asynchronously emitted output has been delivered.  Iterates to
+        a fixpoint: drained output may re-enter another @async stream."""
+        for _ in range(64):
+            for j in self.junctions.values():
+                j.flush_async()
+            self._drainer.flush()
+            if all(j.pending_async() == 0 for j in self.junctions.values()):
+                return
+
+    def _quiesce(self):
+        """Acquire the app lock plus EVERY query lock (the reference's
+        ThreadBarrier quiescing event threads for snapshots)."""
+        locks = [self._lock]
+        for qname in sorted(self.query_runtimes):
+            lk = getattr(self.query_runtimes[qname], "_qlock", None)
+            if lk is not None:
+                locks.append(lk)
+        return _acquire_all(locks)
 
     def timestamp_millis(self) -> int:
         if self.playback:
@@ -1987,14 +2171,13 @@ class SiddhiAppRuntime:
         if self.playback and n:
             self._playback_time = max(self._playback_time, int(ts[:n].max()))
         now = self.timestamp_millis()
-        with self._lock:
-            if self.playback:
+        if self.playback:
+            with self._lock:
                 self._scheduler.drain_playback(now)
-            for q in junction.queries:
-                try:
-                    q.process_staged(staged, now)
-                except Exception as exc:  # noqa: BLE001 — fault routing
-                    junction._handle_error_staged(staged, exc, now)
+        elif junction._async_q is not None:
+            junction.enqueue("staged", staged, now)
+            return
+        junction.dispatch_staged(staged, now)
 
     def _route(self, stream_id: str, events: List[ev.Event]) -> None:
         if stream_id in self.named_windows:
@@ -2015,12 +2198,15 @@ class SiddhiAppRuntime:
             self._playback_time = max(self._playback_time,
                                       max(e.timestamp for e in events))
         now = self.timestamp_millis()
-        with self._lock:
-            # in playback, fire timers the event clock has passed first (they
-            # are earlier in event time than the new events)
-            if self.playback:
+        if self.playback:
+            # in playback, fire timers the event clock has passed first
+            # (they are earlier in event time than the new events)
+            with self._lock:
                 self._scheduler.drain_playback(now)
-            junction.publish(events, now)
+        elif junction._async_q is not None:
+            junction.enqueue("pub", events, now)
+            return
+        junction.publish(events, now)
 
     # -- statistics / debugging -----------------------------------------------
     def statistics(self) -> Dict:
@@ -2051,7 +2237,7 @@ class SiddhiAppRuntime:
             from ..compiler import SiddhiCompiler
             q = SiddhiCompiler.parse_on_demand_query(q)
         assert isinstance(q, OnDemandQuery)
-        with self._lock:
+        with self._quiesce():
             return execute_on_demand(self, q)
 
     # -- snapshot/restore ------------------------------------------------------
@@ -2059,7 +2245,7 @@ class SiddhiAppRuntime:
         """Full state snapshot (reference: SnapshotService.fullSnapshot
         CORE/util/snapshot/SnapshotService.java:90) — here simply the state
         pytrees + slot maps, no stop-the-world object walk needed."""
-        with self._lock:
+        with self._quiesce():
             states = {}
             for name, qr in self.query_runtimes.items():
                 host_state = jax.tree.map(lambda x: np.asarray(x), qr.state)
@@ -2106,7 +2292,7 @@ class SiddhiAppRuntime:
         journal); small states ship whole (reference: incremental snapshots
         via per-element op-logs, SnapshotService.incrementalSnapshot :189 —
         here the op-log is the host-tracked dirty key mask)."""
-        with self._lock:
+        with self._quiesce():
             deltas = {}
             for name, qr in self.query_runtimes.items():
                 alloc = _allocator_of(qr)
@@ -2161,7 +2347,7 @@ class SiddhiAppRuntime:
 
     def restore_increment(self, blob: bytes) -> None:
         payload = pickle.loads(blob)
-        with self._lock:
+        with self._quiesce():
             for s in payload["interner"]:
                 self.interner.intern(s)
             for name, d in payload["deltas"].items():
@@ -2202,7 +2388,7 @@ class SiddhiAppRuntime:
 
     def restore(self, blob: bytes) -> None:
         payload = pickle.loads(blob)
-        with self._lock:
+        with self._quiesce():
             for s in payload["interner"]:
                 self.interner.intern(s)
             for name, data in payload["states"].items():

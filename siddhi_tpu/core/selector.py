@@ -285,7 +285,9 @@ class AggregatorBank:
         for spec, st in zip(self.specs, state):
             (order, unorder, seg_s, first, sign_s, slot_s,
              epoch_s) = layouts[spec.slot_src]
-            K = spec.K_override or self.K
+            # slot count from the STATE shape, not the plan: under
+            # shard_map each device owns a K/n slice of the slot axis
+            K = st.shape[0]
             vals = spec.vals_fn(env, sign)
             # rows that don't contribute carry the identity
             vals = jnp.where(sign != 0, vals,
