@@ -358,7 +358,9 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
 
     if full == "currentTimeMillis":
         def fn(env):
-            return env["__now__"]
+            # __now__ is a scalar; projections must be [B] columns
+            return jnp.broadcast_to(jnp.asarray(env["__now__"], jnp.int64),
+                                    jnp.shape(env["__ts__"]))
         return CompiledExpr(fn, "LONG")
 
     if full.startswith("instanceOf"):

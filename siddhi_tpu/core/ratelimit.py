@@ -12,6 +12,7 @@ shape); otherwise the whole latest row stands in.
 """
 from __future__ import annotations
 
+import threading
 from typing import Callable, List, Optional, Tuple
 
 from . import event as ev
@@ -28,7 +29,6 @@ class OutputRateLimiter:
     needs_timer = False
 
     def __init__(self, deliver: Callable[[List[Tuple[int, ev.Event]], int], None]):
-        import threading
         self.deliver = deliver
         self._lk = threading.RLock()
 

@@ -10,6 +10,7 @@ for time-based windows.
 """
 from __future__ import annotations
 
+import contextlib
 import heapq
 import pickle
 import threading
@@ -61,9 +62,6 @@ def _sub_lock(sub):
     return getattr(target, "_qlock", None)
 
 
-import contextlib
-
-
 @contextlib.contextmanager
 def _query_lock(lk, stream_id: str, timeout: float = 30.0):
     """Bounded query-lock acquisition: a worker holding query X's lock and
@@ -89,7 +87,6 @@ def _acquire_all(locks):
     downstream stream holds its own lock while taking the next), so a
     fixed-order blocking acquisition here could deadlock; try-acquire and
     retry instead."""
-    import contextlib
     while True:
         acquired = []
         for lk in locks:
@@ -909,6 +906,9 @@ class NamedWindowRuntime:
         self.output_event_type = wdef.output_event_type or "ALL_EVENTS"
         self.subscribers: List = []      # QueryRuntime-likes (process_staged)
         self.stream_callbacks: List[Callable] = []
+        # serializes ingest (via _route) against scheduler timers and
+        # snapshot reads of self.state
+        self._qlock = threading.RLock()
         self.next_wakeup: int = _NO_WAKEUP_INT
         wproc = self.wproc
 
@@ -973,7 +973,12 @@ class NamedWindowRuntime:
                               want_kinds=(ev.CURRENT, ev.EXPIRED))
             cb([e for _, e in pairs])
         for q in self.subscribers:
-            q.process_staged(staged, now)
+            lk = _sub_lock(q)
+            if lk is not None:
+                with _query_lock(lk, self.definition.id):
+                    q.process_staged(staged, now)
+            else:
+                q.process_staged(staged, now)
 
 
 class StreamJunction:
@@ -1016,7 +1021,14 @@ class StreamJunction:
             self._async_workers.append(t)
 
     def enqueue(self, tag: str, payload, now: int) -> None:
-        self._async_q.put((tag, payload, now))
+        q = self._async_q
+        if q is None:          # raced with stop_async: process inline
+            if tag == "staged":
+                self.dispatch_staged(payload, now)
+            else:
+                self.publish(payload, now)
+            return
+        q.put((tag, payload, now))
 
     def _drain_async(self) -> None:
         while True:
@@ -1397,7 +1409,11 @@ class _Scheduler:
         try:
             while self._heap and self._heap[0][0] <= now:
                 ts, _, q = heapq.heappop(self._heap)
-                q.on_timer(ts)
+                lk = getattr(q, "_qlock", None)
+                if lk is None:
+                    lk = q.__dict__.setdefault("_qlock", threading.RLock())
+                with lk:
+                    q.on_timer(ts)
         finally:
             self._draining = False
 
@@ -2105,6 +2121,10 @@ class SiddhiAppRuntime:
             self._drainer.flush()
             if all(j.pending_async() == 0 for j in self.junctions.values()):
                 return
+        import logging
+        logging.getLogger("siddhi_tpu").warning(
+            "flush() gave up after 64 rounds with async batches still "
+            "pending (sustained re-ingestion?)")
 
     def _quiesce(self):
         """Acquire the app lock plus EVERY query lock (the reference's
@@ -2114,6 +2134,8 @@ class SiddhiAppRuntime:
             lk = getattr(self.query_runtimes[qname], "_qlock", None)
             if lk is not None:
                 locks.append(lk)
+        for wid in sorted(self.named_windows):
+            locks.append(self.named_windows[wid]._qlock)
         return _acquire_all(locks)
 
     def timestamp_millis(self) -> int:
@@ -2186,9 +2208,10 @@ class SiddhiAppRuntime:
                 self._playback_time = max(self._playback_time,
                                           max(e.timestamp for e in events))
             now = self.timestamp_millis()
-            with self._lock:
-                if self.playback:
+            if self.playback:
+                with self._lock:
                     self._scheduler.drain_playback(now)
+            with nw._qlock:
                 nw.process_staged(ev.pack_np(nw.schema, events), now)
             return
         junction = self.junctions.get(stream_id)

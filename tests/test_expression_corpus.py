@@ -1,0 +1,221 @@
+"""Golden expression corpus (reference shape: TEST/query/FilterTestCase1/2 —
+one mini-app per case, golden outputs per query string + event script).
+
+Float tolerance policy: DOUBLE maps to f32 on device (TPU has no f64), so
+float comparisons use rel=1e-5 abs=1e-5 — the framework-wide contract for
+aggregate/arithmetic parity with the reference's f64 (SURVEY §7(f))."""
+import math
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+TOL = dict(rel=1e-5, abs=1e-5)
+
+EVENTS = [
+    # symbol, price, volume
+    ["WSO2", 55.6, 100],
+    ["IBM", 75.6, 40],
+    ["GOOG", 12.0, 200],
+    ["WSO2", 0.0, 0],
+    ["MSFT", -5.5, 7],
+]
+
+
+def run_filter(cond: str):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    define stream S (symbol string, price float, volume int);
+    @info(name='q') from S[{cond}] select symbol insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [e.data[0] for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for e in EVENTS:
+        h.send(list(e))
+    rt.flush()
+    m.shutdown()
+    return got
+
+
+FILTER_CASES = [
+    ("volume > 50", ["WSO2", "GOOG"]),
+    ("volume >= 40", ["WSO2", "IBM", "GOOG"]),
+    ("volume < 40", ["WSO2", "MSFT"]),
+    ("volume <= 40", ["IBM", "WSO2", "MSFT"]),
+    ("volume == 200", ["GOOG"]),
+    ("volume != 200", ["WSO2", "IBM", "WSO2", "MSFT"]),
+    ("price > 50.0", ["WSO2", "IBM"]),
+    ("price < 0.0", ["MSFT"]),
+    ("symbol == 'WSO2'", ["WSO2", "WSO2"]),
+    ("symbol != 'WSO2'", ["IBM", "GOOG", "MSFT"]),
+    ("volume > 50 and price > 20.0", ["WSO2"]),
+    ("volume > 50 or price > 70.0", ["WSO2", "IBM", "GOOG"]),
+    ("not (volume > 50)", ["IBM", "WSO2", "MSFT"]),
+    ("volume > 30 and (price > 70.0 or symbol == 'GOOG')",
+     ["IBM", "GOOG"]),
+    ("price * 2.0 > 100.0", ["WSO2", "IBM"]),
+    ("price + 10.0 < 5.0", ["MSFT"]),
+    ("price - 5.0 > 50.0", ["WSO2", "IBM"]),
+    ("volume / 2 >= 100", ["GOOG"]),
+    ("volume % 3 == 1", ["WSO2", "IBM", "MSFT"]),
+    ("-price > 0.0", ["MSFT"]),
+    ("volume > price", ["WSO2", "GOOG", "MSFT"]),
+    ("true", ["WSO2", "IBM", "GOOG", "WSO2", "MSFT"]),
+    ("false", []),
+]
+
+
+@pytest.mark.parametrize("cond,expected", FILTER_CASES,
+                         ids=[c for c, _ in FILTER_CASES])
+def test_filter_golden(cond, expected):
+    assert run_filter(cond) == expected
+
+
+def run_project(exprs: str, events=None):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    define stream S (symbol string, price float, volume int);
+    @info(name='q') from S select {exprs} insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [list(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for e in (events or EVENTS[:2]):
+        h.send(list(e))
+    rt.flush()
+    m.shutdown()
+    return got
+
+
+PROJECT_CASES = [
+    ("price * 2.0 as x", [[111.2], [151.2]]),
+    ("price + volume as x", [[155.6], [115.6]]),
+    ("math:abs(0.0 - price) as x", [[55.6], [75.6]]),
+    ("math:sqrt(volume) as x", [[10.0], [math.sqrt(40)]]),
+    ("math:floor(price) as x", [[55.0], [75.0]]),
+    ("math:ceil(price) as x", [[56.0], [76.0]]),
+    ("math:round(price) as x", [[56.0], [76.0]]),
+    ("ifThenElse(volume > 50, 1, 0) as x", [[1], [0]]),
+    ("ifThenElse(symbol == 'IBM', price, 0.0) as x", [[0.0], [75.6]]),
+    ("coalesce(price, 1.0) as x", [[55.6], [75.6]]),
+    ("cast(volume, 'double') as x", [[100.0], [40.0]]),
+    ("cast(price, 'long') as x", [[55], [75]]),
+    ("convert(volume, 'float') as x", [[100.0], [40.0]]),
+    ("maximum(price, 60.0) as x", [[60.0], [75.6]]),
+    ("minimum(price, 60.0) as x", [[55.6], [60.0]]),
+    ("instanceOfFloat(price) as x", [[True], [True]]),
+    ("instanceOfString(price) as x", [[False], [False]]),
+    ("eventTimestamp() as x, volume as v",
+     None),   # checked separately below
+]
+
+
+@pytest.mark.parametrize("exprs,expected",
+                         [c for c in PROJECT_CASES if c[1] is not None],
+                         ids=[c[0] for c in PROJECT_CASES
+                              if c[1] is not None])
+def test_projection_golden(exprs, expected):
+    got = run_project(exprs)
+    assert len(got) == len(expected)
+    for row, exp in zip(got, expected):
+        for a, b in zip(row, exp):
+            if isinstance(b, float):
+                assert a == pytest.approx(b, **TOL)
+            else:
+                assert a == b
+
+
+def test_event_timestamp_projection():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (symbol string, price float, volume int);
+    @info(name='q') from S select eventTimestamp() as ts2 insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [e.data[0] for e in (i or [])]))
+    rt.start()
+    rt.get_input_handler("S").send(["A", 1.0, 1], timestamp=123456)
+    rt.flush()
+    assert got == [123456]
+    m.shutdown()
+
+
+AGG_EVENTS = [
+    ["A", 10.0, 2], ["B", 20.0, 4], ["A", 30.0, 6], ["B", 40.0, 8],
+]
+
+
+def run_agg(select: str, group: str = ""):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    define stream S (symbol string, price float, volume int);
+    @info(name='q') from S select {select} {group} insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [list(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for e in AGG_EVENTS:
+        h.send(list(e))
+    rt.flush()
+    m.shutdown()
+    return got
+
+
+AGG_CASES = [
+    ("sum(price) as x", "", [[10.0], [30.0], [60.0], [100.0]]),
+    ("count() as x", "", [[1], [2], [3], [4]]),
+    ("avg(price) as x", "", [[10.0], [15.0], [20.0], [25.0]]),
+    ("min(price) as x", "", [[10.0], [10.0], [10.0], [10.0]]),
+    ("max(price) as x", "", [[10.0], [20.0], [30.0], [40.0]]),
+    ("minForever(price) as x", "", [[10.0], [10.0], [10.0], [10.0]]),
+    ("maxForever(price) as x", "", [[10.0], [20.0], [30.0], [40.0]]),
+    ("sum(volume) as x", "", [[2], [6], [12], [20]]),
+    ("sum(price) as x", "group by symbol",
+     [[10.0], [20.0], [40.0], [60.0]]),
+    ("count() as x", "group by symbol", [[1], [1], [2], [2]]),
+    ("avg(price) as x", "group by symbol",
+     [[10.0], [20.0], [20.0], [30.0]]),
+    ("max(volume) as x", "group by symbol", [[2], [4], [6], [8]]),
+    ("stdDev(price) as x", "group by symbol",
+     [[0.0], [0.0], [10.0], [10.0]]),
+]
+
+
+@pytest.mark.parametrize("select,group,expected", AGG_CASES,
+                         ids=[f"{s}|{g}" for s, g, _ in AGG_CASES])
+def test_aggregator_golden(select, group, expected):
+    got = run_agg(select, group)
+    assert len(got) == len(expected)
+    for row, exp in zip(got, expected):
+        for a, b in zip(row, exp):
+            if isinstance(b, float):
+                assert a == pytest.approx(b, **TOL)
+            else:
+                assert a == b
+
+
+def test_and_or_aggregators():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (b bool);
+    @info(name='q') from S select and(b) as allb, or(b) as anyb
+    insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in (True, True, False):
+        h.send([v])
+    rt.flush()
+    assert got == [(True, True), (True, True), (False, True)]
+    m.shutdown()

@@ -1,0 +1,220 @@
+"""Ops-layer corpus: config, statistics, exceptions, persistence stores,
+extension registry (reference shape: TEST/managment/* + config tests)."""
+import os
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu import exceptions as ex
+from siddhi_tpu.utils.config import ConfigReader, InMemoryConfigManager
+from siddhi_tpu.utils.persistence import (
+    FileSystemPersistenceStore,
+    IncrementalFileSystemPersistenceStore,
+    InMemoryPersistenceStore,
+)
+
+
+def test_exception_hierarchy_roots():
+    assert issubclass(ex.CompileError, ex.SiddhiError)
+    assert issubclass(ex.SiddhiParserException, ex.CompileError)
+    assert issubclass(ex.MatchOverflowError, ex.SiddhiAppRuntimeError)
+    assert issubclass(ex.CapacityExceededError, RuntimeError)
+    assert issubclass(ex.DefinitionNotExistError, KeyError)
+    assert issubclass(ex.QueryNotExistError, KeyError)
+    assert issubclass(ex.NoPersistenceStoreError, ex.PersistenceError)
+    assert issubclass(ex.CannotRestoreStateError, ex.PersistenceError)
+
+
+def test_unknown_stream_raises_typed():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("define stream S (a int);")
+    rt.start()
+    with pytest.raises(ex.DefinitionNotExistError):
+        rt.get_input_handler("Nope")
+    with pytest.raises(ex.QueryNotExistError):
+        rt.add_callback("nope", lambda *a: None)
+    with pytest.raises(ex.QueryNotExistError):
+        rt.add_batch_callback("nope", lambda *a: None)
+    m.shutdown()
+
+
+def test_restore_revision_missing_raises():
+    m = SiddhiManager()
+    m.create_siddhi_app_runtime("define stream S (a int);").start()
+    with pytest.raises(ex.CannotRestoreStateError):
+        m.restore_revision("no_such_rev")
+    m.shutdown()
+
+
+def test_restore_revision_roundtrip():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (a int);
+    @info(name='q') from S select sum(a) as t insert into O;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [e.data[0] for e in (i or [])]))
+    rt.start()
+    rt.get_input_handler("S").send([5])
+    rt.flush()
+    revs = m.persist()
+    m.wait_for_persistence()
+    rt.get_input_handler("S").send([100])
+    rt.flush()
+    m.restore_revision(revs[0])
+    rt.get_input_handler("S").send([1])
+    rt.flush()
+    assert got[-1] == 6          # 5 (restored) + 1, not 106
+    m.shutdown()
+
+
+def test_config_reader_properties():
+    cm = InMemoryConfigManager({"ns.name.prop": "42"})
+    r = cm.generate_config_reader("ns", "name")
+    assert isinstance(r, ConfigReader)
+    assert r.read_config("prop", "0") == "42"
+    assert r.read_config("missing", "7") == "7"
+
+
+def test_statistics_levels_and_report():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:statistics('BASIC')
+    define stream S (a int);
+    @info(name='q') from S select a insert into O;
+    """)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in range(10):
+        h.send([v])
+    rt.flush()
+    rep = rt.statistics()
+    assert rep["streams"]["S"]["events"] == 10
+    assert rep["streams"]["S"]["throughput_eps"] > 0
+    rt.set_statistics_level("OFF")
+    rt.set_statistics_level("DETAIL")
+    m.shutdown()
+
+
+def test_inmemory_persistence_store_revisions():
+    st = InMemoryPersistenceStore()
+    st.save("app", "r1", b"one")
+    st.save("app", "r2", b"two")
+    assert st.get_last_revision("app") == "r2"
+    assert st.load("app", "r1") == b"one"
+    st.clear_all_revisions("app")
+    assert st.get_last_revision("app") is None
+
+
+def test_fs_persistence_store(tmp_path):
+    st = FileSystemPersistenceStore(str(tmp_path))
+    st.save("app", "r1", b"blob")
+    assert st.load("app", "r1") == b"blob"
+    assert st.get_last_revision("app") == "r1"
+    st.clear_all_revisions("app")
+    assert st.get_last_revision("app") is None
+
+
+def test_incremental_fs_store_chain(tmp_path):
+    st = IncrementalFileSystemPersistenceStore(str(tmp_path))
+    st.save_base("app", "r1", b"base")
+    st.save_increment("app", "r2", b"i1")
+    st.save_increment("app", "r3", b"i2")
+    base, incs = st.load_chain("app")
+    assert base == b"base" and incs == [b"i1", b"i2"]
+    st.save_base("app", "r4", b"base2")     # new base invalidates chain
+    base, incs = st.load_chain("app")
+    assert base == b"base2" and incs == []
+
+
+def test_scalar_function_extension_registry():
+    from siddhi_tpu.core.executor import CompiledExpr
+    from siddhi_tpu.core.extension import scalar_function
+
+    @scalar_function("t:triple")
+    def _triple(args):
+        a = args[0]
+        return CompiledExpr(fn=lambda env: a.fn(env) * 3, type=a.type)
+
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (a int);
+    @info(name='q') from S select t:triple(a) as x insert into O;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [e.data[0] for e in (i or [])]))
+    rt.start()
+    rt.get_input_handler("S").send([7])
+    rt.flush()
+    assert got == [21]
+    m.shutdown()
+
+
+def test_fault_stream_carries_error_column():
+    m = SiddhiManager()
+    from siddhi_tpu.core.executor import CompiledExpr
+    from siddhi_tpu.core.extension import scalar_function
+
+    @scalar_function("t:boom2")
+    def _boom(args):
+        def fn(env):
+            raise RuntimeError("kaput")
+        return CompiledExpr(fn=fn, type="INT")
+
+    rt = m.create_siddhi_app_runtime("""
+    @OnError(action='STREAM')
+    define stream S (a int);
+    @info(name='q') from S[t:boom2(a) > 0] select a insert into O;
+    @info(name='f') from !S select a, _error insert into F;
+    """)
+    faults = []
+    rt.add_callback("f", lambda ts, i, o: faults.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    rt.get_input_handler("S").send([3])
+    rt.flush()
+    assert len(faults) == 1
+    assert faults[0][0] == 3 and "kaput" in faults[0][1]
+    m.shutdown()
+
+
+def test_playback_clock_follows_event_time():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (a int);
+    @info(name='q') from S select a, currentTimeMillis() as now2
+    insert into O;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [e.data[1] for e in (i or [])]))
+    rt.start()
+    rt.get_input_handler("S").send([1], timestamp=5000)
+    rt.get_input_handler("S").send([1], timestamp=9000)
+    rt.flush()
+    assert got == [5000, 9000]
+    m.shutdown()
+
+
+def test_debugger_breakpoint():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (a int);
+    @info(name='q') from S select a insert into O;
+    """)
+    dbg = rt.debug()
+    seen = []
+    dbg.acquire_break_point("q", "IN")
+
+    def on_break(events, name, term, d):
+        seen.append(term)
+        d.play()          # breakpoints BLOCK the event thread until resumed
+    dbg.set_debugger_callback(on_break)
+    rt.start()
+    rt.get_input_handler("S").send([1])
+    rt.flush()
+    assert seen == ["IN"]
+    m.shutdown()

@@ -1,0 +1,187 @@
+"""Golden pattern/sequence corpus (reference shape: TEST/query/pattern/* —
+Complex/Count/Every/Logical/Within and absent variants, plus sequences)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+BASE = """
+@app:playback
+define stream S1 (sym string, price float, vol int);
+define stream S2 (sym string, price float, vol int);
+"""
+
+
+def run(ql_body, sends, query="q"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(BASE + ql_body)
+    got = []
+    rt.add_callback(query, lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    hs = {}
+    for stream, data, ts in sends:
+        h = hs.setdefault(stream, rt.get_input_handler(stream))
+        h.send(list(data), timestamp=ts)
+    rt.flush()
+    m.shutdown()
+    return got
+
+
+def test_followed_by_basic():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] -> e2=S2[vol == 2]
+    select e1.sym as a, e2.sym as b insert into Out;
+    """, [("S1", ["x", 1.0, 1], 1000), ("S2", ["y", 1.0, 2], 1001)])
+    assert got == [("x", "y")]
+
+
+def test_followed_by_no_every_fires_once():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] -> e2=S2[vol == 2]
+    select e1.sym as a, e2.sym as b insert into Out;
+    """, [("S1", ["x", 1.0, 1], 1000), ("S2", ["y", 1.0, 2], 1001),
+          ("S1", ["p", 1.0, 1], 1002), ("S2", ["q", 1.0, 2], 1003)])
+    assert got == [("x", "y")]
+
+
+def test_every_restarts():
+    got = run("""
+    @info(name='q') from every e1=S1[vol == 1] -> e2=S2[vol == 2]
+    select e1.sym as a, e2.sym as b insert into Out;
+    """, [("S1", ["x", 1.0, 1], 1000), ("S2", ["y", 1.0, 2], 1001),
+          ("S1", ["p", 1.0, 1], 1002), ("S2", ["q", 1.0, 2], 1003)])
+    assert got == [("x", "y"), ("p", "q")]
+
+
+def test_capture_filter_cross_reference():
+    got = run("""
+    @info(name='q') from every e1=S1[vol == 1]
+        -> e2=S2[price > e1.price]
+    select e1.price as p1, e2.price as p2 insert into Out;
+    """, [("S1", ["a", 10.0, 1], 1000),
+          ("S2", ["b", 5.0, 0], 1001),     # not > 10
+          ("S2", ["c", 15.0, 0], 1002)])   # match
+    assert got == [(10.0, 15.0)]
+
+
+def test_count_quantifier_range():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] -> e2=S1[vol == 5]<2:3>
+        -> e3=S1[vol == 9]
+    select e2[0].price as k0, e2[1].price as k1 insert into Out;
+    """, [("S1", ["s", 0.0, 1], 1000),
+          ("S1", ["s", 1.0, 5], 1001),
+          ("S1", ["s", 2.0, 5], 1002),
+          ("S1", ["s", 0.0, 9], 1003)])
+    assert got == [(1.0, 2.0)]
+
+
+def test_count_quantifier_min_not_met():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] -> e2=S1[vol == 5]<2:3>
+        -> e3=S1[vol == 9]
+    select e1.sym as a insert into Out;
+    """, [("S1", ["s", 0.0, 1], 1000),
+          ("S1", ["s", 1.0, 5], 1001),     # only ONE of min 2
+          ("S1", ["s", 0.0, 9], 1002)])
+    assert got == []
+
+
+def test_logical_and_pattern():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] and e2=S2[vol == 2]
+    select e1.sym as a, e2.sym as b insert into Out;
+    """, [("S2", ["y", 1.0, 2], 1000),     # order-free
+          ("S1", ["x", 1.0, 1], 1001)])
+    assert got == [("x", "y")]
+
+
+def test_logical_or_pattern():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] or e2=S2[vol == 2]
+    select e2.sym as b insert into Out;
+    """, [("S2", ["y", 1.0, 2], 1000)])
+    assert got == [("y",)]
+
+
+def test_within_expires_partial():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] -> e2=S2[vol == 2]
+        within 1 sec
+    select e1.sym as a, e2.sym as b insert into Out;
+    """, [("S1", ["x", 1.0, 1], 1000),
+          ("S2", ["y", 1.0, 2], 2500)])    # too late
+    assert got == []
+
+
+def test_within_met():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] -> e2=S2[vol == 2]
+        within 1 sec
+    select e1.sym as a insert into Out;
+    """, [("S1", ["x", 1.0, 1], 1000),
+          ("S2", ["y", 1.0, 2], 1800)])
+    assert got == [("x",)]
+
+
+def test_absent_fires_after_timeout():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] -> not S2 for 1 sec
+    select e1.sym as a insert into Out;
+    """, [("S1", ["x", 1.0, 1], 1000),
+          ("S1", ["z", 1.0, 9], 2500)])    # clock advance
+    assert got == [("x",)]
+
+
+def test_absent_suppressed_by_arrival():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] -> not S2 for 1 sec
+    select e1.sym as a insert into Out;
+    """, [("S1", ["x", 1.0, 1], 1000),
+          ("S2", ["y", 1.0, 2], 1500),
+          ("S1", ["z", 1.0, 9], 2500)])
+    assert got == []
+
+
+def test_sequence_strictness():
+    got = run("""
+    @info(name='q') from every e1=S1[vol == 1], e2=S1[vol == 2]
+    select e1.sym as a, e2.sym as b insert into Out;
+    """, [("S1", ["a", 1.0, 1], 1000),
+          ("S1", ["k", 1.0, 7], 1001),     # interloper breaks the partial
+          ("S1", ["b", 1.0, 2], 1002),
+          ("S1", ["c", 1.0, 1], 1003),
+          ("S1", ["d", 1.0, 2], 1004)])
+    assert got == [("c", "d")]
+
+
+def test_sequence_kleene_plus():
+    got = run("""
+    @info(name='q') from every e1=S1[vol == 1], e2=S1[vol == 5]+,
+         e3=S1[vol == 2]
+    select e1.sym as a, e2[0].sym as k0, e3.sym as c insert into Out;
+    """, [("S1", ["a", 1.0, 1], 1000),
+          ("S1", ["k", 1.0, 5], 1001),
+          ("S1", ["l", 1.0, 5], 1002),
+          ("S1", ["b", 1.0, 2], 1003)])
+    assert got == [("a", "k", "b")]
+
+
+def test_pattern_output_aggregation():
+    got = run("""
+    @info(name='q') from every e1=S1[vol == 1] -> e2=S2[vol == 2]
+    select count() as n insert into Out;
+    """, [("S1", ["x", 1.0, 1], 1000), ("S2", ["y", 1.0, 2], 1001),
+          ("S1", ["p", 1.0, 1], 1002), ("S2", ["q", 1.0, 2], 1003)])
+    assert got == [(1,), (2,)]
+
+
+def test_multi_stream_three_stage():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] -> e2=S2[vol == 2]
+        -> e3=S1[vol == 3]
+    select e1.sym as a, e2.sym as b, e3.sym as c insert into Out;
+    """, [("S1", ["x", 1.0, 1], 1000),
+          ("S2", ["y", 1.0, 2], 1001),
+          ("S1", ["z", 1.0, 3], 1002)])
+    assert got == [("x", "y", "z")]

@@ -1,0 +1,236 @@
+"""Golden window-behavior corpus (reference shape: TEST/query/window/* —
+one mini-app per case; CURRENT and EXPIRED flows asserted)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def run_window(window: str, sends, select="sym, price",
+               out_clause="insert all events into Out"):
+    """sends: list of (data, ts). Returns list of (ins, outs) per delivery
+    with rows as tuples."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    @app:playback
+    define stream S (sym string, price float);
+    @info(name='q') from S#window.{window}
+    select {select} {out_clause};
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.append(
+        ([tuple(e.data) for e in (i or [])],
+         [tuple(e.data) for e in (o or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for data, ts in sends:
+        h.send(list(data), timestamp=ts)
+    rt.flush()
+    m.shutdown()
+    cur = [r for ins, _ in got for r in ins]
+    exp = [r for _, outs in got for r in outs]
+    return cur, exp
+
+
+S4 = [(["a", 1.0], 1000), (["b", 2.0], 1001),
+      (["c", 3.0], 1002), (["d", 4.0], 1003)]
+
+
+def test_length_window_golden():
+    cur, exp = run_window("length(2)", S4)
+    assert cur == [("a", 1.0), ("b", 2.0), ("c", 3.0), ("d", 4.0)]
+    assert exp == [("a", 1.0), ("b", 2.0)]
+
+
+def test_length_batch_golden():
+    cur, exp = run_window("lengthBatch(2)", S4)
+    assert cur == [("a", 1.0), ("b", 2.0), ("c", 3.0), ("d", 4.0)]
+    # previous batch replays as expired when the next flushes
+    assert exp == [("a", 1.0), ("b", 2.0)]
+
+
+def test_time_window_golden():
+    cur, exp = run_window("time(1 sec)", S4 + [(["e", 5.0], 2500)])
+    assert cur == [("a", 1.0), ("b", 2.0), ("c", 3.0), ("d", 4.0),
+                   ("e", 5.0)]
+    # a..d all expired by t=2500 (arrivals 1000..1003 + 1000ms)
+    assert exp == [("a", 1.0), ("b", 2.0), ("c", 3.0), ("d", 4.0)]
+
+
+def test_time_batch_golden():
+    cur, exp = run_window(
+        "timeBatch(1 sec)",
+        [(["a", 1.0], 1000), (["b", 2.0], 1400),
+         (["c", 3.0], 2100),      # first batch flushes at 2000-boundary
+         (["d", 4.0], 3100)])     # second batch {c} flushes
+    assert cur == [("a", 1.0), ("b", 2.0), ("c", 3.0)]
+    assert exp == [("a", 1.0), ("b", 2.0)]
+
+
+def test_time_length_golden():
+    cur, exp = run_window("timeLength(1 sec, 2)", S4)
+    assert cur == [("a", 1.0), ("b", 2.0), ("c", 3.0), ("d", 4.0)]
+    assert exp[:2] == [("a", 1.0), ("b", 2.0)]   # length cap evicts first
+
+
+def test_external_time_golden():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string, ts long);
+    @info(name='q') from S#window.externalTime(ts, 1 sec)
+    select sym insert all events into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.append(
+        ([e.data[0] for e in (i or [])], [e.data[0] for e in (o or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["a", 1000], timestamp=1000)
+    h.send(["b", 1500], timestamp=1500)
+    h.send(["c", 2100], timestamp=2100)   # expires a (1000+1000 <= 2100)
+    rt.flush()
+    exps = [x for _, o in got for x in o]
+    assert exps == ["a"]
+    m.shutdown()
+
+
+def test_delay_window_golden():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string);
+    @info(name='q') from S#window.delay(1 sec)
+    select sym insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [e.data[0] for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["a"], timestamp=1000)
+    h.send(["b"], timestamp=1200)
+    assert got == []                   # nothing before the delay passes
+    h.send(["x"], timestamp=2500)      # clock advance releases a and b
+    rt.flush()
+    assert got[:2] == ["a", "b"]
+    m.shutdown()
+
+
+def test_sort_window_golden():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string, price float);
+    @info(name='q') from S#window.sort(2, price, 'asc')
+    select sym, price insert all events into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.append(
+        ([tuple(e.data) for e in (i or [])],
+         [tuple(e.data) for e in (o or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["a", 5.0], timestamp=1000)
+    h.send(["b", 1.0], timestamp=1001)
+    h.send(["c", 3.0], timestamp=1002)   # evicts the LARGEST (a, 5.0)
+    rt.flush()
+    exps = [r for _, o in got for r in o]
+    assert exps == [("a", 5.0)]
+    m.shutdown()
+
+
+def test_frequent_window_golden():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string);
+    @info(name='q') from S#window.frequent(1, sym)
+    select sym insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [e.data[0] for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for s in ["a", "a", "b", "a"]:
+        h.send([s], timestamp=1000)
+    rt.flush()
+    # frequent(1): only the (single) most frequent key's events pass
+    assert got.count("a") >= 2
+    m.shutdown()
+
+
+def test_session_window_golden():
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (k string, v int);
+    @info(name='q') from S#window.session(1 sec)
+    select k, sum(v) as total insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["u", 1], timestamp=1000)
+    h.send(["u", 2], timestamp=1400)     # same session
+    h.send(["u", 5], timestamp=5000)     # gap > 1s: new session
+    rt.flush()
+    assert len(got) >= 2
+    m.shutdown()
+
+
+def test_batch_window_golden():
+    cur, exp = run_window("batch()", S4)
+    assert cur == [("a", 1.0), ("b", 2.0), ("c", 3.0), ("d", 4.0)]
+
+
+@pytest.mark.xfail(reason="hopping window not implemented yet",
+                   raises=Exception, strict=True)
+def test_hopping_window_golden():
+    m = SiddhiManager()
+    try:
+        m.create_siddhi_app_runtime("""
+        define stream S (sym string);
+        @info(name='q') from S#window.hopping(2 sec, 1 sec)
+        select sym insert into Out;
+        """)
+    finally:
+        m.shutdown()
+
+
+WINDOW_SMOKE = [
+    "length(3)", "lengthBatch(3)", "time(2 sec)", "timeBatch(2 sec)",
+    "timeLength(2 sec, 3)", "sort(3, price)", "batch()",
+    "expression('count() <= 3')", "expressionBatch('count() <= 3')",
+    "delay(1 sec)",
+]
+
+
+@pytest.mark.parametrize("w", WINDOW_SMOKE, ids=WINDOW_SMOKE)
+def test_window_with_aggregation_smoke(w):
+    """Every window type composes with running aggregation and survives a
+    4-event drive without error; sum reflects only live rows for sliding
+    windows."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    @app:playback
+    define stream S (sym string, price float);
+    @info(name='q') from S#window.{w}
+    select sym, sum(price) as total insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        [tuple(e.data) for e in (i or [])]))
+    errs = []
+    rt.set_exception_listener(errs.append)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i, (d, ts) in enumerate(S4):
+        h.send(list(d), timestamp=ts)
+    h.send(["z", 9.0], timestamp=9000)   # clock advance flushes batches
+    rt.flush()
+    assert errs == []
+    assert len(got) >= 1
+    m.shutdown()
