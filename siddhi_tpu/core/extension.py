@@ -1,24 +1,75 @@
 """Extension registry: decorator-based equivalent of the reference's
 @Extension + classpath scanning (modules/siddhi-annotations/.../Extension.java:56,
-CORE/util/SiddhiExtensionLoader.java:58).
+CORE/util/SiddhiExtensionLoader.java:58) with the annotation processor's
+convention validation (SiddhiAnnotationProcessor.java:56).
 
 Extensions are registered explicitly (Python has no classpath scan):
 
-    @scalar_function("str:length", return_type="INT")
+    @scalar_function("str:length", description="string length",
+                     parameters=["value (STRING)"], return_type="INT")
     def str_length(args):  # args: list[CompiledExpr]
         ...returns CompiledExpr
 """
 from __future__ import annotations
 
-from typing import Callable, Dict
+import dataclasses
+import re
+from typing import Callable, Dict, List, Optional
+
+from ..exceptions import CompileError
+
+_NAME_RE = re.compile(r"^([A-Za-z][A-Za-z0-9_]*:)?[A-Za-z][A-Za-z0-9_]*$")
+
+
+@dataclasses.dataclass
+class ExtensionMeta:
+    """Reference: @Extension(name, namespace, description, parameters,
+    returnAttributes) metadata consumed by doc-gen and validation."""
+
+    name: str
+    kind: str                      # 'scalar_function' | 'window' | ...
+    description: str = ""
+    parameters: List[str] = dataclasses.field(default_factory=list)
+    return_type: str = ""
+
 
 _SCALAR_FUNCTIONS: Dict[str, Callable] = {}
 _WINDOW_TYPES: Dict[str, type] = {}
+_METADATA: Dict[str, ExtensionMeta] = {}
 
 
-def scalar_function(name: str):
+def _validate(name: str, kind: str, replace: bool) -> None:
+    """Reference: SiddhiAnnotationProcessor validates naming conventions
+    at compile time; here at registration time."""
+    if not _NAME_RE.match(name):
+        raise CompileError(
+            f"invalid extension name {name!r}: expected "
+            f"[namespace:]name with [A-Za-z][A-Za-z0-9_]* segments")
+    if replace:
+        return
+    taken = f"{kind}:{name}" in _METADATA
+    if kind == "scalar_function":
+        taken = taken or name in _SCALAR_FUNCTIONS
+    elif kind == "window":
+        # built-ins live in WINDOW_TYPES without metadata entries
+        from .window import WINDOW_TYPES
+        taken = taken or name in WINDOW_TYPES
+    if taken:
+        raise CompileError(
+            f"extension {name!r} ({kind}) is already registered; pass "
+            f"replace=True to override")
+
+
+def scalar_function(name: str, description: str = "",
+                    parameters: Optional[List[str]] = None,
+                    return_type: str = "", replace: bool = False):
     def deco(fn):
+        _validate(name, "scalar_function", replace)
         _SCALAR_FUNCTIONS[name] = fn
+        _METADATA[f"scalar_function:{name}"] = ExtensionMeta(
+            name, "scalar_function",
+            description or (fn.__doc__ or "").strip().split("\n")[0],
+            list(parameters or []), return_type)
         return fn
     return deco
 
@@ -27,10 +78,22 @@ def scalar_function_registry() -> Dict[str, Callable]:
     return _SCALAR_FUNCTIONS
 
 
-def window_extension(name: str):
+def window_extension(name: str, description: str = "",
+                     parameters: Optional[List[str]] = None,
+                     replace: bool = False):
     def deco(cls):
+        _validate(name, "window", replace)
         from .window import WINDOW_TYPES
         WINDOW_TYPES[name] = cls
         _WINDOW_TYPES[name] = cls
+        _METADATA[f"window:{name}"] = ExtensionMeta(
+            name, "window",
+            description or (cls.__doc__ or "").strip().split("\n")[0],
+            list(parameters or []))
         return cls
     return deco
+
+
+def extension_metadata() -> Dict[str, ExtensionMeta]:
+    """All registered extension metadata (doc-gen input)."""
+    return dict(_METADATA)

@@ -1497,6 +1497,15 @@ class SiddhiAppRuntime:
             elif level == "FALSE":
                 level = OFF
         self.stats = StatisticsManager(level)
+        # @app:statistics(reporter='console', interval='5 sec') starts a
+        # periodic reporter with the app (reference: startReporting :55)
+        self._stats_reporter = None
+        if st_ann is not None and \
+                str(st_ann.element("reporter", "")).lower() == "console":
+            from ..utils.statistics import ConsoleReporter
+            from .aggregation import parse_time_ms
+            iv = parse_time_ms(st_ann.element("interval", "5 sec")) or 5000
+            self._stats_reporter = ConsoleReporter(self, iv / 1000.0)
         self.exception_listener = None
 
         # schemas & junctions
@@ -2089,11 +2098,15 @@ class SiddhiAppRuntime:
                 tr.start(now)
             for lim in self._timed_limiters:
                 self._scheduler.notify_at(now + lim.interval, lim)
+            if self._stats_reporter is not None:
+                self._stats_reporter.start()
 
     def shutdown(self) -> None:
         if self._started:
             for src in self.sources:
                 src.stop()
+            if self._stats_reporter is not None:
+                self._stats_reporter.stop()
             for j in self.junctions.values():
                 j.stop_async()       # drain accepted sends, stop workers
             for sk in self.sinks:

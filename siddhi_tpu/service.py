@@ -1,0 +1,143 @@
+"""REST deployment service.
+
+Reference (what): modules/siddhi-service —
+SiddhiApiServiceImpl.java:42 (POST deploy :51, GET undeploy :100) plus an
+on-demand query endpoint; an MSF4J microservice wrapping SiddhiManager.
+TPU design (how): a stdlib ThreadingHTTPServer wrapping one SiddhiManager —
+no framework dependency (nothing outside the baked-in stack).
+
+Endpoints (JSON in/out):
+  GET    /siddhi-apps                       -> {"apps": [names]}
+  POST   /siddhi-apps        body=SiddhiQL  -> deploy + start
+  DELETE /siddhi-apps/<name>                -> undeploy (shutdown)
+  POST   /siddhi-apps/<name>/streams/<sid>  body={"events":[[...],...],
+                                                  "timestamp": opt}
+  POST   /query              body={"app": name, "query": on-demand QL}
+  GET    /siddhi-apps/<name>/statistics     -> metrics report
+  GET    /health                            -> {"status": "ok"}
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .core.runtime import SiddhiManager
+from .exceptions import SiddhiError
+
+
+class SiddhiRestService:
+    """Deploy/undeploy/ingest/query over HTTP (reference:
+    SiddhiApiServiceImpl.java:42)."""
+
+    def __init__(self, manager: Optional[SiddhiManager] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.manager = manager or SiddhiManager()
+        svc = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet
+                pass
+
+            def _json(self, code: int, payload) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n)
+
+            def do_GET(self):
+                try:
+                    parts = [p for p in self.path.split("/") if p]
+                    if parts == ["health"]:
+                        self._json(200, {"status": "ok"})
+                    elif parts == ["siddhi-apps"]:
+                        self._json(200, {
+                            "apps": sorted(svc.manager.runtimes)})
+                    elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "statistics":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                        else:
+                            self._json(200, rt.statistics())
+                    else:
+                        self._json(404, {"error": "unknown path"})
+                except Exception as exc:  # noqa: BLE001 — HTTP boundary
+                    self._json(500, {"error": repr(exc)})
+
+            def do_POST(self):
+                try:
+                    parts = [p for p in self.path.split("/") if p]
+                    if parts == ["siddhi-apps"]:
+                        ql = self._body().decode()
+                        rt = svc.manager.create_siddhi_app_runtime(ql)
+                        rt.start()
+                        self._json(201, {"app": rt.name})
+                    elif len(parts) == 4 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "streams":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                            return
+                        req = json.loads(self._body() or b"{}")
+                        h = rt.get_input_handler(parts[3])
+                        ts = req.get("timestamp")
+                        for e in req.get("events", []):
+                            h.send(list(e), timestamp=ts)
+                        self._json(200, {"accepted":
+                                         len(req.get("events", []))})
+                    elif parts == ["query"]:
+                        req = json.loads(self._body() or b"{}")
+                        rt = svc.manager.runtimes.get(req.get("app", ""))
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                            return
+                        rows = rt.query(req["query"])
+                        self._json(200, {
+                            "records": [list(e.data) for e in rows]})
+                    else:
+                        self._json(404, {"error": "unknown path"})
+                except SiddhiError as exc:
+                    self._json(400, {"error": str(exc)})
+                except Exception as exc:  # noqa: BLE001 — HTTP boundary
+                    self._json(500, {"error": repr(exc)})
+
+            def do_DELETE(self):
+                try:
+                    parts = [p for p in self.path.split("/") if p]
+                    if len(parts) == 2 and parts[0] == "siddhi-apps":
+                        rt = svc.manager.runtimes.pop(parts[1], None)
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                            return
+                        rt.shutdown()
+                        self._json(200, {"undeployed": parts[1]})
+                    else:
+                        self._json(404, {"error": "unknown path"})
+                except Exception as exc:  # noqa: BLE001 — HTTP boundary
+                    self._json(500, {"error": repr(exc)})
+
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "SiddhiRestService":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="siddhi-rest")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        self.manager.shutdown()

@@ -1,0 +1,89 @@
+"""Extension documentation generator.
+
+Reference (what): modules/siddhi-doc-gen — Maven mojos rendering mkdocs
+markdown from @Extension metadata (DocumentationUtils.java:84).
+TPU design (how): walk THIS framework's live registries (window types,
+stream functions, aggregators, scalar extensions, record stores) and render
+one markdown page per extension category from their docstrings — no build
+plugin, just `python -m siddhi_tpu.tools.docgen [outdir]`.
+"""
+from __future__ import annotations
+
+import inspect
+import os
+from typing import Dict, List, Tuple
+
+
+def _first_paragraph(doc: str) -> str:
+    doc = inspect.cleandoc(doc or "").strip()
+    return doc.split("\n\n")[0].replace("\n", " ") if doc else "(undocumented)"
+
+
+def collect() -> Dict[str, List[Tuple[str, str]]]:
+    """{category: [(name, summary)]} from the live registries."""
+    from ..core import window as win
+    from ..core.streamfn import STREAM_FUNCTIONS
+    from ..core.executor import AGGREGATOR_NAMES
+    from ..core.extension import extension_metadata, scalar_function_registry
+    from ..io.store import store_registry
+
+    out: Dict[str, List[Tuple[str, str]]] = {}
+    meta = extension_metadata()
+    out["windows"] = sorted(
+        (name, _first_paragraph(cls.__doc__))
+        for name, cls in win.WINDOW_TYPES.items())
+    out["stream-functions"] = sorted(
+        (name, _first_paragraph(
+            getattr(fn, "__doc__", "") or type(fn).__doc__))
+        for name, fn in STREAM_FUNCTIONS.items())
+    out["aggregators"] = sorted((n, "") for n in AGGREGATOR_NAMES)
+    def _scalar_summary(name, fn):
+        m = meta.get(f"scalar_function:{name}")
+        return (m.description if m else "") or \
+            _first_paragraph(getattr(fn, "__doc__", ""))
+    out["scalar-extensions"] = sorted(
+        (name, _scalar_summary(name, fn))
+        for name, fn in scalar_function_registry().items())
+    out["stores"] = sorted(
+        (name, _first_paragraph(cls.__doc__))
+        for name, cls in store_registry().items())
+    return out
+
+
+def render(collected: Dict[str, List[Tuple[str, str]]]) -> Dict[str, str]:
+    """{filename: markdown} mkdocs-style pages."""
+    pages: Dict[str, str] = {}
+    index = ["# siddhi_tpu extensions", "",
+             "Generated from the live extension registries "
+             "(reference role: siddhi-doc-gen).", ""]
+    for cat, items in collected.items():
+        index.append(f"- [{cat}]({cat}.md) ({len(items)})")
+        lines = [f"# {cat}", ""]
+        for name, summary in items:
+            lines.append(f"## {name}")
+            lines.append("")
+            if summary:
+                lines.append(summary)
+                lines.append("")
+        pages[f"{cat}.md"] = "\n".join(lines) + "\n"
+    pages["index.md"] = "\n".join(index) + "\n"
+    return pages
+
+
+def write(outdir: str) -> List[str]:
+    os.makedirs(outdir, exist_ok=True)
+    pages = render(collect())
+    written = []
+    for fname, content in pages.items():
+        path = os.path.join(outdir, fname)
+        with open(path, "w") as f:
+            f.write(content)
+        written.append(path)
+    return written
+
+
+if __name__ == "__main__":
+    import sys
+    target = sys.argv[1] if len(sys.argv) > 1 else "docs/extensions"
+    for p in write(target):
+        print(p)

@@ -65,19 +65,29 @@ class StatisticsManager:
                     "max_latency_ms": self._query_max_ns.get(name, 0) / 1e6,
                 }
         if app is not None:
-            mem = 0
+            # memory metric (reference: SiddhiMemoryUsageMetric's object-
+            # graph walk — here an exact pytree byte count, per query)
+            mem_by_query: Dict[str, int] = {}
             try:
                 import jax
                 import numpy as np
-                for qr in app.query_runtimes.values():
+                for name, qr in app.query_runtimes.items():
+                    q = 0
                     for leaf in jax.tree.leaves(qr.state):
-                        mem += np.asarray(leaf).nbytes \
+                        q += np.asarray(leaf).nbytes \
                             if not hasattr(leaf, "nbytes") else leaf.nbytes
+                    mem_by_query[name] = q
             except Exception:  # noqa: BLE001 — metrics must not throw
                 pass
-            out["state_bytes"] = mem
+            out["state_bytes"] = sum(mem_by_query.values())
+            out["state_bytes_by_query"] = mem_by_query
+            # buffered-events metric (reference: SiddhiBufferedEventsMetric)
             out["buffered_emissions"] = app._drainer._q.qsize() \
                 if app._drainer is not None else 0
+            pend = {sid: j.pending_async()
+                    for sid, j in app.junctions.items()}
+            out["buffered_ingress"] = {
+                sid: n for sid, n in pend.items() if n > 0}
         return out
 
     def reset(self) -> None:
@@ -87,3 +97,40 @@ class StatisticsManager:
             self._query_time_ns.clear()
             self._query_max_ns.clear()
             self._start = time.time()
+
+
+class ConsoleReporter:
+    """Periodic metric reporter (reference: SiddhiStatisticsManager
+    startReporting :55 — console reporter role).  `@app:statistics(
+    reporter='console', interval='5 sec')` or start one programmatically."""
+
+    def __init__(self, app, interval_s: float = 5.0, out=None):
+        self.app = app
+        self.interval_s = interval_s
+        self.out = out              # callable(line) or None -> print
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ConsoleReporter":
+        self._stop.clear()            # restartable after stop()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="siddhi-stats-report")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def _run(self) -> None:
+        import json
+        while not self._stop.wait(self.interval_s):
+            try:
+                line = json.dumps(self.app.statistics(), default=str)
+                if self.out is not None:
+                    self.out(line)
+                else:
+                    print(f"[siddhi-stats] {line}", flush=True)
+            except Exception:  # noqa: BLE001 — reporter must not die
+                pass

@@ -1,0 +1,147 @@
+"""REST service + doc-gen + extension metadata (reference:
+siddhi-service SiddhiApiServiceImpl.java:42, siddhi-doc-gen mojos,
+siddhi-annotations SiddhiAnnotationProcessor conventions)."""
+import json
+import urllib.request
+
+import pytest
+
+from siddhi_tpu.service import SiddhiRestService
+
+
+@pytest.fixture()
+def svc():
+    s = SiddhiRestService().start()
+    yield s
+    s.stop()
+
+
+def _req(svc, method, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{svc.port}{path}",
+        data=body.encode() if isinstance(body, str) else body,
+        method=method)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+APP = """
+@app:name('RestApp')
+define stream S (k string, v int);
+define table T (k string, v int);
+@info(name='w') from S insert into T;
+"""
+
+
+def test_rest_deploy_ingest_query_undeploy(svc):
+    code, r = _req(svc, "GET", "/health")
+    assert code == 200 and r == {"status": "ok"}
+
+    code, r = _req(svc, "POST", "/siddhi-apps", APP)
+    assert code == 201 and r == {"app": "RestApp"}
+
+    code, r = _req(svc, "GET", "/siddhi-apps")
+    assert code == 200 and r == {"apps": ["RestApp"]}
+
+    code, r = _req(svc, "POST", "/siddhi-apps/RestApp/streams/S",
+                   json.dumps({"events": [["a", 1], ["b", 2]]}))
+    assert code == 200 and r == {"accepted": 2}
+
+    code, r = _req(svc, "POST", "/query", json.dumps(
+        {"app": "RestApp", "query": "from T select k, v order by v"}))
+    assert code == 200 and r == {"records": [["a", 1], ["b", 2]]}
+
+    code, r = _req(svc, "GET", "/siddhi-apps/RestApp/statistics")
+    assert code == 200 and "streams" in r
+
+    code, r = _req(svc, "DELETE", "/siddhi-apps/RestApp")
+    assert code == 200
+    code, r = _req(svc, "GET", "/siddhi-apps")
+    assert r == {"apps": []}
+
+
+def test_rest_errors(svc):
+    code, r = _req(svc, "POST", "/siddhi-apps", "define bogus !!")
+    assert code == 400 and "error" in r
+    code, r = _req(svc, "DELETE", "/siddhi-apps/nope")
+    assert code == 404
+    code, r = _req(svc, "POST", "/siddhi-apps/nope/streams/S",
+                   json.dumps({"events": []}))
+    assert code == 404
+    code, r = _req(svc, "GET", "/bogus")
+    assert code == 404
+
+
+def test_docgen_renders_all_categories(tmp_path):
+    from siddhi_tpu.tools import docgen
+    written = docgen.write(str(tmp_path))
+    names = {p.split("/")[-1] for p in written}
+    assert {"index.md", "windows.md", "aggregators.md",
+            "stream-functions.md", "scalar-extensions.md",
+            "stores.md"} <= names
+    windows_md = (tmp_path / "windows.md").read_text()
+    for w in ("length", "lengthBatch", "time", "timeBatch", "session",
+              "expression"):
+        assert f"## {w}" in windows_md
+    aggs = (tmp_path / "aggregators.md").read_text()
+    assert "## distinctCount" in aggs
+    index = (tmp_path / "index.md").read_text()
+    assert "windows.md" in index
+
+
+def test_extension_metadata_and_validation():
+    from siddhi_tpu.core.executor import CompiledExpr
+    from siddhi_tpu.core.extension import (extension_metadata,
+                                           scalar_function)
+    from siddhi_tpu.exceptions import CompileError
+
+    @scalar_function("doc:twice", description="doubles a number",
+                     parameters=["value (numeric)"], return_type="same")
+    def _twice(args):
+        a = args[0]
+        return CompiledExpr(fn=lambda env: a.fn(env) * 2, type=a.type)
+
+    meta = extension_metadata()["scalar_function:doc:twice"]
+    assert meta.description == "doubles a number"
+    assert meta.parameters == ["value (numeric)"]
+
+    with pytest.raises(CompileError):       # duplicate without replace
+        @scalar_function("doc:twice")
+        def _dup(args):
+            return None
+
+    @scalar_function("doc:twice", replace=True)
+    def _ok(args):
+        return None
+
+    with pytest.raises(CompileError):       # invalid name
+        @scalar_function("9bad:name!")
+        def _bad(args):
+            return None
+
+
+def test_console_reporter_emits():
+    import time
+    from siddhi_tpu import SiddhiManager
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:statistics(reporter='console', interval='50 ms')
+    define stream S (a int);
+    @info(name='q') from S select a insert into O;
+    """)
+    lines = []
+    rt._stats_reporter.out = lines.append
+    rt._stats_reporter.interval_s = 0.05
+    rt.start()
+    rt.get_input_handler("S").send([1])
+    deadline = time.time() + 5
+    while not lines and time.time() < deadline:
+        time.sleep(0.02)
+    assert lines, "console reporter produced no report"
+    rep = json.loads(lines[0])
+    assert rep["streams"]["S"]["events"] == 1
+    assert "state_bytes" in rep
+    m.shutdown()
