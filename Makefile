@@ -1,0 +1,19 @@
+# Dev entry points (reference role: the Maven build's verify/test lifecycle).
+PY ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: test lint dryrun bench all
+
+all: lint test dryrun
+
+lint:
+	$(PY) -m compileall -q siddhi_tpu tests samples
+
+test:
+	$(CPU_ENV) $(PY) -m pytest tests/ -q
+
+dryrun:
+	$(CPU_ENV) $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench:
+	$(PY) bench.py

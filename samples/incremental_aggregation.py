@@ -1,0 +1,34 @@
+"""Incremental aggregation sample (reference role: quick-start
+AggregateDataIncrementallySample — sec..year cascade + `within`/`per` join)."""
+from siddhi_tpu import SiddhiManager
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime("""
+        @app:playback
+        define stream TradeStream (symbol string, price double, volume long);
+        define aggregation TradeAggregation
+          from TradeStream
+          select symbol, avg(price) as avgPrice, sum(volume) as total
+          group by symbol
+          aggregate every sec ... hour;
+    """)
+    runtime.start()
+
+    handler = runtime.get_input_handler("TradeStream")
+    handler.send([["IBM", 100.0, 10]], timestamp=1_000)
+    handler.send([["IBM", 102.0, 20]], timestamp=1_500)
+    handler.send([["IBM", 104.0, 30]], timestamp=61_000)
+    runtime.flush()
+
+    rows = runtime.query(
+        "from TradeAggregation within 0L, 10000000L per 'minutes' "
+        "select symbol, avgPrice, total")
+    for event in rows:
+        print("minute bucket:", event.data)
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

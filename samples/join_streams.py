@@ -1,0 +1,31 @@
+"""Windowed join sample (reference role: quick-start JoinSample — join two
+streams over length windows on a shared key)."""
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.utils.testing import EventPrinter
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime("""
+        define stream TempStream (roomNo int, temp double);
+        define stream RegulatorStream (roomNo int, isOn bool);
+        @info(name='joinQuery')
+        from TempStream#window.length(10) join
+             RegulatorStream#window.length(10)
+          on TempStream.roomNo == RegulatorStream.roomNo
+        select TempStream.roomNo as roomNo, temp, isOn
+        insert into RegulatorTempStream;
+    """)
+    printer = EventPrinter()
+    runtime.add_callback("joinQuery", printer)
+    runtime.start()
+
+    runtime.get_input_handler("TempStream").send([1, 23.5])
+    runtime.get_input_handler("RegulatorStream").send([1, True])
+    runtime.get_input_handler("TempStream").send([2, 30.0])
+    runtime.flush()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""Partition sample (reference role: quick-start PartitionSample — per-key
+isolated query state via `partition with (value of ...)`)."""
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.utils.testing import EventPrinter
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime("""
+        define stream TradeStream (symbol string, price float, volume long);
+        partition with (symbol of TradeStream)
+        begin
+          @info(name='perSymbolMax')
+          from TradeStream
+          select symbol, max(price) as maxPrice
+          insert into MaxPriceStream;
+        end;
+    """)
+    printer = EventPrinter()
+    runtime.add_callback("perSymbolMax", printer)
+    runtime.start()
+
+    handler = runtime.get_input_handler("TradeStream")
+    handler.send(["IBM", 75.0, 10])
+    handler.send(["WSO2", 40.0, 5])
+    handler.send(["IBM", 80.0, 8])     # IBM max rises independently
+    handler.send(["WSO2", 38.0, 2])    # WSO2 max unchanged
+    runtime.flush()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

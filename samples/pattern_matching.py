@@ -1,0 +1,28 @@
+"""Pattern sample (reference role: quick-start PatternMatchingSample —
+`every A -> B` with a cross-event condition)."""
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.utils.testing import EventPrinter
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float);
+        @info(name='riseQuery')
+        from every e1=StockStream -> e2=StockStream[price > e1.price]
+        select e1.symbol as symbol, e1.price as buy, e2.price as sell
+        insert into RiseStream;
+    """)
+    printer = EventPrinter()
+    runtime.add_callback("riseQuery", printer)
+    runtime.start()
+
+    handler = runtime.get_input_handler("StockStream")
+    for price in (50.0, 48.0, 52.0, 55.0):
+        handler.send(["ACME", price])
+    runtime.flush()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

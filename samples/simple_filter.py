@@ -1,0 +1,32 @@
+"""Filter sample (reference role: quick-start SimpleFilterSample —
+filter a stream on a condition and print the survivors)."""
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.utils.testing import EventPrinter
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        @info(name='filterQuery')
+        from StockStream[volume > 100 and price >= 50.0]
+        select symbol, price
+        insert into HighVolumeStream;
+    """)
+    printer = EventPrinter()
+    runtime.add_callback("filterQuery", printer)
+    runtime.start()
+
+    handler = runtime.get_input_handler("StockStream")
+    handler.send(["IBM", 75.6, 105])
+    handler.send(["WSO2", 45.6, 150])     # dropped: price < 50
+    handler.send(["GOOG", 50.0, 200])
+    handler.send(["MSFT", 88.0, 80])      # dropped: volume <= 100
+    runtime.flush()
+
+    print(f"{printer.count} events passed the filter")
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

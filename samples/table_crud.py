@@ -1,0 +1,34 @@
+"""Table sample (reference role: quick-start TableSample — @PrimaryKey/@Index
+table with insert, indexed update, and an on-demand store query)."""
+from siddhi_tpu import SiddhiManager
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime("""
+        define stream StockStream (symbol string, price float, volume long);
+        define stream UpdateStream (symbol string, price float);
+        @PrimaryKey('symbol')
+        @Index('volume')
+        define table StockTable (symbol string, price float, volume long);
+        @info(name='add') from StockStream insert into StockTable;
+        @info(name='upd') from UpdateStream
+        update StockTable set StockTable.price = price
+          on StockTable.symbol == symbol;
+    """)
+    runtime.start()
+
+    runtime.get_input_handler("StockStream").send(["IBM", 75.0, 100])
+    runtime.get_input_handler("StockStream").send(["WSO2", 40.0, 200])
+    runtime.get_input_handler("UpdateStream").send(["IBM", 80.0])
+    runtime.flush()
+
+    rows = runtime.query("from StockTable on volume >= 100 "
+                         "select symbol, price, volume")
+    for event in rows:
+        print("row:", event.data)
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

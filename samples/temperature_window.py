@@ -1,0 +1,32 @@
+"""Sliding-window aggregation sample (reference role: quick-start
+TemperatureWindowSample — avg over #window.time with group-by)."""
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.utils.testing import EventPrinter
+
+
+def main():
+    manager = SiddhiManager()
+    runtime = manager.create_siddhi_app_runtime("""
+        @app:playback
+        define stream TempStream (roomNo int, temp double);
+        @info(name='avgTempQuery')
+        from TempStream#window.time(1 min)
+        select roomNo, avg(temp) as avgTemp, count() as n
+        group by roomNo
+        insert into AvgTempStream;
+    """)
+    printer = EventPrinter()
+    runtime.add_callback("avgTempQuery", printer)
+    runtime.start()
+
+    handler = runtime.get_input_handler("TempStream")
+    handler.send([[1, 23.0]], timestamp=1_000)
+    handler.send([[2, 20.5]], timestamp=2_000)
+    handler.send([[1, 25.0]], timestamp=3_000)
+    handler.send([[1, 24.0]], timestamp=70_000)   # first event expired
+    runtime.flush()
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()

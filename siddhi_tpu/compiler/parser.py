@@ -199,6 +199,10 @@ class Parser:
                     ann.annotations.append(self.parse_annotation())
                 else:
                     key, val = self._parse_annotation_element()
+                    if key is None and None in ann.elements:
+                        # later positional elements must not overwrite the
+                        # first (@Index('a','b'), composite @PrimaryKey)
+                        key = f"__p{len(ann.elements)}"
                     ann.elements[key] = val
                 if not self.eat_punct(","):
                     break

@@ -51,6 +51,7 @@ _DTYPES = {
 }
 
 NULL_ID = -1  # interned id representing null string
+UUID_SENTINEL = -2  # UUID() marker id: decodes to a fresh uuid4 per cell
 
 _BUCKETS = (8, 32, 128, 512, 2048, 8192, 32768, 131072, 262144, 524288,
             1048576, 2097152)
@@ -183,7 +184,15 @@ class Schema:
     def decode_value(self, attr_type: str, v):
         t = attr_type.upper()
         if t == "STRING":
-            return self.interner.lookup(int(v))
+            iv = int(v)
+            if iv == UUID_SENTINEL:
+                # UUID() columns materialize one fresh id per decoded cell
+                # (reference: CORE/executor/function/UUIDFunctionExecutor —
+                # one UUID per event); device-side the column carries the
+                # sentinel, the string exists only at the host boundary
+                import uuid
+                return str(uuid.uuid4())
+            return self.interner.lookup(iv)
         if t == "OBJECT":
             return self.objects.lookup(int(v))
         if t == "BOOL":
@@ -331,10 +340,17 @@ def unpack(schema: Schema, batch: EventBatch,
     kind_l = kind[idx].tolist()
     col_ls = [np.asarray(c)[idx].tolist() for c in batch.cols]
     decoders = []
+
+    def _str_decode(i, _lk=schema.interner.lookup):
+        if i == UUID_SENTINEL:
+            import uuid
+            return str(uuid.uuid4())
+        return _lk(i)
+
     for t in schema.types:
         tu = t.upper()
         if tu == "STRING":
-            decoders.append(schema.interner.lookup)
+            decoders.append(_str_decode)
         elif tu == "OBJECT":
             decoders.append(schema.objects.lookup)
         else:

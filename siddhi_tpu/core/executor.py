@@ -351,6 +351,16 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
         # the SET pseudo-value IS the running distinct count
         return CompiledExpr(src.fn, "LONG")
 
+    if full == "UUID":
+        # one unique id per output event (reference: CORE/executor/function/
+        # UUIDFunctionExecutor).  Device-side the column is the sentinel;
+        # Schema.decode_value turns each delivered cell into a fresh uuid4 —
+        # strings never ride the device
+        def fn(env):
+            return jnp.full(jnp.shape(env["__ts__"]), ev.UUID_SENTINEL,
+                            ev.dtype_of("STRING"))
+        return CompiledExpr(fn, "STRING")
+
     if full == "eventTimestamp":
         def fn(env):
             return env["__ts__"]

@@ -94,7 +94,16 @@ def execute_on_demand(rt, oq) -> List[ev.Event]:
         c = compile_expression(store.on_condition, scope)
         if c.type != "BOOL":
             raise CompileError("on-condition must be boolean")
-        mask &= np.asarray(c.fn(env)).astype(bool)
+        table = rt.tables.get(store.store_id)
+        sel = (_indexed_row_mask(table, store.on_condition, key, schema,
+                                 scope, env, mask)
+               if table is not None else None)
+        if sel is not None:
+            mask &= sel
+        else:
+            if table is not None:
+                table.index_stats["dense"] += 1
+            mask &= np.asarray(c.fn(env)).astype(bool)
 
     if oq.type == "FIND":
         return _find(rt, oq, scope, schema, env, mask, key)
@@ -108,6 +117,53 @@ def execute_on_demand(rt, oq) -> List[ev.Event]:
         raise CompileError(f"on-demand {oq.type} target must be a table")
     _apply_write(rt, oq, sel_events, schema, key)
     return sel_events
+
+
+def _indexed_row_mask(table, cond_expr, key, schema, scope, env, valid):
+    """Index-aware on-demand condition (reference: the store-query path of
+    CollectionExpressionParser + IndexOperator.find). Returns a row mask, or
+    None when the condition has no usable indexed conjunct."""
+    from .table_index import split_index_condition
+
+    probe_positions = list(table.indexes)
+    if table.pkey_positions is not None and len(table.pkey_positions) == 1:
+        probe_positions.append(table.pkey_positions[0])
+    if not probe_positions:
+        return None
+    plan = split_index_condition(cond_expr, key, schema, probe_positions,
+                                 unqualified_is_table=True)
+    if plan is None:
+        return None
+    if plan.kind == "range" and plan.pos not in table.indexes:
+        return None
+    rv = np.asarray(compile_expression(plan.rhs, scope).fn(env))
+    val = rv.reshape(-1)[0]
+    if plan.kind == "eq":
+        if plan.pos in table.indexes:
+            rows = table.indexes[plan.pos].rows_eq(val)
+        else:
+            rows = table.allocator.slots_for(
+                [np.asarray([val], ev.np_dtype(
+                    table.schema.types[plan.pos]))],
+                np.ones(1, bool), lookup_only=True)
+            rows = rows[rows >= 0].astype(np.int64)
+    else:
+        rows = table.indexes[plan.pos].rows_range(
+            np.asarray(table.valid), plan.op, val)
+    mask = np.zeros(valid.shape, bool)
+    rows = rows[rows < valid.shape[0]]
+    mask[rows] = True
+    mask &= valid
+    if plan.residual is not None and mask.any():
+        ridx = np.nonzero(mask)[0]
+        env_sub = dict(env)
+        env_sub[key] = tuple(np.asarray(cc)[ridx] for cc in env[key])
+        env_sub["__ts__"] = np.asarray(env["__ts__"])[ridx]
+        rmask = np.asarray(
+            compile_expression(plan.residual, scope).fn(env_sub))
+        mask[ridx] &= np.broadcast_to(rmask.astype(bool), ridx.shape)
+    table.index_stats["indexed"] += 1
+    return mask
 
 
 def _result_schema(names, types, interner):
@@ -304,7 +360,7 @@ def _apply_write(rt, oq, sel_events, store_schema, key) -> None:
     cond_expr = (out_stream.on_delete_expression
                  if isinstance(out_stream, DeleteStream)
                  else out_stream.on_update_expression)
-    cond = compile_expression(cond_expr, cscope)
+    cond = table.plan_condition(cond_expr, cscope)
     set_fns = []
     us = getattr(out_stream, "update_set", None)
     if us is not None:

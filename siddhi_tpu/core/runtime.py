@@ -1527,8 +1527,8 @@ class SiddhiAppRuntime:
                 if stype is None:
                     raise CompileError(
                         f"@store on table {tid!r} needs a type element")
-                props = {k: v for k, v in store_ann.elements.items()
-                         if k not in (None, "type")}
+                props = {k: v for k, v in store_ann.named_elements().items()
+                         if k != "type"}
                 reader = self.config_manager.generate_config_reader(
                     "store", str(stype))
                 store = create_store(str(stype), tdef, schema, props, reader)
@@ -1537,7 +1537,7 @@ class SiddhiAppRuntime:
                     if sub.name.lower() == "cache":
                         pk = tdef.get_annotation("PrimaryKey")
                         kpos = [schema.position(v)
-                                for v in pk.elements.values()] if pk else \
+                                for v in pk.positional_elements()] if pk else \
                             list(range(len(schema.names)))
                         cache = CacheTable(
                             store, kpos,
@@ -1772,7 +1772,7 @@ class SiddhiAppRuntime:
                 # table attrs must be qualified (T.attr); unqualified names
                 # resolve to the query output side, as in the reference
                 scope.add_source(tgt, table.schema, default=False)
-                cond = compile_expression(cond_expr, scope)
+                cond = table.plan_condition(cond_expr, scope)
                 set_fns = []
                 us = getattr(out_stream, "update_set", None)
                 if us is None and not isinstance(out_stream, DeleteStream):

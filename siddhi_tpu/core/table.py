@@ -28,6 +28,31 @@ from ..query_api.query import UpdateSet
 from . import event as ev
 from .executor import CompileError, CompiledExpr, Scope, compile_expression
 from .keyslots import SlotAllocator
+from .table_index import AttributeIndex, IndexPlan, split_index_condition
+
+
+class TableCondition:
+    """A compiled table condition + optional index plan (reference:
+    CollectionExpressionParser.java splits a condition into an indexed probe
+    and an exhaustive residual). `compiled` always holds the full dense
+    condition (fallback + join path)."""
+
+    def __init__(self, compiled: CompiledExpr,
+                 plan: Optional[IndexPlan] = None,
+                 rhs_fn=None, residual_fn=None):
+        self.compiled = compiled
+        self.plan = plan
+        self.rhs_fn = rhs_fn
+        self.residual_fn = residual_fn
+
+    # CompiledExpr duck-typing for callers that pass this to match_matrix
+    @property
+    def fn(self):
+        return self.compiled.fn
+
+    @property
+    def type(self):
+        return self.compiled.type
 
 
 class TableRuntime:
@@ -45,10 +70,23 @@ class TableRuntime:
         self.pkey_positions: Optional[List[int]] = None
         self.allocator: Optional[SlotAllocator] = None
         if pk is not None:
-            names = [v for v in pk.elements.values()]
+            names = pk.positional_elements()
             self.pkey_positions = [schema.position(n) for n in names]
             self.allocator = SlotAllocator(capacity,
                                            name=f"table:{definition.id}")
+        # @Index('a', 'b') declares one secondary index per attribute
+        # (reference: IndexEventHolder.java:65-66, EventHolderPasser.java:48)
+        self.indexes: Dict[int, AttributeIndex] = {}
+        idx_ann = definition.get_annotation("Index")
+        if idx_ann is not None:
+            for n in idx_ann.positional_elements():
+                p = schema.position(n)
+                if self.pkey_positions == [p]:
+                    continue  # the primary key is already an index
+                self.indexes[p] = AttributeIndex(
+                    capacity, ev.np_dtype(schema.types[p]),
+                    name=f"{definition.id}.{n}")
+        self.index_stats = {"indexed": 0, "dense": 0}
         # device state
         self.cols = tuple(
             jnp.full((capacity,), ev.default_value(t), dtype=d)
@@ -104,17 +142,52 @@ class TableRuntime:
         return jnp.logical_and(valid, jnp.logical_not(kill))
 
     # -- public API ------------------------------------------------------------
+    def _materialize_uuids(self, batch: ev.EventBatch,
+                           staged: ev.StagedBatch):
+        """UUID() sentinels must become real interned strings at the storage
+        boundary — a stored sentinel would decode to a different id on every
+        read (reference: one UUID per event, UUIDFunctionExecutor)."""
+        import uuid
+        interner = self.schema.interner
+        new_batch_cols = None
+        for pos, t in enumerate(self.schema.types):
+            if t != "STRING":
+                continue
+            col = np.asarray(staged.cols[pos])
+            mask = staged.valid & (col == ev.UUID_SENTINEL)
+            if not mask.any():
+                continue
+            col = col.copy()
+            col[mask] = [interner.intern(str(uuid.uuid4()))
+                         for _ in range(int(mask.sum()))]
+            scols = list(staged.cols)
+            scols[pos] = col
+            staged.cols = scols
+            if new_batch_cols is None:
+                new_batch_cols = list(batch.cols)
+            new_batch_cols[pos] = jnp.asarray(col).astype(
+                batch.cols[pos].dtype)
+        if new_batch_cols is not None:
+            batch = batch.with_cols(new_batch_cols)
+        return batch
+
     def insert(self, batch: ev.EventBatch, staged: ev.StagedBatch) -> None:
         """Insert CURRENT rows (keyed: upsert on primary key; else append)."""
         with self._lock:
             n = int(np.sum(staged.valid))
             if n == 0:
                 return
+            batch = self._materialize_uuids(batch, staged)
             if self.pkey_positions is not None:
                 slots = self._slots_for_batch(staged.cols, staged.valid, True)
             else:
                 slots = np.full((staged.valid.shape[0],), -1, np.int32)
                 slots[staged.valid] = self._append_slots(n)
+            if self.indexes:
+                mask = staged.valid & (slots >= 0)
+                rows = slots[mask].astype(np.int64)
+                for pos, idx in self.indexes.items():
+                    idx.on_write(rows, np.asarray(staged.cols[pos])[mask])
             self.cols, self.ts, self.valid = self._jit_write(
                 self.cols, self.ts, self.valid, batch.cols, batch.ts,
                 jnp.asarray(slots), jnp.asarray(staged.valid))
@@ -127,6 +200,102 @@ class TableRuntime:
         scope.add_source(self.definition.id, self.schema)
         scope.add_source(other_key, other_schema)
         return compile_expression(cond, scope)
+
+    def plan_condition(self, cond_expr: Expression, scope: Scope,
+                       ) -> TableCondition:
+        """Compile a table condition with index-aware planning: if one AND-
+        conjunct is `table.attr == <stream expr>` on an indexed attribute (or
+        a single-column primary key), later matches probe that index instead
+        of the dense [B, C] broadcast (reference:
+        CollectionExpressionParser.java; IndexOperator.java)."""
+        compiled = compile_expression(cond_expr, scope)
+        probe_positions = list(self.indexes)
+        if self.pkey_positions is not None and len(self.pkey_positions) == 1:
+            probe_positions.append(self.pkey_positions[0])
+        plan = None
+        if probe_positions:
+            plan = split_index_condition(
+                cond_expr, self.definition.id, self.schema, probe_positions)
+        if plan is None:
+            return TableCondition(compiled)
+        if plan.kind == "range" and plan.pos not in self.indexes:
+            return TableCondition(compiled)  # pkey has no sorted view
+        rhs_fn = compile_expression(plan.rhs, scope).fn
+        residual_fn = (compile_expression(plan.residual, scope).fn
+                       if plan.residual is not None else None)
+        return TableCondition(compiled, plan, rhs_fn, residual_fn)
+
+    def _probe_candidates(self, pos: int, values: np.ndarray):
+        """values [B] -> (cand [B, K] int32, ok [B, K] bool)."""
+        values = np.asarray(values).astype(
+            ev.np_dtype(self.schema.types[pos]))
+        if pos in self.indexes:
+            return self.indexes[pos].probe_eq(values)
+        # single-column primary key: the slot allocator IS the index
+        slots = self.allocator.slots_for(
+            [np.ascontiguousarray(values)],
+            np.ones(values.shape[0], bool), lookup_only=True)
+        cand = slots.astype(np.int32)[:, None]
+        return cand, cand >= 0
+
+    def _match(self, cond, other_key: str, batch: ev.EventBatch,
+               staged: Optional[ev.StagedBatch] = None):
+        """Unified match for delete/update paths.
+
+        Returns (hit [C] bool, src [C] int last-matching-stream-row — device
+        arrays on the dense path, host on the indexed path — and
+        matched_any(), a thunk for the [B] per-stream-row hit mask so the
+        dense path pays no device sync unless upsert needs it)."""
+        C = self.capacity
+        plan = cond.plan if isinstance(cond, TableCondition) else None
+        if plan is None or plan.kind != "eq":
+            self.index_stats["dense"] += 1
+            m = self.match_matrix(cond, other_key, batch)      # [B, C]
+            hit = jnp.any(m, axis=0)
+            B = m.shape[0]
+            rowid = jnp.arange(B)[:, None]
+            src = jnp.max(jnp.where(m, rowid, -1), axis=0)
+            return hit, src, lambda: np.asarray(jnp.any(m, axis=1))
+        self.index_stats["indexed"] += 1
+        # stream-side key values: [B] on host (staged cols when available,
+        # else one small device read)
+        if staged is not None:
+            env_np = {other_key: tuple(staged.cols), "__ts__": staged.ts}
+            vals = np.asarray(cond.rhs_fn(env_np))
+        else:
+            env_d = {other_key: batch.cols, "__ts__": batch.ts}
+            vals = np.asarray(cond.rhs_fn(env_d))
+        if vals.ndim == 0:
+            vals = np.broadcast_to(vals, (batch.ts.shape[0],))
+        cand, ok = self._probe_candidates(plan.pos, vals)       # [B, K]
+        bvalid = np.asarray(batch.valid)
+        ok = ok & bvalid[:, None]
+        if ok.any():
+            tvalid = np.asarray(self.valid)
+            safe = np.clip(cand, 0, C - 1)
+            ok = ok & tvalid[safe]
+        if ok.any():
+            # re-evaluate the FULL condition on the gathered candidates:
+            # the hash probe only narrows, it never decides — this keeps
+            # exact dense `==` semantics under dtype casts (LONG rhs vs INT
+            # column) and hash-collision corner cases
+            safe = jnp.asarray(np.clip(cand, 0, C - 1))
+            env = {
+                self.definition.id: tuple(c[safe] for c in self.cols),
+                other_key: tuple(c[:, None] for c in batch.cols),
+                "__ts__": batch.ts[:, None],
+            }
+            ok = ok & np.asarray(cond.compiled.fn(env)).astype(bool)
+        hit = np.zeros(C, bool)
+        src = np.full(C, -1, np.int64)
+        rows = cand[ok]
+        if rows.size:
+            hit[rows] = True
+            bs = np.broadcast_to(
+                np.arange(ok.shape[0], dtype=np.int64)[:, None],
+                ok.shape)[ok]
+            np.maximum.at(src, rows, bs)
+        return hit, src, lambda: ok.any(axis=1)
 
     def match_matrix(self, compiled: CompiledExpr, other_key: str,
                      batch: ev.EventBatch):
@@ -144,19 +313,21 @@ class TableRuntime:
     def delete_where(self, compiled: CompiledExpr, other_key: str,
                      batch: ev.EventBatch, staged=None) -> None:
         with self._lock:
-            m = self.match_matrix(compiled, other_key, batch)
-            kill = jnp.any(m, axis=0)
-            self.valid = self._jit_masked_delete(self.valid, kill)
-            self._reclaim(kill)
+            kill, _, _ = self._match(compiled, other_key, batch, staged)
+            self.valid = self._jit_masked_delete(self.valid,
+                                                 jnp.asarray(kill))
+            self._reclaim(np.asarray(kill))
 
     def _reclaim(self, kill) -> None:
+        killed = np.nonzero(np.asarray(kill))[0]
         if self.pkey_positions is not None:
-            killed = np.nonzero(np.asarray(kill))[0]
             if killed.size:
                 self.allocator.purge(killed.tolist())
         else:
-            killed = np.nonzero(np.asarray(kill))[0]
             self._free_rows.extend(int(x) for x in killed)
+        if killed.size:
+            for idx in self.indexes.values():
+                idx.on_delete(killed)
 
     def update_where(self, compiled: CompiledExpr, other_key: str,
                      batch: ev.EventBatch,
@@ -167,27 +338,29 @@ class TableRuntime:
         """set_fns: [(table_col_pos, fn(env)->[B] value)], applied from the
         LAST matching stream row per table row (batch order semantics)."""
         with self._lock:
-            m = self.match_matrix(compiled, other_key, batch)   # [B, C]
-            hit = jnp.any(m, axis=0)                            # [C]
-            # last matching stream row per table row
-            B = m.shape[0]
-            rowid = jnp.arange(B)[:, None]
-            src = jnp.max(jnp.where(m, rowid, -1), axis=0)      # [C]
-            src_c = jnp.clip(src, 0, B - 1)
+            hit, src, matched_any = self._match(
+                compiled, other_key, batch, staged)
+            hit = jnp.asarray(hit)                              # [C]
+            src_c = jnp.clip(jnp.asarray(src), 0, batch.ts.shape[0] - 1)
             env = {
                 other_key: tuple(c[src_c] for c in batch.cols),
                 self.definition.id: self.cols,
                 "__ts__": batch.ts[src_c],
             }
             new_cols = list(self.cols)
+            # index maintenance needs host rows only when indexes exist
+            hit_rows = (np.nonzero(np.asarray(hit))[0]
+                        if self.indexes else None)
             for pos, fn in set_fns:
                 val = fn(env)
                 new_cols[pos] = jnp.where(hit, val.astype(self.cols[pos].dtype),
                                           self.cols[pos])
+                if self.indexes and pos in self.indexes and hit_rows.size:
+                    self.indexes[pos].on_write(
+                        hit_rows, np.asarray(val)[hit_rows])
             self.cols = tuple(new_cols)
             if upsert and staged is not None:
-                matched_any = np.asarray(jnp.any(m, axis=1))    # [B]
-                miss = staged.valid & ~matched_any
+                miss = staged.valid & ~matched_any()
                 if miss.any():
                     sub_staged = ev.StagedBatch(
                         staged.ts, staged.kind, miss,
@@ -299,8 +472,8 @@ class RecordTableRuntime(TableRuntime):
 
     def delete_where(self, compiled, other_key, batch, staged=None) -> None:
         with self._lock:
-            m = self.match_matrix(compiled, other_key, batch)
-            kill = np.asarray(jnp.any(m, axis=0))
+            kill, _, _ = self._match(compiled, other_key, batch, staged)
+            kill = np.asarray(kill)
             rows = self._decode_mirror(kill & np.asarray(self.valid))
             if rows:
                 self.store.delete_rows(rows)
@@ -312,8 +485,8 @@ class RecordTableRuntime(TableRuntime):
     def update_where(self, compiled, other_key, batch, set_fns,
                      upsert=False, staged=None, insert_map=None) -> None:
         with self._lock:
-            m = self.match_matrix(compiled, other_key, batch)
-            hit = np.asarray(jnp.any(m, axis=0)) & np.asarray(self.valid)
+            hit, _, _ = self._match(compiled, other_key, batch, staged)
+            hit = np.asarray(hit) & np.asarray(self.valid)
             old_rows = self._decode_mirror(hit)
         super().update_where(compiled, other_key, batch, set_fns,
                              upsert=upsert, staged=staged,
@@ -354,3 +527,6 @@ def _restore_table_state(t: TableRuntime, data: Dict) -> None:
         t._free_rows = list(data["free_rows"])
         if data["slots"] is not None and t.allocator:
             t.allocator.restore(data["slots"])
+        valid = np.asarray(t.valid)
+        for pos, idx in t.indexes.items():
+            idx.rebuild(np.asarray(t.cols[pos]), valid)

@@ -32,7 +32,7 @@ class SourceMapper:
                             paths.append(sub.elements[name])
                         else:
                             paths.append(None)
-                    pos = [v for k, v in sub.elements.items() if k is None]
+                    pos = sub.positional_elements()
                     if pos:
                         paths = list(pos) + paths[len(pos):]
                     self.attribute_paths = paths

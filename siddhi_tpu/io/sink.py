@@ -15,7 +15,13 @@ log = logging.getLogger("siddhi_tpu")
 
 
 class Sink:
-    """Transport SPI: subclass and register with register_sink_type."""
+    """Transport SPI: subclass and register with register_sink_type.
+
+    `self.config_reader` (scoped to `sink.<type>.*`) is injected before
+    init — reference: Sink.init receives a ConfigReader
+    (CORE/stream/output/sink/Sink.java:59 via DefinitionParserHelper)."""
+
+    config_reader = None
 
     def init(self, options: Dict[str, Any]):
         self.options = options
@@ -68,8 +74,7 @@ class SinkRuntime:
             raise ValueError(
                 f"unknown sink type {stype!r}; registered: "
                 f"{sorted(SINK_TYPES)}")
-        self.options = {k: v for k, v in ann.elements.items()
-                        if k is not None}
+        self.options = ann.named_elements()
         map_ann = dist_ann = None
         for sub in ann.annotations:
             n = sub.name.lower()
@@ -100,15 +105,19 @@ class SinkRuntime:
             for dest in dist_ann.annotations:
                 if dest.name.lower() == "destination":
                     opts = dict(self.options)
-                    opts.update({k: v for k, v in dest.elements.items()
-                                 if k is not None})
+                    opts.update(dest.named_elements())
                     s = SINK_TYPES[stype]()
+                    s.config_reader = \
+                        app.config_manager.generate_config_reader(
+                            "sink", str(stype))
                     s.init(opts)
                     self.sinks.append(s)
             if not self.sinks:
                 raise ValueError("@distribution needs @destination(...)s")
         else:
             s = SINK_TYPES[stype]()
+            s.config_reader = app.config_manager.generate_config_reader(
+                "sink", str(stype))
             s.init(self.options)
             self.sinks.append(s)
 

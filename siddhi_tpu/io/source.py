@@ -14,7 +14,15 @@ from .mappers import SOURCE_MAPPERS, SourceMapper
 
 
 class Source:
-    """Transport SPI: subclass and register with @source_extension."""
+    """Transport SPI: subclass and register with @source_extension.
+
+    `self.config_reader` (a utils.config.ConfigReader scoped to
+    `source.<type>.*`) is injected before init — the reference hands the
+    reader to Source.init (CORE/stream/input/source/Source.java:66 via
+    DefinitionParserHelper); here it rides the instance so subclass init
+    signatures stay stable."""
+
+    config_reader = None
 
     def init(self, options: Dict[str, Any], deliver: Callable[[Any], None]):
         """`deliver(payload)` pushes one transport payload into the mapper."""
@@ -78,8 +86,7 @@ class SourceRuntime:
             raise ValueError(
                 f"unknown source type {stype!r}; registered: "
                 f"{sorted(SOURCE_TYPES)}")
-        self.options = {k: v for k, v in ann.elements.items()
-                        if k is not None}
+        self.options = ann.named_elements()
         map_ann = None
         for sub in ann.annotations:
             if sub.name.lower() == "map":
@@ -91,6 +98,8 @@ class SourceRuntime:
         schema = app.schemas[stream_id]
         self.mapper: SourceMapper = SOURCE_MAPPERS[mtype](schema, map_ann)
         self.source: Source = SOURCE_TYPES[stype]()
+        self.source.config_reader = app.config_manager.generate_config_reader(
+            "source", str(stype))
         self.source.init(self.options, self._deliver)
 
     # -- lifecycle -------------------------------------------------------------

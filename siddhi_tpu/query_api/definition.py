@@ -51,6 +51,19 @@ class Annotation:
     def element(self, key: Optional[str] = None, default: Any = None) -> Any:
         return self.elements.get(key, default)
 
+    def positional_elements(self) -> List[Any]:
+        """All positional (key-less) elements in source order.  The parser
+        stores the first under None and later ones under synthetic '__pN'
+        keys (dicts cannot repeat None); consumers must use this instead of
+        filtering elements by key."""
+        return [v for k, v in self.elements.items()
+                if k is None or str(k).startswith("__p")]
+
+    def named_elements(self) -> Dict[str, Any]:
+        """Key=value elements only (no positionals, no synthetic keys)."""
+        return {k: v for k, v in self.elements.items()
+                if k is not None and not str(k).startswith("__p")}
+
 
 class AbstractDefinition:
     def __init__(self, id: str):

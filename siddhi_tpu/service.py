@@ -77,6 +77,16 @@ class SiddhiRestService:
                     parts = [p for p in self.path.split("/") if p]
                     if parts == ["siddhi-apps"]:
                         ql = self._body().decode()
+                        from .compiler import SiddhiCompiler
+                        app = SiddhiCompiler.parse(ql)
+                        name = app.name or "SiddhiApp"
+                        if name in svc.manager.runtimes:
+                            # reference: duplicate deployment is rejected,
+                            # never silently replaced (the old runtime's
+                            # threads would leak unreachable)
+                            self._json(409, {
+                                "error": f"app {name!r} already deployed"})
+                            return
                         rt = svc.manager.create_siddhi_app_runtime(ql)
                         rt.start()
                         self._json(201, {"app": rt.name})

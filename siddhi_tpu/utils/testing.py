@@ -1,0 +1,87 @@
+"""Test/debug utilities.
+
+Reference (what): CORE/util/EventPrinter.java (print(timestamp, inEvents,
+outEvents) used by every sample/test callback) and
+CORE/util/SiddhiTestHelper.java:32 (waitForEvents polling helper used across
+the reference test suite).
+
+TPU design (how): the printer accepts both the per-event callback shape
+(timestamp, in_events, out_events) and the columnar batch-callback payload —
+batches print without forcing payload materialization beyond the requested
+columns; the wait helper polls a counter the way reference tests do, plus a
+flush-aware variant that drains the runtime's async paths first.
+"""
+from __future__ import annotations
+
+import sys
+import time
+from typing import Callable, Optional
+
+
+def print_event(timestamp, in_events, out_events=None, out=None) -> None:
+    """Drop-in QueryCallback printer (reference: EventPrinter.print)."""
+    out = out or sys.stdout
+    def fmt(evs):
+        if evs is None:
+            return "null"
+        return "[" + ", ".join(
+            "Event{timestamp=%s, data=%s}" % (e.timestamp, list(e.data))
+            for e in evs) + "]"
+    print(f"Events @ {timestamp}: in:{fmt(in_events)} "
+          f"out:{fmt(out_events)}", file=out)
+
+
+def print_batch(timestamp, payload, out=None) -> None:
+    """Batch-callback printer: shows device-computed counts without pulling
+    payload columns to host (pass materialize=True for full rows)."""
+    out = out or sys.stdout
+    counts = {k: payload[k] for k in
+              ("n_current", "n_expired", "n_timer", "n_reset")
+              if k in payload}
+    print(f"Batch @ {timestamp}: {counts}", file=out)
+
+
+class EventPrinter:
+    """Stateful printer that also counts, for quick assertions:
+
+        p = EventPrinter()
+        rt.add_callback('q', p)
+        ...
+        assert p.count == 3
+    """
+
+    def __init__(self, out=None, quiet: bool = False):
+        self.count = 0
+        self.events = []
+        self._out = out
+        self._quiet = quiet
+
+    def __call__(self, timestamp, in_events, out_events=None):
+        evs = list(in_events or [])
+        self.events.extend(evs)
+        self.count += len(evs)
+        if not self._quiet:
+            print_event(timestamp, in_events, out_events, out=self._out)
+
+
+def wait_for_events(get_count: Callable[[], int], expected: int,
+                    timeout_s: float = 5.0, interval_s: float = 0.02) -> bool:
+    """Poll until `get_count() >= expected` (reference:
+    SiddhiTestHelper.waitForEvents :39). Returns False on timeout."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if get_count() >= expected:
+            return True
+        time.sleep(interval_s)
+    return get_count() >= expected
+
+
+def wait_and_assert(runtime, get_count: Callable[[], int], expected: int,
+                    timeout_s: float = 5.0) -> None:
+    """Flush the runtime's async paths, then wait; raises AssertionError with
+    the observed count on failure."""
+    runtime.flush()
+    if not wait_for_events(get_count, expected, timeout_s):
+        raise AssertionError(
+            f"expected {expected} events, saw {get_count()} "
+            f"after {timeout_s}s")
