@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import heapq
+import logging
 import pickle
 import threading
 import time
@@ -32,6 +33,10 @@ from .planner import PlannedQuery, plan_single_query
 from .window import NO_WAKEUP
 
 _NO_WAKEUP_INT = int(NO_WAKEUP)
+
+# @app:statistics DETAIL-level event tracing (reference: log4j TRACE at
+# StreamJunction.sendEvent :147 and QuerySelector.process :77)
+_trace_log = logging.getLogger("siddhi_tpu.trace")
 
 
 def current_millis() -> int:
@@ -628,6 +633,10 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
                 j.queries or j.stream_callbacks or app.stats.enabled)
     if not (qr.callbacks or qr.batch_callbacks or target_live):
         return
+    if qr.app.stats.detail:
+        # reference: log4j TRACE at QuerySelector.process :77
+        _trace_log.debug("query %s: emitting output batch @ %d",
+                         qr.name, now)
     counts = None
     overflow_exc = None
     if len(out) == 6:
@@ -1077,6 +1086,12 @@ class StreamJunction:
         """Run every subscribed query over a staged batch, serialized per
         QUERY (not per app) so queries on different streams — or workers of
         different streams — process concurrently."""
+        stats = self.app.stats if self.app is not None else None
+        if stats is not None and stats.detail:
+            # reference: log4j TRACE at StreamJunction.sendEvent :147
+            _trace_log.debug("junction %s: dispatching %d staged rows to "
+                             "%d queries @ %d", self.stream_id, staged.n,
+                             len(self.queries), now)
         for q in self.queries:
             lk = _sub_lock(q)
             try:
@@ -1092,6 +1107,11 @@ class StreamJunction:
         stats = self.app.stats if self.app is not None else None
         if stats is not None and stats.enabled:
             stats.stream_in(self.stream_id, len(events))
+            if stats.detail:
+                # reference: log4j TRACE at StreamJunction.sendEvent :147
+                _trace_log.debug(
+                    "junction %s: dispatching %d events to %d queries @ %d",
+                    self.stream_id, len(events), len(self.queries), now)
         for cb in self.stream_callbacks:
             cb(events)
         if self.queries:

@@ -7,6 +7,7 @@ from .broker import InMemoryBroker
 from .mappers import SINK_MAPPERS, SOURCE_MAPPERS
 from .sink import SinkRuntime, register_sink_type
 from .source import SourceRuntime, register_source_type
+from . import tcp as _tcp  # registers the 'tcp' source/sink transport pair
 
 __all__ = [
     "InMemoryBroker",

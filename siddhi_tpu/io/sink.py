@@ -49,6 +49,16 @@ class LogSink(Sink):
         log.info("%s%s", prefix + (" : " if prefix else ""), payload)
 
 
+def _stable_hash(v) -> int:
+    """Deterministic partition hash: Python's hash() is salted per process
+    (PYTHONHASHSEED), which would route the same key to different
+    @destination endpoints across sender processes/restarts — fatal for
+    cross-host sharded pipelines (reference: PartitionedTransport routes on
+    a stable key hash)."""
+    import zlib
+    return zlib.crc32(repr(v).encode())
+
+
 SINK_TYPES: Dict[str, type] = {"inMemory": InMemorySink, "log": LogSink}
 
 
@@ -143,4 +153,4 @@ class SinkRuntime:
         else:  # partitioned
             for e, p in zip(events, payloads):
                 v = e.data[self.partition_positions]
-                self.sinks[hash(v) % len(self.sinks)].publish(p)
+                self.sinks[_stable_hash(v) % len(self.sinks)].publish(p)

@@ -1,0 +1,59 @@
+"""DETAIL-level event tracing (reference: SURVEY §5.1 — log4j TRACE at
+StreamJunction.sendEvent :147 and QuerySelector.process :77, enabled by
+@app:statistics)."""
+import logging
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def _run(manager, level, caplog):
+    ql = f"""
+    @app:statistics('{level}')
+    define stream S (v int);
+    @info(name='q') from S select v insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(i or []))
+    rt.start()
+    with caplog.at_level(logging.DEBUG, logger="siddhi_tpu.trace"):
+        rt.get_input_handler("S").send([[1], [2]])
+        rt.flush()
+    assert len(got) == 2
+    return [r.message for r in caplog.records
+            if r.name == "siddhi_tpu.trace"]
+
+
+def test_detail_level_traces(manager, caplog):
+    msgs = _run(manager, "DETAIL", caplog)
+    assert any("junction S" in m for m in msgs), msgs
+    assert any("query q: emitting" in m for m in msgs), msgs
+
+
+def test_basic_level_is_silent(manager, caplog):
+    assert _run(manager, "BASIC", caplog) == []
+
+
+def test_detail_latency_metrics(manager):
+    ql = """
+    @app:statistics('DETAIL')
+    define stream S (v int);
+    @info(name='q') from S select v insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    rt.get_input_handler("S").send([[1]])
+    rt.flush()
+    rep = rt.statistics()
+    assert rep["streams"]["S"]["events"] == 1
+    assert "q" in rep["queries"]
+    assert rep["queries"]["q"]["events"] == 1
