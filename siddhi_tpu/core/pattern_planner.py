@@ -143,6 +143,9 @@ class PlannedPatternQuery:
     # overriding positional key extraction (reference:
     # RangePartitionExecutor.java:45)
     partition_key_fns: Optional[Dict[str, Callable]] = None
+    # the SelectorExec whose per-key accumulator slabs ride sel_state —
+    # purge resets them through bank.specs (init values / slot spaces)
+    selector_exec: Any = None
 
 
 def plan_pattern_query(
@@ -322,7 +325,8 @@ def plan_pattern_query(
         key_capacity=key_capacity, slots=slots,
         partition_positions=partition_positions,
         partition_key_fns=partition_key_fns,
-        raw_steps=raw_steps, mesh=mesh, emit_explicit=emit_explicit)
+        raw_steps=raw_steps, mesh=mesh, emit_explicit=emit_explicit,
+        selector_exec=sel)
 
 
 def _first_schema(spec: PatternSpec, schemas) -> ev.Schema:

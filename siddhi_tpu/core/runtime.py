@@ -1272,12 +1272,44 @@ class _PartitionPurger:
                     self._reset_selector_slots(qr, qidle)
         self.app._scheduler.notify_at(now + self.interval_ms, self)
 
+    @staticmethod
+    def _key_mask(idx: np.ndarray, capacity: int):
+        mask = np.zeros(capacity, bool)
+        mask[idx] = True
+        return jax.numpy.asarray(mask)
+
+    @staticmethod
+    def _masked_fill(arr, mask, init, key_axis: int = 0):
+        """Reset `arr` rows where mask is True along key_axis.  Elementwise
+        `where` instead of `.at[idx].set`: scatters into MESH-SHARDED state
+        slabs silently drop updates on remote shards outside jit, a where
+        keeps every shard's rows local."""
+        shape = [1] * arr.ndim
+        shape[key_axis] = mask.shape[0]
+        m = mask.reshape(shape)
+        return jax.numpy.where(m, jax.numpy.asarray(init, arr.dtype), arr)
+
     def _reset_pattern_keys(self, qr, idx: np.ndarray) -> None:
         (b32, b64, scalars), sel_state = qr.state
         init32, init64 = self._init_cols[id(qr)]
-        jidx = jax.numpy.asarray(idx)
-        b32 = b32.at[:, jidx].set(init32)
-        b64 = b64.at[:, jidx].set(init64)
+        mesh = getattr(qr.planned, "mesh", None)
+        if mesh is not None:
+            # the sharded path routes allocator slot s to state column
+            # (s % n) * (K/n) + s // n (keys round-robin over devices,
+            # _process_sharded) — the reset must hit the same columns
+            n = mesh.devices.size
+            idx = (idx % n) * (qr.planned.key_capacity // n) + idx // n
+        mask = self._key_mask(idx, b32.shape[1])
+        b32 = self._masked_fill(b32, mask, init32, key_axis=1)
+        b64 = self._masked_fill(b64, mask, init64, key_axis=1)
+        # selector accumulators (per-key sums etc.) key on the same shared
+        # slots — same [K] axis, same mask: a recycled slot must NOT leak
+        # the purged key's aggregates into whatever key comes next
+        specs = qr.planned.selector_exec.bank.specs
+        sel_state = tuple(
+            a if s.slot_src is not None
+            else self._masked_fill(a, mask, s.init)
+            for a, s in zip(sel_state, specs))
         qr.state = ((b32, b64, scalars), sel_state)
         if qr._dirty is not None:
             qr._dirty[idx] = True
@@ -1285,21 +1317,22 @@ class _PartitionPurger:
     def _reset_selector_slots(self, qr, idx: np.ndarray) -> None:
         wstate, astate = qr.state
         specs = qr.planned.selector_exec.bank.specs
-        jidx = jax.numpy.asarray(idx)
         # pair-indexed specs (distinctCount refcounts) live in a different
         # slot space; queries carrying them are excluded from purge at
         # registration, this guard is defense in depth
-        astate = tuple(a if s.slot_src is not None
-                       else a.at[jidx].set(s.init)
-                       for a, s in zip(astate, specs))
+        astate = tuple(
+            a if s.slot_src is not None
+            else self._masked_fill(a, self._key_mask(idx, a.shape[0]),
+                                   s.init)
+            for a, s in zip(astate, specs))
         qr.state = (wstate, astate)
 
     def _reset_keyed_window(self, qr, idx: np.ndarray) -> None:
         wslab, astate = qr.state
         single = qr.planned.window.init_state()
-        jidx = jax.numpy.asarray(idx)
+        mask = self._key_mask(idx, qr.planned.key_capacity)
         wslab = jax.tree.map(
-            lambda s, i0: s.at[jidx].set(jax.numpy.asarray(i0)),
+            lambda s, i0: self._masked_fill(s, mask, i0),
             wslab, single)
         qr.state = (wslab, astate)
 
