@@ -21,13 +21,13 @@ BATCH = 1 << 17           # 131072 keys per micro-batch (524288 events/send)
 SLOTS = 4
 SWEEPS = 4                # timed sweeps over all keys x 4 stages
 
-QL = f"""
+QL_TEMPLATE = """
 @app:playback
-@async
+{async_ann}
 define stream TradeStream (key long, price float, volume int);
 partition with (key of TradeStream)
 begin
-  @capacity(keys='{N_KEYS}', slots='{SLOTS}')
+  @capacity(keys='{n_keys}', slots='{slots}')
   @emit(rows='2')
   @info(name='flagship')
   from every e1=TradeStream[volume == 1]
@@ -40,11 +40,20 @@ end;
 """
 
 
-def run_tpu():
+def run_tpu(async_ingest: bool = False):
+    """One flagship measurement.  Both ingestion modes are legitimate
+    configurations (@async = the reference's Disruptor opt-in); on a
+    single-core driver host the sync path usually wins because the worker
+    thread contends with the producer, so main() measures both and
+    reports the best.  The second runtime reuses the in-process jit cache
+    (the device program is identical — @async only changes host threading).
+    """
     from siddhi_tpu import SiddhiManager
 
     manager = SiddhiManager()
-    rt = manager.create_siddhi_app_runtime(QL)
+    rt = manager.create_siddhi_app_runtime(QL_TEMPLATE.format(
+        async_ann="@async" if async_ingest else "",
+        n_keys=N_KEYS, slots=SLOTS))
     matches = [0]
     # n_current is the device-computed count of valid CURRENT rows riding
     # the emission header (payload columns stay on device unless read)
@@ -86,7 +95,8 @@ def run_tpu():
     dt = time.perf_counter() - t0
     eps = total / dt
     lat_ms = np.array(sorted(lat)) * 1000
-    print(f"tpu: {total} events in {dt:.2f}s -> {eps:,.0f} ev/s; "
+    mode = "async" if async_ingest else "sync"
+    print(f"tpu[{mode}]: {total} events in {dt:.2f}s -> {eps:,.0f} ev/s; "
           f"matches={matches[0]}; batch p50={lat_ms[len(lat)//2]:.2f}ms "
           f"p99={lat_ms[int(len(lat)*0.99)]:.2f}ms", file=sys.stderr)
     expected = SWEEPS * blocks * BATCH  # one match per key per sweep
@@ -94,7 +104,8 @@ def run_tpu():
         print(f"WARNING: match count {matches[0]-warm_matches} != "
               f"{expected}", file=sys.stderr)
     manager.shutdown()
-    return eps
+    return eps, {"p50_ms": round(float(lat_ms[len(lat) // 2]), 2),
+                 "p99_ms": round(float(lat_ms[int(len(lat) * 0.99)]), 2)}
 
 
 def run_python_baseline(n_events=400_000):
@@ -276,8 +287,18 @@ def config_sequence_within(n_batches=32, B=1 << 11):
 
 def main():
     baseline = run_python_baseline()
-    eps = run_tpu()
-    configs = {}
+    eps_sync, lat_sync = run_tpu(async_ingest=False)
+    eps_async, lat_async = run_tpu(async_ingest=True)
+    if eps_sync >= eps_async:
+        eps, lat, mode = eps_sync, lat_sync, "sync"
+    else:
+        eps, lat, mode = eps_async, lat_async, "async"
+    configs = {
+        "flagship_sync": {"value": round(eps_sync), "unit": "events/sec",
+                          **lat_sync},
+        "flagship_async": {"value": round(eps_async), "unit": "events/sec",
+                           **lat_async},
+    }
     for key, fn in (("lengthBatch_avg", config_length_batch),
                     ("time_groupby_having", config_time_groupby_having),
                     ("windowed_join", config_windowed_join),
@@ -296,6 +317,9 @@ def main():
         "value": round(eps),
         "unit": "events/sec",
         "vs_baseline": round(eps / baseline, 2),
+        "ingest_mode": mode,
+        "p50_ms": lat["p50_ms"],
+        "p99_ms": lat["p99_ms"],
         "configs": configs,
         "baseline_note": (
             "vs_baseline compares against a measured CPython per-event NFA "

@@ -151,13 +151,14 @@ class WindowProcessor:
 
 
 def _param_int(params, i, default=None):
+    from ..exceptions import CompileError
     if i >= len(params):
         if default is not None:
             return default
-        raise ValueError("missing window parameter")
+        raise CompileError("missing window parameter")
     p = params[i]
     if not isinstance(p, Constant):
-        raise ValueError("window parameters must be constants")
+        raise CompileError("window parameters must be constants")
     return int(p.value)
 
 
@@ -713,7 +714,8 @@ _window_expr.register(WINDOW_TYPES)
 def create_window(name: str, schema: ev.Schema, params, batch_capacity: int,
                   capacity_hint: int = 2048) -> WindowProcessor:
     if name not in WINDOW_TYPES:
-        raise ValueError(f"unknown window type {name!r}; "
-                         f"available: {sorted(WINDOW_TYPES)}")
+        from ..exceptions import CompileError
+        raise CompileError(f"unknown window type {name!r}; "
+                           f"available: {sorted(WINDOW_TYPES)}")
     return WINDOW_TYPES[name](schema, params, batch_capacity,
                               capacity_hint=capacity_hint)

@@ -35,15 +35,50 @@ class SiddhiApp:
     def siddhi_app(name: Optional[str] = None) -> "SiddhiApp":
         return SiddhiApp(name)
 
+    def _check_duplicate(self, kind: str, d) -> None:
+        """One id names ONE definition: redefinition with a different
+        schema, a different kind (stream vs table vs window), or — for
+        windows — a different window function is an error; an identical
+        re-definition is a no-op (reference: DuplicateDefinitionException,
+        AbstractDefinition.equalsIgnoreAnnotations)."""
+        from ..exceptions import DuplicateDefinitionError
+        for other_kind, dmap in (("stream", self.stream_definition_map),
+                                 ("table", self.table_definition_map),
+                                 ("window", self.window_definition_map)):
+            existing = dmap.get(d.id)
+            if existing is None:
+                continue
+            if other_kind != kind:
+                raise DuplicateDefinitionError(
+                    f"{d.id!r} is already defined as a {other_kind}")
+            if existing.attribute_list != d.attribute_list:
+                raise DuplicateDefinitionError(
+                    f"{d.id!r} is already defined with a different schema")
+            if kind == "window" and self._window_spec(existing) != \
+                    self._window_spec(d):
+                raise DuplicateDefinitionError(
+                    f"window {d.id!r} is already defined with a different "
+                    f"window function")
+
+    @staticmethod
+    def _window_spec(wd):
+        w = wd.window
+        return (None if w is None else (w.namespace, w.name,
+                                        [repr(p) for p in w.parameters]),
+                wd.output_event_type)
+
     def define_stream(self, d: StreamDefinition) -> "SiddhiApp":
+        self._check_duplicate("stream", d)
         self.stream_definition_map[d.id] = d
         return self
 
     def define_table(self, d: TableDefinition) -> "SiddhiApp":
+        self._check_duplicate("table", d)
         self.table_definition_map[d.id] = d
         return self
 
     def define_window(self, d: WindowDefinition) -> "SiddhiApp":
+        self._check_duplicate("window", d)
         self.window_definition_map[d.id] = d
         return self
 

@@ -1,0 +1,199 @@
+"""App validation + lifecycle corpus (reference roles:
+TEST/managment/ValidateTestCase, StartStopTestCase, SandboxTestCase;
+typed exceptions per CORE/exception/*)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.exceptions import (CompileError, DefinitionNotExistError,
+                                   QueryNotExistError, SiddhiError)
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+# ---- validation corpus: bad app -> typed compile-time error ---------------
+
+BAD_APPS = [
+    # (name, ql, message fragment)
+    ("undefined-stream",
+     "@info(name='q') from Nope select a insert into Out;", "Nope"),
+    ("unknown-attribute",
+     "define stream S (a int);\n"
+     "@info(name='q') from S select b insert into Out;", "b"),
+    ("bad-filter-type",
+     "define stream S (a int);\n"
+     "@info(name='q') from S[a + 1] select a insert into Out;", "boolean"),
+    ("unknown-function",
+     "define stream S (a int);\n"
+     "@info(name='q') from S select nosuchfn(a) as x insert into Out;",
+     "nosuchfn"),
+    ("unknown-window",
+     "define stream S (a int);\n"
+     "@info(name='q') from S#window.nosuch(1) select a insert into Out;",
+     "nosuch"),
+    ("two-windows",
+     "define stream S (a int);\n"
+     "@info(name='q') from S#window.length(2)#window.length(3) "
+     "select a insert into Out;", "one window"),
+    ("aggregator-in-filter",
+     "define stream S (a int);\n"
+     "@info(name='q') from S[sum(a) > 2] select a insert into Out;",
+     "aggregator"),
+    ("table-join-table",
+     "define table T1 (a int); define table T2 (a int);\n"
+     "define stream S (a int);\n"
+     "@info(name='q') from T1 join T2 on T1.a == T2.a "
+     "select T1.a as a insert into Out;", "table"),
+    ("syntax-error",
+     "define stream S (a int;\n", ""),
+    ("insert-arity",
+     "define stream S (a int, b int);\n"
+     "define table T (x int);\n"
+     "@info(name='w') from S insert into T;", "arity"),
+]
+
+
+@pytest.mark.parametrize("name,ql,frag",
+                         BAD_APPS, ids=[b[0] for b in BAD_APPS])
+def test_invalid_app_raises_compile_error(manager, name, ql, frag):
+    with pytest.raises(SiddhiError) as ei:
+        manager.create_siddhi_app_runtime(ql)
+    assert isinstance(ei.value, CompileError), type(ei.value)
+    if frag:
+        assert frag.lower() in str(ei.value).lower(), str(ei.value)
+
+
+def test_get_unknown_input_handler(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (a int);
+    @info(name='q') from S select a insert into Out;
+    """)
+    rt.start()
+    with pytest.raises((DefinitionNotExistError, KeyError)):
+        rt.get_input_handler("Missing")
+
+
+def test_unknown_callback_query(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (a int);
+    @info(name='q') from S select a insert into Out;
+    """)
+    with pytest.raises((QueryNotExistError, KeyError)):
+        rt.add_callback("nope", lambda *a: None)
+
+
+# ---- lifecycle (StartStopTestCase role) -----------------------------------
+
+def test_send_before_start_and_restart(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (a int);
+    @info(name='q') from S select a insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        e.data[0] for e in (i or [])))
+    rt.start()
+    rt.get_input_handler("S").send([1])
+    rt.flush()
+    assert got == [1]
+    rt.shutdown()
+    # a fresh runtime from the same manager works after shutdown
+    rt2 = manager.create_siddhi_app_runtime("""
+    define stream S (a int);
+    @info(name='q') from S select a insert into Out;
+    """)
+    got2 = []
+    rt2.add_callback("q", lambda ts, i, o: got2.extend(
+        e.data[0] for e in (i or [])))
+    rt2.start()
+    rt2.get_input_handler("S").send([5])
+    rt2.flush()
+    assert got2 == [5]
+
+
+def test_double_start_is_idempotent(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (a int);
+    @info(name='q') from S select a insert into Out;
+    """)
+    rt.start()
+    rt.start()     # second start must not wedge or duplicate anything
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        e.data[0] for e in (i or [])))
+    rt.get_input_handler("S").send([3])
+    rt.flush()
+    assert got == [3]
+
+
+def test_manager_shutdown_stops_all_apps(manager):
+    names = []
+    for i in range(3):
+        rt = manager.create_siddhi_app_runtime(f"""
+        @app:name('app{i}')
+        define stream S (a int);
+        @info(name='q') from S select a insert into Out;
+        """)
+        rt.start()
+        names.append(rt.name)
+    assert sorted(manager.runtimes) == sorted(names)
+    manager.shutdown()
+    assert all(not getattr(manager.runtimes.get(n), "_started", False)
+               for n in names) or not manager.runtimes
+
+
+def test_duplicate_stream_definition(manager):
+    with pytest.raises(SiddhiError):
+        manager.create_siddhi_app_runtime("""
+        define stream S (a int);
+        define stream S (a string);
+        @info(name='q') from S select a insert into Out;
+        """)
+
+
+def test_cross_kind_id_collision(manager):
+    from siddhi_tpu.exceptions import DuplicateDefinitionError
+    with pytest.raises(DuplicateDefinitionError):
+        manager.create_siddhi_app_runtime("""
+        define stream Foo (a int);
+        define table Foo (a int, b string);
+        @info(name='q') from Foo select a insert into Out;
+        """)
+
+
+def test_identical_redefinition_is_noop(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (a int);
+    define stream S (a int);
+    @info(name='q') from S select a insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        e.data[0] for e in (i or [])))
+    rt.start()
+    rt.get_input_handler("S").send([4])
+    rt.flush()
+    assert got == [4]
+
+
+def test_window_redefinition_different_function(manager):
+    from siddhi_tpu.exceptions import DuplicateDefinitionError
+    with pytest.raises(DuplicateDefinitionError):
+        manager.create_siddhi_app_runtime("""
+        define window W (a int) length(5);
+        define window W (a int) time(1 sec);
+        define stream S (a int);
+        @info(name='w') from S insert into W;
+        """)
+
+
+def test_window_missing_param_is_compile_error(manager):
+    with pytest.raises(CompileError):
+        manager.create_siddhi_app_runtime("""
+        define stream S (a int);
+        @info(name='q') from S#window.length() select a insert into Out;
+        """)
