@@ -1,0 +1,112 @@
+"""Window corpus additions: cron window, output-event-type selection,
+window + group-by + having composition (reference shape:
+TEST/query/window/CronWindowTestCase, output event type cases)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def test_cron_window_flushes_on_schedule(manager):
+    """Every-second cron: events batch up and flush when the playback clock
+    crosses a cron boundary."""
+    ql = """
+    @app:playback
+    define stream S (v int);
+    @info(name='q') from S#window.cron('* * * * * ?')
+    select sum(v) as sv insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        e.data[0] for e in (i or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([[1]], timestamp=100)
+    h.send([[2]], timestamp=300)
+    assert got == []                  # nothing flushed inside the second
+    h.send([[10]], timestamp=1_200)   # clock crossed the :01 cron boundary
+    rt.flush()
+    # the flushed batch emits per-row running sums: 1, then 1+2
+    assert got == [1, 3]
+    h.send([[5]], timestamp=2_500)    # next boundary flushes [10]
+    rt.flush()
+    assert got == [1, 3, 10]
+
+
+def test_output_expired_events_only(manager):
+    """`insert expired events into Out`: the Out STREAM receives only the
+    expired rows; the query callback still sees current (in) and expired
+    (out) separately, as the reference's QueryCallback does."""
+    ql = """
+    @app:playback
+    define stream S (v int);
+    define stream Sink (v int);
+    @info(name='q') from S#window.length(1)
+    select v insert expired events into Out;
+    @info(name='fwd') from Out select v insert into Sink;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    routed = []
+    rt.add_callback("Out", lambda events: routed.extend(
+        e.data[0] for e in (events or [])))
+    cb_in, cb_out = [], []
+    rt.add_callback("q", lambda ts, i, o: (
+        cb_in.extend(e.data[0] for e in (i or [])),
+        cb_out.extend(e.data[0] for e in (o or []))))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([[1]], timestamp=1000)
+    h.send([[2]], timestamp=1001)   # expires 1
+    h.send([[3]], timestamp=1002)   # expires 2
+    rt.flush()
+    assert routed == [1, 2]         # only expired rows flow downstream
+    assert cb_in == [1, 2, 3]
+    assert cb_out == [1, 2]
+
+
+def test_window_groupby_having_composition(manager):
+    ql = """
+    @app:playback
+    define stream S (sym string, price float);
+    @info(name='q') from S#window.lengthBatch(4)
+    select sym, sum(price) as sp
+    group by sym having sp > 5.0
+    insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        tuple(e.data) for e in (i or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([["a", 1.0], ["b", 4.0], ["a", 2.0], ["b", 4.0]], timestamp=1000)
+    rt.flush()
+    # batch of 4: a=3.0 (filtered by having), b=8.0 (passes)
+    assert got == [("b", 8.0)]
+
+
+def test_delay_window_holds_events(manager):
+    ql = """
+    @app:playback
+    define stream S (v int);
+    @info(name='q') from S#window.delay(1 sec)
+    select v insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        e.data[0] for e in (i or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([[1]], timestamp=1_000)
+    assert got == []                    # held for 1 sec
+    h.send([[2]], timestamp=2_500)      # releases the delayed event
+    rt.flush()
+    assert 1 in got
