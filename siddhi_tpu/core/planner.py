@@ -70,6 +70,10 @@ class PlannedQuery:
     # (group, value) pairs resolve to refcount slots on the host
     pair_allocs: List[Tuple[SlotAllocator, int]] = \
         dataclasses.field(default_factory=list)
+    # set when the windowless group-by step is sharded over a device mesh
+    # (slot s lives at state row (s % n) * (G/n) + s // n — purge resets
+    # must remap through this layout, _PartitionPurger)
+    mesh: Any = None
 
 
 def _env_for(scope_key: str, cols, ts):
@@ -93,6 +97,83 @@ def _apply_chain(chain, env, sid, cols, keep, data_row):
     return env, cols, keep
 
 
+def _shard_plain_step(step, mesh, sel, wproc, group_slots: int):
+    """Shard a windowless partitioned group-by step over the mesh.
+
+    Design (same scaling-book recipe as the pattern path): group slots are
+    the shard axis — each device owns a G/n block of every accumulator
+    slab.  Event rows replicate to all devices; each device masks `valid`
+    to the rows whose slot falls in its block and runs the unmodified
+    single-device body over local slot ids.  Groups are independent, so
+    the data path needs no communication; output rows (each owned by
+    exactly one device) merge with psum, the wake scalar with pmin.
+    This scales group capacity and segment-op work G/n per chip — the
+    reference's thread-per-Disruptor scale-up becomes SPMD scale-out
+    (CORE/stream/StreamJunction.java:296)."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.devices.size
+    blk = group_slots // n
+
+    ex_w = wproc.init_state()
+    ex_s = sel.init_state()
+    wspec = jax.tree.map(lambda x: P(), ex_w)     # NoWindow state: scalars
+    sspec = jax.tree.map(lambda x: P("shard"), ex_s)
+    rspec = P()                                   # event rows: replicated
+
+    def merge_rows(ovalid, col):
+        z = jnp.where(ovalid, col, jnp.zeros_like(col))
+        if col.dtype == jnp.bool_:
+            return lax.psum(z.astype(jnp.int32), "shard") > 0
+        return lax.psum(z, "shard")
+
+    def local(state, ts, kind, valid, cols, gslot, now, in_tabs, pslots):
+        dev = lax.axis_index("shard")
+        ts = lax.pcast(ts, ("shard",), to="varying")
+        kind = lax.pcast(kind, ("shard",), to="varying")
+        valid = lax.pcast(valid, ("shard",), to="varying")
+        cols = tuple(lax.pcast(c, ("shard",), to="varying") for c in cols)
+        gslot = lax.pcast(gslot, ("shard",), to="varying")
+        in_tabs = jax.tree.map(
+            lambda x: lax.pcast(x, ("shard",), to="varying"), in_tabs)
+        wstate, astate = state
+        old_w = wstate
+        wstate = jax.tree.map(
+            lambda x: lax.pcast(x, ("shard",), to="varying"), wstate)
+        # round-robin ownership (slot % n): sequential slot allocation
+        # would park every early group on device 0 under a block split —
+        # same layout as the pattern path, device column = (s%n)*blk + s//n
+        owned = (gslot % n) == dev
+        local_slot = jnp.where(owned, gslot // n, 0)
+        lvalid = jnp.logical_and(valid, owned)
+        (wstate, astate), (ots, okind, ovalid, ocols), wake = step(
+            (wstate, astate), ts, kind, lvalid, cols, local_slot, now,
+            in_tabs, pslots)
+        # outputs stay ROW-ALIGNED to the input batch (NoWindow.compact is
+        # off on this path), so each row is valid on exactly its owner
+        # device and a psum merge preserves single-device delivery order
+        ots = merge_rows(ovalid, ots)
+        okind = merge_rows(ovalid, okind)
+        ocols = tuple(merge_rows(ovalid, c) for c in ocols)
+        ovalid = lax.psum(ovalid.astype(jnp.int32), "shard") > 0
+        wake = lax.pmin(wake, "shard")
+        # NoWindow's state is the additive seq counter: re-replicate as
+        # old + sum of per-device deltas (pattern-path recipe)
+        wstate = jax.tree.map(
+            lambda old, new: old + lax.psum(
+                new - lax.pcast(old, ("shard",), to="varying"), "shard"),
+            old_w, wstate)
+        return (wstate, astate), (ots, okind, ovalid, ocols), wake
+
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=((wspec, sspec), rspec, rspec, rspec, rspec, rspec, P(),
+                  rspec, rspec),
+        out_specs=((wspec, sspec), (P(), P(), P(), P()), P()))
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
 def plan_single_query(
     query: Query,
     name: str,
@@ -109,6 +190,7 @@ def plan_single_query(
     named_window_input: bool = False,
     config_manager=None,
     script_functions=None,
+    mesh=None,
 ) -> PlannedQuery:
     ist = query.input_stream
     assert isinstance(ist, SingleInputStream)
@@ -289,6 +371,7 @@ def plan_single_query(
         return ((wstate, astate), (ots, okind, ovalid, ocols),
                 wout.next_wakeup)
 
+    plain_mesh = None
     if keyed_window:
         # ---- keyed window: one window state per partition key ------------
         # The window processor is a pure (state, rows, now) -> (state', out)
@@ -358,7 +441,22 @@ def plan_single_query(
                     (K,) + jnp.asarray(x).shape)), single)
             return (slab, sel.init_state())
     else:
-        jit_step = jax.jit(step, donate_argnums=(0,))
+        shardable = (
+            mesh is not None and allocator is not None
+            and isinstance(wproc, NoWindow) and not pair_allocs
+            and not sel._order_by and query.selector.limit is None
+            and query.selector.offset is None
+            and allocator.capacity % mesh.devices.size == 0)
+        if shardable:
+            # keep outputs row-aligned so the sharded psum merge preserves
+            # single-device delivery order
+            wproc.compact = False
+            jit_step = _shard_plain_step(step, mesh, sel, wproc,
+                                         allocator.capacity)
+            plain_mesh = mesh
+        else:
+            jit_step = jax.jit(step, donate_argnums=(0,))
+            plain_mesh = None
 
         def init_state():
             return (wproc.init_state(), sel.init_state())
@@ -385,4 +483,5 @@ def plan_single_query(
         window_key_positions=list(partition_positions or []),
         key_capacity=key_capacity,
         pair_allocs=pair_allocs,
+        mesh=plain_mesh,
     )

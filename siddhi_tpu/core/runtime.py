@@ -1317,6 +1317,12 @@ class _PartitionPurger:
     def _reset_selector_slots(self, qr, idx: np.ndarray) -> None:
         wstate, astate = qr.state
         specs = qr.planned.selector_exec.bank.specs
+        mesh = getattr(qr.planned, "mesh", None)
+        if mesh is not None:
+            # sharded plain step stores slot s at row (s%n)*(G/n) + s//n
+            n = mesh.devices.size
+            G = qr.planned.slot_allocator.capacity
+            idx = (idx % n) * (G // n) + idx // n
         # pair-indexed specs (distinctCount refcounts) live in a different
         # slot space; queries carrying them are excluded from purge at
         # registration, this guard is defense in depth
@@ -2073,7 +2079,8 @@ class SiddhiAppRuntime:
                     window_key_allocator=shared_allocator,
                     key_capacity=keys_cap,
                     config_manager=self.config_manager,
-                    script_functions=self.app.function_definition_map)
+                    script_functions=self.app.function_definition_map,
+                    mesh=self.mesh)
                 runtime = QueryRuntime(planned, self)
                 self.query_runtimes[qname] = runtime
                 part_runtimes.append(runtime)

@@ -163,9 +163,16 @@ def _param_int(params, i, default=None):
 
 
 class NoWindow(WindowProcessor):
-    """Pass-through when the query has no window handler."""
+    """Pass-through when the query has no window handler.
+
+    `compact` (default True) moves valid rows to the front via sort_rows;
+    the mesh-sharded plain path disables it so output rows stay aligned to
+    input rows on every device and merge with a psum (planner
+    _shard_plain_step) — valid rows are already in input order either way.
+    """
 
     name = "(none)"
+    compact = True
 
     @property
     def out_capacity(self):
@@ -182,8 +189,8 @@ class NoWindow(WindowProcessor):
         seq = jnp.where(is_cur, seq0 + ord_, BIG_SEQ)
         out = Rows(rows.ts, rows.kind, is_cur, seq, rows.gslot, rows.cols)
         nseq = seq0 + jnp.sum(is_cur.astype(jnp.int64))
-        return nseq, WindowOutput(sort_rows(out), None,
-                                  jnp.asarray(NO_WAKEUP, jnp.int64))
+        return nseq, WindowOutput(sort_rows(out) if self.compact else out,
+                                  None, jnp.asarray(NO_WAKEUP, jnp.int64))
 
 
 class PassAllWindow(WindowProcessor):
