@@ -74,6 +74,9 @@ class PlannedQuery:
     # (slot s lives at state row (s % n) * (G/n) + s // n — purge resets
     # must remap through this layout, _PartitionPurger)
     mesh: Any = None
+    # set when the keyed-window slab is sharded (key k at row
+    # (k % n) * (K/n) + k // n; selector state stays replicated)
+    keyed_mesh: Any = None
 
 
 def _env_for(scope_key: str, cols, ts):
@@ -95,6 +98,16 @@ def _apply_chain(chain, env, sid, cols, keep, data_row):
                 jnp.asarray(c, d) for c, d in zip(new_cols, dtypes))
             env[sid] = cols
     return env, cols, keep
+
+
+def _merge_rows(ovalid, col):
+    """Merge row-aligned per-device outputs: each row is valid on exactly
+    one device, so zero-the-rest + psum reconstructs the global row."""
+    from jax import lax
+    z = jnp.where(ovalid, col, jnp.zeros_like(col))
+    if col.dtype == jnp.bool_:
+        return lax.psum(z.astype(jnp.int32), "shard") > 0
+    return lax.psum(z, "shard")
 
 
 def _shard_plain_step(step, mesh, sel, wproc, group_slots: int):
@@ -122,12 +135,6 @@ def _shard_plain_step(step, mesh, sel, wproc, group_slots: int):
     sspec = jax.tree.map(lambda x: P("shard"), ex_s)
     rspec = P()                                   # event rows: replicated
 
-    def merge_rows(ovalid, col):
-        z = jnp.where(ovalid, col, jnp.zeros_like(col))
-        if col.dtype == jnp.bool_:
-            return lax.psum(z.astype(jnp.int32), "shard") > 0
-        return lax.psum(z, "shard")
-
     def local(state, ts, kind, valid, cols, gslot, now, in_tabs, pslots):
         dev = lax.axis_index("shard")
         ts = lax.pcast(ts, ("shard",), to="varying")
@@ -153,9 +160,9 @@ def _shard_plain_step(step, mesh, sel, wproc, group_slots: int):
         # outputs stay ROW-ALIGNED to the input batch (NoWindow.compact is
         # off on this path), so each row is valid on exactly its owner
         # device and a psum merge preserves single-device delivery order
-        ots = merge_rows(ovalid, ots)
-        okind = merge_rows(ovalid, okind)
-        ocols = tuple(merge_rows(ovalid, c) for c in ocols)
+        ots = _merge_rows(ovalid, ots)
+        okind = _merge_rows(ovalid, okind)
+        ocols = tuple(_merge_rows(ovalid, c) for c in ocols)
         ovalid = lax.psum(ovalid.astype(jnp.int32), "shard") > 0
         wake = lax.pmin(wake, "shard")
         # NoWindow's state is the additive seq counter: re-replicate as
@@ -171,6 +178,75 @@ def _shard_plain_step(step, mesh, sel, wproc, group_slots: int):
         in_specs=((wspec, sspec), rspec, rspec, rspec, rspec, rspec, P(),
                   rspec, rspec),
         out_specs=((wspec, sspec), (P(), P(), P(), P()), P()))
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def _shard_keyed_step(kstep, mesh, K: int):
+    """Shard the keyed-window step over the mesh 'shard' axis.
+
+    Partition keys are the shard axis: each device owns the window-state
+    rows of keys with `key_idx % n == dev` (round-robin — sequential key
+    allocation would park early keys on device 0), stored at local row
+    key_idx // n. Event rows and the [Kb, E] per-key grouping replicate;
+    non-owned keys turn into pad rows (sentinel K) whose window writes
+    drop and whose output rows invalidate. Selector accumulators stay
+    REPLICATED (group slots interleave keys arbitrarily, so they cannot
+    share the key layout); each group slot is written by exactly one
+    device per batch, so states merge exactly with a changed-delta psum.
+    Outputs stay row-aligned — the psum merge preserves single-device
+    delivery order. Wake scalars ride pmin."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.devices.size
+
+    def dmerge(old, new):
+        """Exact merge when at most one device changed each element.
+        `old` must be the replicated (unvaried) input so old + psum(delta)
+        is statically replicated."""
+        is_bool = old.dtype == jnp.bool_
+        oi = old.astype(jnp.int32) if is_bool else old
+        ni = new.astype(jnp.int32) if is_bool else new
+        oi_v = lax.pcast(oi, ("shard",), to="varying")
+        changed = ni != oi_v
+        merged = oi + lax.psum(
+            jnp.where(changed, ni - oi_v, jnp.zeros_like(ni)), "shard")
+        return merged.astype(jnp.bool_) if is_bool else merged
+
+    def local(state, ts, kind, valid, cols, gslot, key_idx, sel_idx, now,
+              in_tabs):
+        dev = lax.axis_index("shard")
+        vary = lambda x: lax.pcast(x, ("shard",), to="varying")  # noqa: E731
+        ts, kind, valid, gslot = vary(ts), vary(kind), vary(valid), \
+            vary(gslot)
+        cols = tuple(vary(c) for c in cols)
+        key_idx, sel_idx = vary(key_idx), vary(sel_idx)
+        in_tabs = jax.tree.map(vary, in_tabs)
+        wslab, astate = state
+        old_a = astate
+        astate = jax.tree.map(vary, astate)
+        # host pad rows carry sentinel key_idx == K: they must stay pads on
+        # EVERY device (K % n would otherwise claim them as a real key)
+        owned = jnp.logical_and((key_idx % n) == dev, key_idx < K)
+        key_l = jnp.where(owned, key_idx // n, K)   # K == drop sentinel
+        (wslab, astate), (ots, okind, ovalid, ocols), wake = kstep(
+            (wslab, astate), ts, kind, valid, cols, gslot, key_l, sel_idx,
+            now, in_tabs)
+        ots = _merge_rows(ovalid, ots)
+        okind = _merge_rows(ovalid, okind)
+        ocols = tuple(_merge_rows(ovalid, c) for c in ocols)
+        ovalid = lax.psum(ovalid.astype(jnp.int32), "shard") > 0
+        wake = lax.pmin(wake, "shard")
+        astate = jax.tree.map(dmerge, old_a, astate)
+        return (wslab, astate), (ots, okind, ovalid, ocols), wake
+
+    wspec = P("shard")
+    rspec = P()
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=((wspec, rspec), rspec, rspec, rspec, rspec, rspec, rspec,
+                  rspec, P(), rspec),
+        out_specs=((wspec, rspec), (P(), P(), P(), P()), P()))
     return jax.jit(sharded, donate_argnums=(0,))
 
 
@@ -372,6 +448,7 @@ def plan_single_query(
                 wout.next_wakeup)
 
     plain_mesh = None
+    keyed_mesh = None
     if keyed_window:
         # ---- keyed window: one window state per partition key ------------
         # The window processor is a pure (state, rows, now) -> (state', out)
@@ -431,7 +508,22 @@ def plan_single_query(
             astate, outs = sel.process(astate, orows, env2)
             return ((wslab, astate), outs, jnp.min(wout.next_wakeup))
 
-        jit_step = jax.jit(kstep, donate_argnums=(0,))
+        kshardable = (
+            mesh is not None and mesh.devices.size > 1
+            and K % mesh.devices.size == 0 and not pair_allocs
+            and not sel._order_by and query.selector.limit is None
+            and query.selector.offset is None
+            and not getattr(wproc, "host_scheduled", False)
+            # RESET-emitting batch windows reset ALL selector slots on any
+            # device that sees the flush — multiple writers per slot break
+            # the replicated-state delta merge; they stay single-device
+            and not wproc.emits_reset)
+        if kshardable:
+            jit_step = _shard_keyed_step(kstep, mesh, K)
+            keyed_mesh = mesh
+        else:
+            jit_step = jax.jit(kstep, donate_argnums=(0,))
+            keyed_mesh = None
 
         def init_state():
             single = wproc.init_state()
@@ -484,4 +576,5 @@ def plan_single_query(
         key_capacity=key_capacity,
         pair_allocs=pair_allocs,
         mesh=plain_mesh,
+        keyed_mesh=keyed_mesh,
     )

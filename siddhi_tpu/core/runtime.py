@@ -1273,6 +1273,12 @@ class _PartitionPurger:
         self.app._scheduler.notify_at(now + self.interval_ms, self)
 
     @staticmethod
+    def _shard_remap(idx: np.ndarray, n: int, capacity: int) -> np.ndarray:
+        """Round-robin shard layout: slot/key s lives at state row
+        (s % n) * (capacity/n) + s // n on the sharded slab."""
+        return (idx % n) * (capacity // n) + idx // n
+
+    @staticmethod
     def _key_mask(idx: np.ndarray, capacity: int):
         mask = np.zeros(capacity, bool)
         mask[idx] = True
@@ -1297,8 +1303,8 @@ class _PartitionPurger:
             # the sharded path routes allocator slot s to state column
             # (s % n) * (K/n) + s // n (keys round-robin over devices,
             # _process_sharded) — the reset must hit the same columns
-            n = mesh.devices.size
-            idx = (idx % n) * (qr.planned.key_capacity // n) + idx // n
+            idx = self._shard_remap(idx, mesh.devices.size,
+                                    qr.planned.key_capacity)
         mask = self._key_mask(idx, b32.shape[1])
         b32 = self._masked_fill(b32, mask, init32, key_axis=1)
         b64 = self._masked_fill(b64, mask, init64, key_axis=1)
@@ -1320,9 +1326,8 @@ class _PartitionPurger:
         mesh = getattr(qr.planned, "mesh", None)
         if mesh is not None:
             # sharded plain step stores slot s at row (s%n)*(G/n) + s//n
-            n = mesh.devices.size
-            G = qr.planned.slot_allocator.capacity
-            idx = (idx % n) * (G // n) + idx // n
+            idx = self._shard_remap(idx, mesh.devices.size,
+                                    qr.planned.slot_allocator.capacity)
         # pair-indexed specs (distinctCount refcounts) live in a different
         # slot space; queries carrying them are excluded from purge at
         # registration, this guard is defense in depth
@@ -1336,6 +1341,11 @@ class _PartitionPurger:
     def _reset_keyed_window(self, qr, idx: np.ndarray) -> None:
         wslab, astate = qr.state
         single = qr.planned.window.init_state()
+        kmesh = getattr(qr.planned, "keyed_mesh", None)
+        if kmesh is not None:
+            # sharded slab stores key k at row (k%n)*(K/n) + k//n
+            idx = self._shard_remap(idx, kmesh.devices.size,
+                                    qr.planned.key_capacity)
         mask = self._key_mask(idx, qr.planned.key_capacity)
         wslab = jax.tree.map(
             lambda s, i0: self._masked_fill(s, mask, i0),

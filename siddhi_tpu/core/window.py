@@ -123,6 +123,10 @@ class WindowProcessor:
 
     name = "?"
     needs_timer = False
+    # True for batch windows that emit RESET rows (epoch flushes) — the
+    # sharded keyed path excludes them: a RESET resets ALL selector slots
+    # on whichever device sees it, violating the single-writer merge
+    emits_reset = False
 
     def __init__(self, schema: ev.Schema, params: List[Constant],
                  batch_capacity: int, capacity_hint: int = 1024):
@@ -414,6 +418,7 @@ class TimeWindow(WindowProcessor):
 
 
 class LengthBatchWindow(WindowProcessor):
+    emits_reset = True
     """Tumbling length batch (reference: LengthBatchWindowProcessor).
 
     Arrivals accumulate silently; when `n` have gathered the whole batch is
@@ -567,6 +572,7 @@ class LengthBatchWindow(WindowProcessor):
 
 
 class TimeBatchWindow(WindowProcessor):
+    emits_reset = True
     """Tumbling time batch (reference: TimeBatchWindowProcessor).
 
     Time is divided into [start + k*t, start + (k+1)*t) slices; at each slice

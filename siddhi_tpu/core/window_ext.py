@@ -145,6 +145,7 @@ class ExternalTimeWindow(WindowProcessor):
 
 
 class ExternalTimeBatchWindow(WindowProcessor):
+    emits_reset = True
     """Tumbling window over an event-time attribute (reference:
     ExternalTimeBatchWindowProcessor.java): slices [start+k*t, start+(k+1)*t)
     of the timestamp attribute; a slice flushes when an arrival's event time
@@ -404,6 +405,7 @@ class DelayWindow(WindowProcessor):
 
 
 class ChunkBatchWindow(WindowProcessor):
+    emits_reset = True
     """`batch()` (reference: BatchWindowProcessor.java): each processed
     micro-batch is the window; the previous batch is replayed as EXPIRED
     ahead of the new CURRENT chunk."""
@@ -541,6 +543,7 @@ class SortWindow(WindowProcessor):
 
 
 class CronWindow(WindowProcessor):
+    emits_reset = True
     """Cron batch window (reference: CronWindowProcessor.java): accumulates
     events and flushes the batch at cron-scheduled times.  The cron schedule
     cannot be evaluated inside the compiled step, so the host scheduler

@@ -91,6 +91,84 @@ def test_sharded_partition_purge(mesh):
         {k: sums[k] for k in range(3)})
 
 
+def test_sharded_keyed_timebatch_timer_flush(mesh):
+    """timeBatch inside a partition on the mesh: the TIMER-driven all-keys
+    flush advances every device's key rows and agrees with single-device."""
+    ql = """
+    @app:playback
+    define stream S (key long, v int);
+    partition with (key of S)
+    begin
+      @capacity(keys='32')
+      @info(name='q')
+      from S#window.timeBatch(1 sec)
+      select key, sum(v) as total
+      insert into Out;
+    end;
+    """
+    def run(mesh_arg):
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(ql, mesh=mesh_arg)
+        got = []
+        rt.add_callback("q", lambda ts, i, o: got.extend(
+            tuple(e.data) for e in (i or [])))
+        rt.start()
+        h = rt.get_input_handler("S")
+        h.send([[k, k + 1] for k in range(12)], timestamp=1_000)
+        h.send([[k, 10] for k in range(12)], timestamp=1_500)
+        h.send([[0, 1]], timestamp=2_600)   # crossing flushes the batch
+        # post-flush epoch: state after a RESET must not diverge (the
+        # RESET/global-slot-reset interaction is why batch windows stay
+        # single-device under a mesh)
+        h.send([[k, 2] for k in range(12)], timestamp=2_700)
+        h.send([[5, 3]], timestamp=4_000)   # second flush
+        m.shutdown()
+        return sorted(got)
+
+    sharded = run(mesh)
+    assert sharded == run(None)
+    sums = {}
+    for k, t in sharded:
+        sums.setdefault(k, []).append(t)
+    assert 14 in sums[3]          # 4 + 10 in the first flushed batch
+
+
+def test_sharded_keyed_window_purge_remap(mesh):
+    """@purge + per-key windows on the mesh: resets must hit the
+    round-robin-permuted slab rows."""
+    ql = """
+    @app:playback
+    define stream S (key long, price float);
+    partition with (key of S)
+    begin
+      @capacity(keys='16')
+      @purge(enable='true', interval='1 sec', idle.period='1 sec')
+      @info(name='q')
+      from S#window.length(2)
+      select key, sum(price) as sp
+      insert into Out;
+    end;
+    """
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(ql, mesh=mesh)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        tuple(e.data) for e in (i or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([[k, 10.0] for k in range(12)], timestamp=1_000)
+    h.send([[k, 20.0] for k in range(12)], timestamp=1_100)
+    h.send([[99, 1.0]], timestamp=30_000)     # purge sweep
+    h.send([[k, 5.0] for k in range(12)], timestamp=31_000)
+    m.shutdown()
+    sums = {}
+    for k, sp in got:
+        sums.setdefault(k, []).append(sp)
+    # window contents cleared: the post-purge sum is 5.0, not 20+5 rolling
+    assert all(sums[k][-1] == 5.0 for k in range(12)), (
+        {k: sums[k] for k in range(3)})
+
+
 PLAIN_APP = """
 @app:playback
 define stream S3 (key long, v int);
