@@ -249,18 +249,30 @@ class _DurationStore:
     per-duration BaseIncrementalValueStore maps + backing table)."""
 
     def __init__(self, agg_name: str, dur: str, identities: np.ndarray,
-                 capacity: int):
+                 capacity: int, mesh=None):
         from .keyslots import SlotAllocator
         self.dur = dur
         self.capacity = capacity
         self.alloc = SlotAllocator(capacity, f"{agg_name}:{dur}")
         self.identities = identities                    # [n_base] f64
-        self.slab = jnp.asarray(
-            np.tile(identities[:, None], (1, capacity)))
+        self.mesh = mesh
+        self.slab = self.place(jnp.asarray(
+            np.tile(identities[:, None], (1, capacity))))
         # slots written since the last table flush (@store write-through)
         self.dirty = np.zeros(capacity, np.bool_)
         # slots written since the last (incremental) snapshot baseline
         self.snap_dirty = np.zeros(capacity, np.bool_)
+
+    def place(self, slab):
+        """Bucket axis shards over the mesh (GSPMD: the jitted scatter-
+        merge auto-partitions; replicated indices route to shard owners).
+        Scale-out story for aggregation state — reference's equivalent is
+        the shardId multi-JVM store split (AggregationParser :173-197)."""
+        if self.mesh is None:
+            return slab
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(slab, NamedSharding(self.mesh,
+                                                  P(None, "shard")))
 
     def decode_keys(self) -> Tuple[np.ndarray, np.ndarray]:
         """(slots [n], key_words [n, ng+1] int64) for live slots."""
@@ -273,10 +285,35 @@ class _DurationStore:
         return slots, words.reshape(n, -1)
 
     def reset_slots(self, slots: np.ndarray) -> None:
-        if len(slots):
+        if not len(slots):
+            return
+        if self.mesh is None:
             self.slab = self.slab.at[:, jnp.asarray(slots)].set(
                 jnp.asarray(self.identities)[:, None])
-            self.dirty[slots] = False
+        else:
+            # host-context scatters into a sharded slab drop remote-shard
+            # updates: go through the shared masked-where helper
+            from .shardsafe import key_mask, masked_fill
+            self.slab = masked_fill(
+                self.slab, key_mask(slots, self.capacity),
+                jnp.asarray(self.identities)[:, None], key_axis=1)
+        self.dirty[slots] = False
+
+    def scatter_rows(self, slots: np.ndarray, rows_t: np.ndarray) -> None:
+        """Write rows_t [n_base, n] into slab columns `slots` (restore
+        paths)."""
+        if not len(slots):
+            return
+        if self.mesh is None:   # sparse fast path (no dense temp)
+            self.slab = self.slab.at[:, jnp.asarray(slots)].set(
+                jnp.asarray(rows_t))
+            return
+        from .shardsafe import key_mask, masked_fill
+        upd = np.zeros((rows_t.shape[0], self.capacity), np.float64)
+        upd[:, slots] = rows_t
+        self.slab = masked_fill(
+            self.slab, key_mask(slots, self.capacity), jnp.asarray(upd),
+            key_axis=1)
 
 
 class _Output:
@@ -358,9 +395,14 @@ class AggregationRuntime:
             hasattr(adef, "get_annotation") else None
         self.bucket_capacity = int(cap_ann.element("buckets")) \
             if cap_ann is not None and cap_ann.element("buckets") else 1 << 16
+        agg_mesh = getattr(app, "mesh", None)
+        if agg_mesh is not None and (
+                agg_mesh.devices.size < 2 or
+                self.bucket_capacity % agg_mesh.devices.size != 0):
+            agg_mesh = None
         self._dstores: Dict[str, _DurationStore] = {
             d: _DurationStore(adef.id, d, self._identities,
-                              self.bucket_capacity)
+                              self.bucket_capacity, mesh=agg_mesh)
             for d in self.durations}
 
         # retention per duration: defaults from the reference, overridable
@@ -667,9 +709,9 @@ class AggregationRuntime:
             for dur in self.durations:
                 ds = self._dstores[dur]
                 ds.alloc.restore({})
-                ds.slab = jnp.asarray(
+                ds.slab = ds.place(jnp.asarray(
                     np.tile(self._identities[:, None],
-                            (1, self.bucket_capacity)))
+                            (1, self.bucket_capacity))))
                 ds.dirty[:] = False
                 mapping = value.get(dur) or {}
                 if not mapping:
@@ -680,8 +722,7 @@ class AggregationRuntime:
                 cols = [np.ascontiguousarray(keys[:, i])
                         for i in range(keys.shape[1])]
                 slots = ds.alloc.slots_for(cols)
-                ds.slab = ds.slab.at[:, jnp.asarray(slots)].set(
-                    jnp.asarray(rows.T))
+                ds.scatter_rows(slots, rows.T)
 
     def snapshot_delta(self) -> Dict[str, Dict[tuple, np.ndarray]]:
         """Buckets written since the last snapshot baseline (per duration),
@@ -722,8 +763,7 @@ class AggregationRuntime:
                 cols = [np.ascontiguousarray(keys[:, i])
                         for i in range(keys.shape[1])]
                 slots = ds.alloc.slots_for(cols)
-                ds.slab = ds.slab.at[:, jnp.asarray(slots)].set(
-                    jnp.asarray(rows.T))
+                ds.scatter_rows(slots, rows.T)
 
     def clear_snapshot_baseline(self) -> None:
         with self._lock:
@@ -840,6 +880,5 @@ class AggregationRuntime:
                 cols = [np.ascontiguousarray(keys[:, i])
                         for i in range(keys.shape[1])]
                 slots = ds.alloc.slots_for(cols)
-                ds.slab = ds.slab.at[:, jnp.asarray(slots)].set(
-                    jnp.asarray(base.T))
+                ds.scatter_rows(slots, base.T)
                 ds.dirty[slots] = True

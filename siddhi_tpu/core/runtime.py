@@ -1280,20 +1280,13 @@ class _PartitionPurger:
 
     @staticmethod
     def _key_mask(idx: np.ndarray, capacity: int):
-        mask = np.zeros(capacity, bool)
-        mask[idx] = True
-        return jax.numpy.asarray(mask)
+        from .shardsafe import key_mask
+        return key_mask(idx, capacity)
 
     @staticmethod
     def _masked_fill(arr, mask, init, key_axis: int = 0):
-        """Reset `arr` rows where mask is True along key_axis.  Elementwise
-        `where` instead of `.at[idx].set`: scatters into MESH-SHARDED state
-        slabs silently drop updates on remote shards outside jit, a where
-        keeps every shard's rows local."""
-        shape = [1] * arr.ndim
-        shape[key_axis] = mask.shape[0]
-        m = mask.reshape(shape)
-        return jax.numpy.where(m, jax.numpy.asarray(init, arr.dtype), arr)
+        from .shardsafe import masked_fill
+        return masked_fill(arr, mask, init, key_axis)
 
     def _reset_pattern_keys(self, qr, idx: np.ndarray) -> None:
         (b32, b64, scalars), sel_state = qr.state

@@ -1,0 +1,31 @@
+"""Shard-safe state mutation helpers.
+
+Host-context `.at[idx].set(...)` scatters into a MESH-SHARDED jax array
+silently drop the updates that land on remote shards (observed on the
+virtual CPU mesh; the op runs per-shard without the cross-device routing
+jit/GSPMD would insert).  Every host-side reset/restore of potentially
+sharded state must go through an elementwise masked `where` instead —
+these helpers are the single home for that idiom (used by the partition
+purger in core/runtime.py and the aggregation duration slabs in
+core/aggregation.py).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def key_mask(idx: np.ndarray, capacity: int):
+    """Device bool mask of `capacity` with True at `idx`."""
+    mask = np.zeros(capacity, bool)
+    mask[idx] = True
+    return jax.numpy.asarray(mask)
+
+
+def masked_fill(arr, mask, init, key_axis: int = 0):
+    """Reset `arr` rows where mask is True along key_axis with `init`
+    (scalar or an array broadcastable over the masked rows)."""
+    shape = [1] * arr.ndim
+    shape[key_axis] = mask.shape[0]
+    m = mask.reshape(shape)
+    return jax.numpy.where(m, jax.numpy.asarray(init, arr.dtype), arr)
