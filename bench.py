@@ -306,18 +306,26 @@ def _enable_compile_cache():
 def main():
     _enable_compile_cache()
     baseline = run_python_baseline()
-    eps_sync, lat_sync = run_tpu(async_ingest=False)
-    eps_async, lat_async = run_tpu(async_ingest=True)
-    if eps_sync >= eps_async:
-        eps, lat, mode = eps_sync, lat_sync, "sync"
-    else:
-        eps, lat, mode = eps_async, lat_async, "async"
-    configs = {
-        "flagship_sync": {"value": round(eps_sync), "unit": "events/sec",
-                          **lat_sync},
-        "flagship_async": {"value": round(eps_async), "unit": "events/sec",
-                           **lat_async},
-    }
+    # one failing mode must not kill the benchmark (the other mode's
+    # number still stands); both failing is a real rc!=0
+    results = {}
+    errors = {}
+    for mode_name, flag in (("sync", False), ("async", True)):
+        try:
+            results[mode_name] = run_tpu(async_ingest=flag)
+        except Exception as exc:  # noqa: BLE001 — isolate mode failures
+            errors[mode_name] = repr(exc)[:300]
+            print(f"flagship[{mode_name}] FAILED: {exc!r}", file=sys.stderr)
+    if not results:
+        raise RuntimeError(f"both flagship modes failed: {errors}")
+    mode = max(results, key=lambda m: results[m][0])
+    eps, lat = results[mode]
+    configs = {}
+    for m, (v, l) in results.items():
+        configs[f"flagship_{m}"] = {"value": round(v),
+                                    "unit": "events/sec", **l}
+    for m, e in errors.items():
+        configs[f"flagship_{m}"] = {"error": e}
     for key, fn in (("lengthBatch_avg", config_length_batch),
                     ("time_groupby_having", config_time_groupby_having),
                     ("windowed_join", config_windowed_join),
