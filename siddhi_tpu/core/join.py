@@ -43,6 +43,9 @@ class JoinSide:
     window: Optional[WindowProcessor]   # None => table / named window side
     is_table: bool = False
     is_aggregation: bool = False
+    # `define window` shared instance probed like a table: the join reads
+    # its live buffer per step (reference: WindowWindowProcessor adapter)
+    is_named_window: bool = False
     pre_filters: List[CompiledExpr] = dataclasses.field(default_factory=list)
 
 
@@ -75,7 +78,7 @@ class PlannedJoinQuery:
 
 def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
              scope: Scope, window_capacity_hint: int,
-             aggregations=None) -> JoinSide:
+             aggregations=None, named_windows=None) -> JoinSide:
     sid = sis.stream_id
     key = sis.stream_reference_id or sid
     if aggregations and sid in aggregations:
@@ -85,6 +88,16 @@ def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
         scope.add_source(key, schema, alias=None)
         return JoinSide(sid, key, schema, None, is_table=True,
                         is_aggregation=True)
+    if named_windows and sid in named_windows:
+        nw = named_windows[sid]
+        if nw.wproc.current_buffer(nw.state) is None:
+            raise CompileError(
+                f"named window {sid!r} ({nw.wproc.name}) does not expose a "
+                f"probe-able buffer for joins")
+        schema = nw.schema
+        scope.add_source(key, schema, alias=None)
+        return JoinSide(sid, key, schema, None, is_table=True,
+                        is_named_window=True)
     is_table = sid in tables
     schema = tables[sid].schema if is_table else schemas[sid]
     scope.add_source(key, schema, alias=None)
@@ -117,16 +130,22 @@ def plan_join_query(
     batch_capacity: int = 512,
     window_capacity_hint: int = 512,
     aggregations=None,
+    named_windows=None,
 ) -> PlannedJoinQuery:
     jis = query.input_stream
     assert isinstance(jis, JoinInputStream)
     scope = Scope()
     scope.interner = interner
     left = _mk_side(jis.left_input_stream, schemas, tables, batch_capacity,
-                    scope, window_capacity_hint, aggregations)
+                    scope, window_capacity_hint, aggregations, named_windows)
     right = _mk_side(jis.right_input_stream, schemas, tables, batch_capacity,
-                     scope, window_capacity_hint, aggregations)
+                     scope, window_capacity_hint, aggregations,
+                     named_windows)
     if left.is_table and right.is_table:
+        if left.is_named_window or right.is_named_window:
+            raise CompileError(
+                "a named-window join side is probe-only here: join it "
+                "against a stream side that triggers the query")
         raise CompileError("cannot join two tables in a streaming query")
     if not left.is_table and not right.is_table and (
             isinstance(left.window, NoWindow) or

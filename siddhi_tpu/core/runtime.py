@@ -808,6 +808,12 @@ class JoinQueryRuntime:
         if other.is_aggregation:
             agg = self.app.aggregations[other.stream_id]
             return _aggregation_view(agg, p.per_duration, p.within_range)
+        if getattr(other, "is_named_window", False):
+            # probe the shared window's live buffer (reference:
+            # WindowWindowProcessor.find against Window.java's chain)
+            nw = self.app.named_windows[other.stream_id]
+            buf = nw.wproc.current_buffer(nw.state)
+            return (buf.cols, buf.ts, buf.alive)
         if other.is_table:
             t = self.app.tables[other.stream_id]
             return (t.cols, t.ts, t.valid)
@@ -929,7 +935,11 @@ class NamedWindowRuntime:
             o = wout.rows
             return state, (o.ts, o.kind, o.valid, o.cols), wout.next_wakeup
 
-        self._step = jax.jit(step, donate_argnums=(0,))
+        # NOT donated: join queries probe this window's live buffer
+        # (_other_table) without holding _qlock through their own step —
+        # donation would let a concurrent ingest delete the buffers a
+        # join just captured
+        self._step = jax.jit(step)
         self.state = jax.tree.map(
             lambda x: jax.numpy.array(x, copy=True), wproc.init_state())
 
@@ -1869,7 +1879,8 @@ class SiddhiAppRuntime:
         from .join import plan_join_query
         planned = plan_join_query(q, name, self.schemas, self.tables,
                                   self.interner,
-                                  aggregations=self.aggregations)
+                                  aggregations=self.aggregations,
+                                  named_windows=self.named_windows)
         runtime = JoinQueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
         self.query_runtimes[name] = runtime
