@@ -1,0 +1,145 @@
+"""Expression corpus additions: precedence, promotions, edge values, math
+namespace breadth, isNull, default() (reference shape: FilterTestCase
+operator/type-pair matrix)."""
+import math
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+TOL = dict(rel=1e-5, abs=1e-5)
+
+
+def _run(ql_body, events, qname="q"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(ql_body)
+    got = []
+    rt.add_callback(qname, lambda ts, i, o: got.extend(
+        [list(e.data) for e in (i or [])]))
+    rt.start()
+    first_sid = ql_body.split("define stream ")[1].split(" ")[0]
+    h = rt.get_input_handler(first_sid)
+    for e in events:
+        h.send(list(e))
+    rt.flush()
+    m.shutdown()
+    return got
+
+
+STREAM = "define stream S (s string, i int, l long, f float, d double, b bool);\n"
+ROWS = [
+    ["x", 3, 10_000_000_000, 1.5, 2.25, True],
+    ["y", -7, -2, 0.0, -0.5, False],
+]
+
+
+def _project(expr, events=ROWS):
+    return _run(STREAM + f"@info(name='q') from S select {expr} as v "
+                "insert into Out;", events)
+
+
+def test_precedence_mul_before_add():
+    got = _project("i + i * 2")
+    assert [g[0] for g in got] == [9, -21]
+
+
+def test_parenthesized_precedence():
+    got = _project("(i + i) * 2")
+    assert [g[0] for g in got] == [12, -28]
+
+
+def test_int_long_promotion():
+    got = _project("i + l")
+    assert [g[0] for g in got] == [10_000_000_003, -9]
+
+
+def test_int_float_promotion():
+    got = _project("i * f")
+    assert got[0][0] == pytest.approx(4.5, **TOL)
+
+
+def test_mod_negative_operand():
+    got = _project("i % 4")
+    # jnp/python semantics: remainder takes the divisor's sign
+    assert got[0][0] == 3
+
+
+def test_division_returns_float_semantics():
+    got = _project("i / 2")
+    assert got[0][0] == pytest.approx(1.5, **TOL) or got[0][0] == 1
+
+
+def test_bool_column_filter():
+    got = _run(STREAM + "@info(name='q') from S[b] select s insert into O;",
+               ROWS)
+    assert [g[0] for g in got] == ["x"]
+
+
+def test_not_bool_column():
+    got = _run(STREAM + "@info(name='q') from S[not b] select s "
+               "insert into O;", ROWS)
+    assert [g[0] for g in got] == ["y"]
+
+
+def test_string_compare_interned():
+    got = _run(STREAM + "@info(name='q') from S[s == 'y'] select i "
+               "insert into O;", ROWS)
+    assert [g[0] for g in got] == [-7]
+
+
+@pytest.mark.parametrize("fn,pyfn", [
+    ("math:exp", math.exp), ("math:ln", math.log),
+    ("math:log10", math.log10), ("math:sin", math.sin),
+    ("math:cos", math.cos), ("math:tan", math.tan),
+])
+def test_math_namespace(fn, pyfn):
+    got = _project(f"{fn}(d)", [["x", 1, 1, 1.0, 2.25, True]])
+    assert got[0][0] == pytest.approx(pyfn(2.25), **TOL)
+
+
+def test_math_power():
+    got = _project("math:power(d, 2.0)", [["x", 1, 1, 1.0, 3.0, True]])
+    assert got[0][0] == pytest.approx(9.0, **TOL)
+
+
+def test_default_on_null_string():
+    got = _run(
+        "define stream S (s string, i int);\n"
+        "@info(name='q') from S select default(s, 'dflt') as v "
+        "insert into O;",
+        [[None, 1], ["real", 2]])
+    assert [g[0] for g in got] == ["dflt", "real"]
+
+
+def test_is_null_string_filter():
+    got = _run(
+        "define stream S (s string, i int);\n"
+        "@info(name='q') from S[s is null] select i insert into O;",
+        [[None, 1], ["real", 2]])
+    assert [g[0] for g in got] == [1]
+
+
+def test_large_long_arithmetic_exact():
+    big = 4_611_686_018_427_387_000   # near 2^62: must stay int64-exact
+    got = _run(
+        "define stream S (l long);\n"
+        "@info(name='q') from S select l + 1 as v insert into O;",
+        [[big]])
+    assert got[0][0] == big + 1
+
+
+def test_chained_comparisons_with_and_or_not():
+    got = _run(STREAM +
+               "@info(name='q') from S[(i > 0 and f > 1.0) or "
+               "(not b and d < 0.0)] select s insert into O;", ROWS)
+    assert [g[0] for g in got] == ["x", "y"]
+
+
+def test_current_time_millis_monotone():
+    got = _run(
+        "define stream S (i int);\n"
+        "@info(name='q') from S select currentTimeMillis() as t "
+        "insert into O;",
+        [[1], [2]])
+    assert got[0][0] > 1_500_000_000_000   # a real epoch-ms clock
+    assert got[1][0] >= got[0][0]
