@@ -131,7 +131,9 @@ class TableRuntime:
     @staticmethod
     def _write_impl(cols, ts, valid, new_cols, new_ts, slots, row_valid):
         tgt = jnp.where(row_valid, slots, jnp.iinfo(jnp.int32).max)
-        cols = tuple(c.at[tgt].set(nc, mode="drop")
+        # incoming batches may carry wider dtypes than the table column
+        # (on-demand #sel stages ints as LONG): cast at the boundary
+        cols = tuple(c.at[tgt].set(jnp.asarray(nc, c.dtype), mode="drop")
                      for c, nc in zip(cols, new_cols))
         ts = ts.at[tgt].set(new_ts, mode="drop")
         valid = valid.at[tgt].set(True, mode="drop")

@@ -843,8 +843,111 @@ def _as_i64_key(col):
     return col.astype(jnp.int64)
 
 
+class HoppingWindow(WindowProcessor):
+    """Hopping (sliding-batch) time window (reference:
+    HopingWindowProcessor — `#window.hoping(window.time, hop.time)`): every
+    hop.time the events of the trailing window.time emit as one batch, so
+    consecutive batches overlap when hop < window.
+
+    TPU design: one retained buffer of the trailing window.time + hop.time;
+    each hop boundary emits CURRENT = rows inside [emit-win, emit) and
+    EXPIRED = the previous boundary's rows, with a RESET row between epochs
+    (standard batch-window aggregation semantics).  If several hop
+    boundaries pass inside one quiet gap, intermediate empty emissions
+    collapse to the latest boundary — same collapsing rule as timeBatch."""
+
+    name = "hopping"
+    needs_timer = True
+    emits_reset = True
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
+        super().__init__(schema, params, batch_capacity)
+        self.win_ms = _param_int(params, 0)
+        self.hop_ms = _param_int(params, 1, default=self.win_ms)
+        self.capacity = max(capacity_hint, 2 * batch_capacity)
+
+    @property
+    def out_capacity(self):
+        return 2 * (self.capacity + self.batch_capacity) + 1
+
+    def init_state(self):
+        return (
+            empty_buffer(self.schema, self.capacity),   # retained rows
+            jnp.asarray(-1, jnp.int64),                 # next emit boundary
+            jnp.asarray(0, jnp.int64),                  # seq counter
+        )
+
+    def process(self, state, rows: Rows, now):
+        buf, next0, seq0 = state
+        win, hop = self.win_ms, self.hop_ms
+        C, B = self.capacity, rows.capacity
+
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+        any_cur = jnp.any(is_cur)
+        first_ts = jnp.min(jnp.where(is_cur, rows.ts, BIG_SEQ))
+        nxt = jnp.where(next0 >= 0, next0,
+                        jnp.where(any_cur, first_ts + hop, -1))
+        flush = jnp.logical_and(nxt >= 0, now >= nxt)
+        emit_ts = jnp.where(flush, nxt + ((now - nxt) // hop) * hop, nxt)
+
+        cand_ts = jnp.concatenate([buf.ts, rows.ts])
+        cand_live = jnp.concatenate([buf.alive, is_cur])
+        cand_gslot = jnp.concatenate([buf.gslot, rows.gslot])
+        cand_cols = tuple(jnp.concatenate([bc, rc])
+                          for bc, rc in zip(buf.cols, rows.cols))
+        CB = C + B
+
+        in_cur = jnp.logical_and(
+            cand_live, jnp.logical_and(cand_ts >= emit_ts - win,
+                                       cand_ts < emit_ts))
+        prev_ts = emit_ts - hop
+        in_prev = jnp.logical_and(
+            cand_live, jnp.logical_and(cand_ts >= prev_ts - win,
+                                       cand_ts < prev_ts))
+        # seq layout: expired prev batch [0..CB), reset CB, current [CB+1..)
+        exp_rows = Rows(
+            ts=cand_ts, kind=jnp.full((CB,), ev.EXPIRED, jnp.int32),
+            valid=jnp.logical_and(in_prev, flush),
+            seq=seq0 + jnp.cumsum(in_prev.astype(jnp.int64)) - 1,
+            gslot=cand_gslot, cols=cand_cols)
+        reset_rows = Rows(
+            ts=jnp.reshape(now, (1,)) * jnp.ones((1,), jnp.int64),
+            kind=jnp.full((1,), ev.RESET, jnp.int32),
+            valid=jnp.reshape(flush, (1,)),
+            seq=jnp.full((1,), seq0 + CB, jnp.int64),
+            gslot=jnp.full((1,), -1, jnp.int32),
+            cols=tuple(jnp.full((1,), ev.default_value(t_), d)
+                       for t_, d in zip(self.schema.types,
+                                        self.schema.dtypes)))
+        cur_rows = Rows(
+            ts=cand_ts, kind=jnp.full((CB,), ev.CURRENT, jnp.int32),
+            valid=jnp.logical_and(in_cur, flush),
+            seq=seq0 + CB + 1 + jnp.cumsum(in_cur.astype(jnp.int64)) - 1,
+            gslot=cand_gslot, cols=cand_cols)
+        out = sort_rows(concat_rows(concat_rows(exp_rows, cur_rows),
+                                    reset_rows))
+
+        # retention: the next flush at new_next expires window
+        # [new_next - hop - win, new_next - hop), so rows must survive one
+        # hop PAST their own window or EXPIRED batches lose their old rows
+        new_next = jnp.where(flush, emit_ts + hop, nxt)
+        keep = jnp.logical_and(
+            cand_live,
+            jnp.where(new_next >= 0,
+                      cand_ts >= new_next - win - hop, True))
+        rank = jnp.cumsum(keep.astype(jnp.int64)) - 1
+        big = jnp.full((CB,), BIG_SEQ, jnp.int64)
+        nbuf = _scatter_buffer(self.schema, C, keep, rank, cand_ts,
+                               big, big, cand_gslot, cand_cols)
+        nseq = jnp.where(flush, seq0 + 2 * CB + 2, seq0)
+        wake = jnp.where(new_next >= 0, new_next, NO_WAKEUP)
+        return ((nbuf, new_next, nseq), WindowOutput(out, None, wake))
+
+
 def register(window_types: dict) -> None:
     for cls in (ExternalTimeWindow, ExternalTimeBatchWindow, TimeLengthWindow,
                 DelayWindow, ChunkBatchWindow, SortWindow, CronWindow,
-                SessionWindow, FrequentWindow, LossyFrequentWindow):
+                SessionWindow, FrequentWindow, LossyFrequentWindow,
+                HoppingWindow):
         window_types[cls.name] = cls
+    window_types["hoping"] = HoppingWindow   # the reference's spelling

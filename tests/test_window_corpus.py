@@ -186,18 +186,72 @@ def test_batch_window_golden():
     assert cur == [("a", 1.0), ("b", 2.0), ("c", 3.0), ("d", 4.0)]
 
 
-@pytest.mark.xfail(reason="hopping window not implemented yet",
-                   raises=Exception, strict=True)
 def test_hopping_window_golden():
+    """Overlapping 2s windows hopping every 1s: each flush emits the
+    trailing 2s of events, so consecutive batches overlap."""
     m = SiddhiManager()
-    try:
-        m.create_siddhi_app_runtime("""
-        define stream S (sym string);
-        @info(name='q') from S#window.hopping(2 sec, 1 sec)
-        select sym insert into Out;
-        """)
-    finally:
-        m.shutdown()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string, v int);
+    @info(name='q') from S#window.hopping(2 sec, 1 sec)
+    select sym, sum(v) as sv insert into Out;
+    """)
+    batches = []
+    rt.add_callback("q", lambda ts, i, o: batches.append(
+        [tuple(e.data) for e in (i or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([["a", 1]], timestamp=1_000)
+    h.send([["b", 2]], timestamp=1_500)
+    h.send([["c", 4]], timestamp=2_200)   # crosses the 2_000 boundary
+    rt.flush()
+    # first hop at 2_000: window [0, 2000) = a, b with running sums
+    flat1 = [x for b in batches for x in b]
+    assert ("a", 1) in flat1 and ("b", 3) in flat1
+    assert not any(s == "c" for s, _ in flat1)
+    batches.clear()
+    h.send([["d", 8]], timestamp=3_100)   # crosses 3_000
+    rt.flush()
+    # second hop at 3_000: trailing 2s window [1000, 3000) = a, b, c
+    flat2 = [x for b in batches for x in b]
+    assert ("c", 7) in flat2              # overlap: a+b re-emitted with c
+    assert ("a", 1) in flat2 and ("b", 3) in flat2
+    batches.clear()
+    h.send([["e", 16]], timestamp=4_100)  # crosses 4_000
+    rt.flush()
+    # third hop at 4_000: window [2000, 4000) = c, d only (a, b aged out)
+    flat3 = [x for b in batches for x in b]
+    assert ("c", 4) in flat3 and ("d", 12) in flat3
+    assert not any(s in ("a", "b") for s, _ in flat3)
+    m.shutdown()
+
+
+def test_hopping_window_expired_batch():
+    """The EXPIRED emission at each hop is the FULL previous window —
+    including rows older than one hop (retention regression guard)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string, v int);
+    @info(name='q') from S#window.hopping(2 sec, 1 sec)
+    select sym insert expired events into Out;
+    """)
+    expired = []
+    rt.add_callback("q", lambda ts, i, o: expired.extend(
+        e.data[0] for e in (o or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([["a", 1]], timestamp=1_000)
+    h.send([["b", 2]], timestamp=1_500)
+    h.send([["c", 4]], timestamp=2_200)
+    h.send([["d", 8]], timestamp=3_100)   # flush@3000: current {a,b,c}
+    h.send([["e", 16]], timestamp=4_100)  # flush@4000: EXPIRES {a,b,c}
+    rt.flush()
+    # every CURRENT emission gets a matching EXPIRED one hop later: a and
+    # b appeared in TWO overlapping windows ([0,2000) and [1000,3000)),
+    # so they expire twice; c (one window so far) expires once
+    assert expired == ["a", "b", "a", "b", "c"]
+    m.shutdown()
 
 
 WINDOW_SMOKE = [
