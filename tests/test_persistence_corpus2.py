@@ -1,0 +1,127 @@
+"""Persistence corpus round 2: whole-app snapshot equivalence for a
+combined app (window + table + pattern + named window together), restore
+idempotence, and revision selection (reference shape:
+TEST/managment/PersistenceTestCase multi-element cases)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.utils.persistence import InMemoryPersistenceStore
+
+APP = """
+@app:playback
+define stream S (k long, sym string, v double);
+define stream Probe (k long);
+@PrimaryKey('sym')
+define table T (sym string, total double);
+define window W (k long, v double) length(3);
+
+@info(name='wins') from S select k, v insert into W;
+@info(name='agg') from W select k, sum(v) as sv group by k insert into WOut;
+@info(name='tab') from S select sym, sum(v) as total group by sym
+  update or insert into T set T.total = total on T.sym == sym;
+partition with (k of S)
+begin
+  @capacity(keys='32', slots='4')
+  @info(name='pat')
+  from every e1=S[v > 0.0] -> e2=S[v > e1.v]
+  select e1.k as k, e1.v as v1, e2.v as v2 insert into POut;
+end;
+"""
+
+
+def _drive(rt, rows):
+    h = rt.get_input_handler("S")
+    for i, (k, sym, v) in enumerate(rows):
+        h.send([[k, sym, float(v)]], timestamp=1000 + i)
+    rt.flush()
+
+
+def _observe(rt, more_rows):
+    got = {"agg": [], "pat": []}
+    rt.add_callback("agg", lambda ts, i, o: got["agg"].extend(
+        tuple(e.data) for e in (i or [])))
+    rt.add_callback("pat", lambda ts, i, o: got["pat"].extend(
+        tuple(e.data) for e in (i or [])))
+    _drive(rt, more_rows)
+    table = sorted(tuple(e.data) for e in
+                   rt.query("from T select sym, total"))
+    return got, table
+
+
+PREFIX = [(1, "a", 1.0), (2, "b", 2.0), (1, "a", 0.5)]
+SUFFIX = [(1, "a", 3.0), (2, "b", 1.0)]
+
+
+def _fresh(store):
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(APP)
+    rt.start()
+    return m, rt
+
+
+def test_combined_app_restore_equals_uninterrupted():
+    """snapshot -> restore -> suffix must equal prefix+suffix in one run,
+    across windows, group-by, tables, and pattern state at once."""
+    store = InMemoryPersistenceStore()
+    # uninterrupted reference run
+    m0, rt0 = _fresh(InMemoryPersistenceStore())
+    _drive(rt0, PREFIX)
+    expected, exp_table = _observe(rt0, SUFFIX)
+    m0.shutdown()
+
+    # interrupted run
+    m1, rt1 = _fresh(store)
+    _drive(rt1, PREFIX)
+    m1.persist()
+    m1.wait_for_persistence()
+    m1.shutdown()
+
+    m2, rt2 = _fresh(store)
+    m2.restore_last_revision()
+    got, table = _observe(rt2, SUFFIX)
+    m2.shutdown()
+
+    assert got["agg"] == expected["agg"]
+    assert got["pat"] == expected["pat"]
+    assert table == exp_table
+
+
+def test_restore_is_idempotent():
+    store = InMemoryPersistenceStore()
+    m1, rt1 = _fresh(store)
+    _drive(rt1, PREFIX)
+    m1.persist()
+    m1.wait_for_persistence()
+    m1.shutdown()
+
+    m2, rt2 = _fresh(store)
+    m2.restore_last_revision()
+    m2.restore_last_revision()          # double restore: same state
+    got, table = _observe(rt2, SUFFIX)
+    m2.shutdown()
+
+    m3, rt3 = _fresh(store)
+    m3.restore_last_revision()
+    got2, table2 = _observe(rt3, SUFFIX)
+    m3.shutdown()
+    assert got == got2 and table == table2
+
+
+def test_multiple_revisions_latest_wins():
+    store = InMemoryPersistenceStore()
+    m1, rt1 = _fresh(store)
+    _drive(rt1, PREFIX[:1])
+    m1.persist()
+    _drive(rt1, PREFIX[1:])
+    m1.persist()                        # later revision
+    m1.wait_for_persistence()
+    m1.shutdown()
+
+    m2, rt2 = _fresh(store)
+    m2.restore_last_revision()
+    table = sorted(tuple(e.data) for e in
+                   rt2.query("from T select sym, total"))
+    # latest revision saw all PREFIX rows: a=1.5, b=2.0
+    assert table == [("a", 1.5), ("b", 2.0)]
+    m2.shutdown()
