@@ -1,0 +1,102 @@
+"""Rate-limiter corpus round 2: first/last per-time, snapshot ungrouped,
+interaction with windows and filters (reference shape:
+TEST/query/ratelimit time-based cases)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def _run(manager, ql, sends, qname="q"):
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(qname, lambda ts, i, o: got.extend(
+        tuple(e.data) for e in (i or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row, ts in sends:
+        h.send([list(row)], timestamp=ts)
+    rt.flush()
+    return got
+
+
+def test_output_first_every_time(manager):
+    ql = """
+    @app:playback
+    define stream S (sym string, v int);
+    @info(name='q') from S select sym, v
+    output first every 1 sec insert into Out;
+    """
+    got = _run(manager, ql, [
+        (["a", 1], 1_000), (["b", 2], 1_200), (["c", 3], 1_800),
+        (["d", 4], 2_100),     # new window: emits immediately
+        (["e", 5], 2_500),
+    ])
+    assert got == [("a", 1), ("d", 4)]
+
+
+def test_output_last_every_time(manager):
+    ql = """
+    @app:playback
+    define stream S (sym string, v int);
+    @info(name='q') from S select sym, v
+    output last every 1 sec insert into Out;
+    """
+    got = _run(manager, ql, [
+        (["a", 1], 1_000), (["b", 2], 1_200),
+        (["c", 3], 2_100),     # tick at 2_000 flushed b
+        (["d", 4], 3_100),     # tick at 3_000 flushed c
+    ])
+    assert ("b", 2) in got and ("c", 3) in got
+    assert ("a", 1) not in got
+
+
+def test_snapshot_ungrouped(manager):
+    ql = """
+    @app:playback
+    define stream S (sym string, v int);
+    @info(name='q') from S select sym, v
+    output snapshot every 1 sec insert into Out;
+    """
+    got = _run(manager, ql, [
+        (["a", 1], 1_000), (["b", 2], 1_400),
+        (["c", 3], 2_100),     # tick: snapshot = latest row (b)
+        (["d", 4], 3_200),     # tick: snapshot = c
+    ])
+    assert ("b", 2) in got and ("c", 3) in got
+    assert ("a", 1) not in got
+
+
+def test_ratelimit_after_filter_and_window(manager):
+    """Rate limiting applies to QUERY OUTPUT: rows dropped by the filter
+    or aggregated by the window never count toward the N."""
+    ql = """
+    @app:playback
+    define stream S (sym string, v int);
+    @info(name='q') from S[v > 0]#window.lengthBatch(2)
+    select sym, sum(v) as sv
+    output all every 2 events insert into Out;
+    """
+    got = _run(manager, ql, [
+        (["a", 1], 1_000), (["x", -5], 1_100),   # filtered out
+        (["b", 2], 1_200),                        # batch 1 flushes (a,b)
+        (["c", 3], 1_300), (["d", 4], 1_400),     # batch 2 flushes (c,d)
+    ])
+    # each flushed batch emits 2 rows -> the 2-event limiter releases them
+    assert ("b", 3) in got           # sum over batch 1
+    assert ("d", 7) in got           # sum over batch 2
+
+
+def test_output_all_passthrough_default(manager):
+    ql = """
+    define stream S (sym string, v int);
+    @info(name='q') from S select sym insert into Out;
+    """
+    got = _run(manager, ql, [(["a", 1], None), (["b", 2], None)])
+    assert [g[0] for g in got] == ["a", "b"]
