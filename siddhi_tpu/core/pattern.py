@@ -232,6 +232,15 @@ class PatternExec:
                 if other.ckey != a.ckey and not other.absent:
                     fscope.add_source(other.ref, schemas[other.stream_id],
                                       default=False)
+            from ..query_api.expression import In, walk
+            if any(isinstance(n, In) for n in walk(a.filter_expr)):
+                # the In-probe rides the plain-query step env; pattern
+                # steps have no table plumbing yet — fail at compile time
+                # instead of a runtime KeyError
+                raise CompileError(
+                    "`in <table>` inside pattern/sequence filters is not "
+                    "supported; join the match output against the table "
+                    "instead")
             self._filters[a.ckey] = compile_expression(a.filter_expr, fscope)
 
     # -- state ----------------------------------------------------------------

@@ -197,3 +197,15 @@ def test_window_missing_param_is_compile_error(manager):
         define stream S (a int);
         @info(name='q') from S#window.length() select a insert into Out;
         """)
+
+
+def test_in_table_inside_pattern_is_compile_error(manager):
+    """`in <table>` inside pattern filters fails at COMPILE time with a
+    clear message (regression: used to KeyError at runtime)."""
+    with pytest.raises(CompileError, match="pattern/sequence filters"):
+        manager.create_siddhi_app_runtime("""
+        define stream S (k long, v int);
+        define table T (k long);
+        @info(name='q') from every e1=S[k in T] -> e2=S[v == 2]
+        select e1.k as k insert into Out;
+        """)
