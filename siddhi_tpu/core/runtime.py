@@ -792,6 +792,7 @@ class JoinQueryRuntime:
         self.app = app
         self.state = jax.tree.map(
             lambda x: jax.numpy.array(x, copy=True), planned.init_state())
+        self.state = self.place_state(self.state)
         self.callbacks: List[Callable] = []
         self.batch_callbacks: List[Callable] = []
         self.next_wakeup: int = _NO_WAKEUP_INT
@@ -801,6 +802,27 @@ class JoinQueryRuntime:
     @property
     def name(self):
         return self.planned.name
+
+    def place_state(self, state):
+        """GSPMD scale-out: shard window buffers / selector slabs on axis 0
+        and let XLA partition the [R, C] join compare and buffer
+        maintenance (sharding is a layout hint — semantics are preserved
+        whatever the choice; scatters/sorts get collectives as needed).
+        Scalars and indivisible leaves stay replicated.  Restore paths call
+        this too, so a restored runtime keeps its sharding."""
+        mesh = getattr(self.app, "mesh", None)
+        if mesh is None or mesh.devices.size < 2:
+            return state
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        n = mesh.devices.size
+
+        def _place(x):
+            if getattr(x, "ndim", 0) >= 1 and x.shape[0] >= n and \
+                    x.shape[0] % n == 0:
+                spec = P(*(["shard"] + [None] * (x.ndim - 1)))
+                return jax.device_put(x, NamedSharding(mesh, spec))
+            return x
+        return jax.tree.map(_place, state)
 
     def _other_table(self, is_left):
         p = self.planned
@@ -2478,8 +2500,10 @@ class SiddhiAppRuntime:
                     if alloc is not None:
                         alloc.apply_journal(d["journal"])
                 else:
-                    qr.state = jax.tree.map(
+                    restored = jax.tree.map(
                         lambda x: jax.numpy.asarray(x), d["state"])
+                    qr.state = qr.place_state(restored) \
+                        if hasattr(qr, "place_state") else restored
                     if d["slots"] is not None and alloc is not None:
                         alloc.restore(d["slots"])
                     alloc2 = getattr(qr.planned, "slot_allocator2", None)
@@ -2505,8 +2529,10 @@ class SiddhiAppRuntime:
                 qr = self.query_runtimes.get(name)
                 if qr is None:
                     continue
-                qr.state = jax.tree.map(
+                restored = jax.tree.map(
                     lambda x: jax.numpy.asarray(x), data["state"])
+                qr.state = qr.place_state(restored) \
+                    if hasattr(qr, "place_state") else restored
                 alloc = _allocator_of(qr)
                 if data["slots"] is not None and alloc is not None:
                     alloc.restore(data["slots"])
