@@ -1,0 +1,114 @@
+"""Grammar corpus round 2: every SiddhiQL construct the framework claims
+must PARSE (reference shape: query-compiler src/test parse fixtures).
+Structural spot-checks, not runtime drives."""
+import pytest
+
+from siddhi_tpu.compiler import SiddhiCompiler
+
+VALID_APPS = [
+    # annotations
+    "@app:name('X') @app:statistics('DETAIL') define stream S (a int);",
+    "@app:playback define stream S (a int);",
+    "@async(buffer.size='128', workers='2') define stream S (a int);",
+    "@OnError(action='STREAM') define stream S (a int);",
+    # definitions
+    "define stream S (a string, b int, c long, d float, e double, f bool);",
+    "@PrimaryKey('a','b') @Index('c') define table T (a int, b int, c int);",
+    "define window W (a int) timeBatch(5 sec) output expired events;",
+    "define trigger T5 at every 5 sec;",
+    "define trigger TC at '*/5 * * * * ?';",
+    "define trigger TS at 'start';",
+    "define function f[javascript] return int { return 1; };",
+    "define aggregation A from S select sum(a) as s "
+    "aggregate every sec ... year;",
+    # windows & handlers
+    "define stream S (a int);\n@info(name='q') from S#window.hopping"
+    "(2 sec, 1 sec) select a insert into O;",
+    "define stream S (a int);\n@info(name='q') from "
+    "S[a > 0]#window.length(5)[a < 10] select a insert into O;",
+    # patterns
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from every (e1=A1 -> e2=B1) within 10 sec "
+    "select e1.x as x insert into O;",
+    "define stream A1 (x int);\n@info(name='q') from e1=A1[x > 0]<2:5> "
+    "select e1[0].x as x insert into O;",
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from e1=A1 and e2=B1 select e1.x as x insert into O;",
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from e1=A1 or e2=B1 select e1.x as x insert into O;",
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from e1=A1 -> not B1 for 5 sec "
+    "select e1.x as x insert into O;",
+    # joins
+    "define stream L (s long); define stream R (s long);\n"
+    "@info(name='q') from L#window.time(1 min) as l "
+    "join R#window.time(1 min) as r on l.s == r.s "
+    "select l.s as s insert into O;",
+    "define stream L (s long); define stream R (s long);\n"
+    "@info(name='q') from L#window.length(5) full outer join "
+    "R#window.length(5) on L.s == R.s select L.s as s insert into O;",
+    # partitions
+    "define stream S (k string, v int);\n"
+    "partition with (k of S) begin @info(name='q') from S select k, "
+    "sum(v) as t insert into O; end;",
+    "define stream S (v int);\n"
+    "partition with (v < 10 as 'low' or v >= 10 as 'high' "
+    "of S) begin @info(name='q') from S select v insert into O; end;",
+    # selection forms
+    "define stream S (a int, b string);\n@info(name='q') from S select * "
+    "insert into O;",
+    "define stream S (a int, b string);\n@info(name='q') from S "
+    "select a, b group by b having a > 1 order by a desc limit 5 offset 1 "
+    "insert into O;",
+    # output rate + event types
+    "define stream S (a int);\n@info(name='q') from S select a "
+    "output snapshot every 5 sec insert into O;",
+    "define stream S (a int);\n@info(name='q') from S#window.length(2) "
+    "select a insert all events into O;",
+    # table ops
+    "define stream S (a int); define table T (a int);\n"
+    "@info(name='q') from S delete T on T.a == a;",
+    "define stream S (a int); define table T (a int);\n"
+    "@info(name='q') from S update T set T.a = a on T.a < a;",
+    "define stream S (a int); define table T (a int);\n"
+    "@info(name='q') from S update or insert into T set T.a = a "
+    "on T.a == a;",
+    # sources/sinks
+    "@source(type='tcp', port='9000', @map(type='json', "
+    "@attributes(a='$.x'))) define stream S (a int);",
+    "@sink(type='log', prefix='p', @map(type='text', "
+    "@payload('v={{a}}'))) define stream S (a int);",
+]
+
+
+@pytest.mark.parametrize("ql", VALID_APPS,
+                         ids=[f"app{i}" for i in range(len(VALID_APPS))])
+def test_parses(ql):
+    app = SiddhiCompiler.parse(ql)
+    assert app.stream_definition_map or app.table_definition_map or \
+        app.window_definition_map or app.trigger_definition_map or \
+        app.aggregation_definition_map or app.function_definition_map
+
+
+def test_parse_structure_spotchecks():
+    app = SiddhiCompiler.parse(
+        "define stream S (a int);\n"
+        "@info(name='q') from S[a > 0] select a as x, a * 2 as y "
+        "group by a having x > 1 insert expired events into O;")
+    q = app.execution_element_list[0]
+    assert q.selector is not None
+    assert len(q.selector.selection_list) == 2
+    assert q.selector.group_by_list and q.selector.having_expression
+    assert q.output_stream.output_event_type == "EXPIRED_EVENTS"
+
+
+def test_parse_on_demand_forms():
+    for ql in ("from T select a",
+               "from T on a > 5 select a, b",
+               "from A within '2020-01-01' per 'days' select x",
+               "from T delete T on T.a == 5",
+               "from T update T set T.a = 1 on T.a == 2",
+               "select 1 as a insert into T"):
+        oq = SiddhiCompiler.parse_on_demand_query(ql)
+        assert oq.type in ("FIND", "DELETE", "UPDATE", "INSERT",
+                           "UPDATE_OR_INSERT")
