@@ -1590,7 +1590,9 @@ class SiddhiAppRuntime:
                 level = "BASIC"
             elif level == "FALSE":
                 level = OFF
-        self.stats = StatisticsManager(level)
+        self.stats = StatisticsManager(
+            level, include=str(st_ann.element("include", ""))
+            if st_ann is not None else "")
         # @app:statistics(reporter='console', interval='5 sec') starts a
         # periodic reporter with the app (reference: startReporting :55)
         self._stats_reporter = None

@@ -13,14 +13,24 @@ OFF, BASIC, DETAIL = "OFF", "BASIC", "DETAIL"
 
 
 class StatisticsManager:
-    def __init__(self, level: str = OFF):
+    def __init__(self, level: str = OFF, include: str = ""):
         self.level = level
+        # @app:statistics(include='streams.*, queries.q1') — comma-
+        # separated fnmatch patterns over report paths (reference:
+        # SiddhiStatisticsManager's include filter)
+        self.include = [p.strip() for p in include.split(",") if p.strip()]
         self._lock = threading.Lock()
         self._stream_in: Dict[str, int] = {}
         self._query_events: Dict[str, int] = {}
         self._query_time_ns: Dict[str, int] = {}
         self._query_max_ns: Dict[str, int] = {}
         self._start = time.time()
+
+    def _included(self, path: str) -> bool:
+        if not self.include:
+            return True
+        from fnmatch import fnmatch
+        return any(fnmatch(path, p) for p in self.include)
 
     # -- hook points -----------------------------------------------------------
     @property
@@ -53,10 +63,13 @@ class StatisticsManager:
                 "uptime_s": elapsed,
                 "streams": {
                     sid: {"events": n, "throughput_eps": n / elapsed}
-                    for sid, n in self._stream_in.items()},
+                    for sid, n in self._stream_in.items()
+                    if self._included(f"streams.{sid}")},
                 "queries": {},
             }
             for name, n in self._query_events.items():
+                if not self._included(f"queries.{name}"):
+                    continue
                 t = self._query_time_ns.get(name, 0)
                 out["queries"][name] = {
                     "events": n,

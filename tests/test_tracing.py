@@ -57,3 +57,24 @@ def test_detail_latency_metrics(manager):
     assert rep["streams"]["S"]["events"] == 1
     assert "q" in rep["queries"]
     assert rep["queries"]["q"]["events"] == 1
+
+
+def test_statistics_include_filter(manager):
+    """@app:statistics(include=...) filters which metrics report
+    (reference: the include filter of SiddhiStatisticsManager)."""
+    ql = """
+    @app:statistics('BASIC', include='streams.S1')
+    define stream S1 (v int);
+    define stream S2 (v int);
+    @info(name='q1') from S1 select v insert into Out;
+    @info(name='q2') from S2 select v insert into Out2;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    rt.get_input_handler("S1").send([1])
+    rt.get_input_handler("S2").send([2])
+    rt.flush()
+    rep = rt.statistics()
+    assert "S1" in rep["streams"]
+    assert "S2" not in rep["streams"]
+    assert rep["queries"] == {}          # queries.* not included
