@@ -2601,8 +2601,33 @@ class SiddhiManager:
         properties and per-extension ConfigReaders (utils/config.py)."""
         self.config_manager = config_manager
 
+    def set_extension(self, name: str, impl) -> None:
+        """reference: SiddhiManager.setExtension :213 — register a custom
+        extension by `namespace:name`.  The implementation kind is
+        inferred: WindowProcessor subclasses register as windows, Source/
+        Sink subclasses as transports, callables as scalar functions
+        (returning a CompiledExpr from a list of compiled args)."""
+        from ..io.sink import Sink, register_sink_type
+        from ..io.source import Source, register_source_type
+        from .extension import scalar_function, window_extension
+        from .window import WindowProcessor
+        if isinstance(impl, type) and issubclass(impl, WindowProcessor):
+            window_extension(name, replace=True)(impl)
+        elif isinstance(impl, type) and issubclass(impl, Source):
+            register_source_type(name, impl)
+        elif isinstance(impl, type) and issubclass(impl, Sink):
+            register_sink_type(name, impl)
+        elif callable(impl):
+            scalar_function(name, replace=True)(impl)
+        else:
+            raise TypeError(
+                f"cannot infer extension kind for {type(impl).__name__}; "
+                f"use the @scalar_function/@window_extension decorators or "
+                f"register_source_type/register_sink_type directly")
+
     setPersistenceStore = set_persistence_store
     setConfigManager = set_config_manager
+    setExtension = set_extension
 
     def create_siddhi_app_runtime(
             self, app: Union[str, SiddhiApp],

@@ -130,3 +130,30 @@ def test_composite_annotation_elements(manager):
     rt.flush()
     rows = sorted(tuple(e.data) for e in t.snapshot_rows())
     assert rows == [("x", "p", 3), ("x", "q", 2)]
+
+
+def test_manager_set_extension():
+    """SiddhiManager.setExtension registers custom extensions with kind
+    inference (reference: SiddhiManager.java:213)."""
+    import jax.numpy as jnp
+
+    from siddhi_tpu.core.executor import CompiledExpr
+
+    def twice(args):
+        src = args[0]
+        return CompiledExpr(lambda env, _s=src.fn: _s(env) * 2, src.type)
+
+    m = SiddhiManager()
+    m.set_extension("custom:twice", twice)
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @info(name='q') from S select custom:twice(v) as d insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        e.data[0] for e in (i or [])))
+    rt.start()
+    rt.get_input_handler("S").send([21])
+    rt.flush()
+    assert got == [42]
+    m.shutdown()
