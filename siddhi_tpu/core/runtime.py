@@ -2238,8 +2238,13 @@ class SiddhiAppRuntime:
             "pending (sustained re-ingestion?)")
 
     def _quiesce(self):
-        """Acquire the app lock plus EVERY query lock (the reference's
-        ThreadBarrier quiescing event threads for snapshots)."""
+        """Drain async ingress, then acquire the app lock plus EVERY query
+        lock (the reference's ThreadBarrier quiescing event threads for
+        snapshots).  The drain comes FIRST: accepted-but-queued events must
+        land in the state being snapshotted (at-least-once across a
+        persist/restore), and draining takes query locks internally."""
+        for j in self.junctions.values():
+            j.flush_async()
         locks = [self._lock]
         for qname in sorted(self.query_runtimes):
             lk = getattr(self.query_runtimes[qname], "_qlock", None)

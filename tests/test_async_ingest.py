@@ -105,3 +105,43 @@ def test_async_snapshot_quiesces_workers(manager):
         stop.set()
         t.join()
     rt.flush()
+
+
+def test_snapshot_drains_async_ingress():
+    """Events accepted by @async sends before persist() must be in the
+    snapshot (reference: ThreadBarrier drains event threads first)."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.utils.persistence import InMemoryPersistenceStore
+
+    store = InMemoryPersistenceStore()
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime("""
+    @async(buffer.size='64', workers='1')
+    define stream S (k string, v int);
+    @info(name='q') from S select k, sum(v) as t group by k insert into O;
+    """)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(50):
+        h.send(["a", 1])
+    m.persist()             # must include all 50 accepted sends
+    m.wait_for_persistence()
+    m.shutdown()
+
+    m2 = SiddhiManager()
+    m2.set_persistence_store(store)
+    rt2 = m2.create_siddhi_app_runtime("""
+    @async(buffer.size='64', workers='1')
+    define stream S (k string, v int);
+    @info(name='q') from S select k, sum(v) as t group by k insert into O;
+    """)
+    rt2.start()
+    m2.restore_last_revision()
+    got = []
+    rt2.add_callback("q", lambda ts, i, o: got.extend(
+        e.data[1] for e in (i or [])))
+    rt2.get_input_handler("S").send(["a", 1])
+    rt2.flush()
+    assert got == [51]      # 50 pre-snapshot + 1
+    m2.shutdown()
