@@ -185,3 +185,28 @@ def test_multi_stream_three_stage():
           ("S2", ["y", 1.0, 2], 1001),
           ("S1", ["z", 1.0, 3], 1002)])
     assert got == [("x", "y", "z")]
+
+
+def test_count_capture_indexed_access():
+    """e1[0].attr / e1[1].attr select specific occurrences of a counted
+    capture (reference: StateInputStream count patterns, e[i] positions)."""
+    from siddhi_tpu import SiddhiManager
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (v int);
+    @capacity(keys='1', slots='8')
+    @info(name='q') from e1=S[v < 10]<2:3> -> e2=S[v == 99]
+    select e1[0].v as first, e1[1].v as second, e2.v as probe
+    insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        tuple(e.data) for e in (i or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i, v in enumerate((1, 2, 99)):
+        h.send([[v]], timestamp=1000 + i)
+    rt.flush()
+    assert got == [(1, 2, 99)]
+    m.shutdown()
