@@ -2630,9 +2630,34 @@ class SiddhiManager:
                 f"use the @scalar_function/@window_extension decorators or "
                 f"register_source_type/register_sink_type directly")
 
+    def create_sandbox_siddhi_app_runtime(
+            self, app: Union[str, SiddhiApp],
+            mesh=None) -> "SiddhiAppRuntime":
+        """reference: SiddhiManager.createSandboxSiddhiAppRuntime — deploy
+        an app with its EXTERNAL dependencies stripped for testing: only
+        inMemory sources/sinks survive, @store tables become plain
+        in-memory tables (SandboxTestCase expectations)."""
+        from ..compiler import SiddhiCompiler
+        if isinstance(app, str):
+            app = SiddhiCompiler.parse(app)
+
+        def keep(ann) -> bool:
+            if ann.name.lower() not in ("source", "sink"):
+                return True
+            t = ann.element("type") or ann.element(None)
+            return str(t).lower() == "inmemory"
+
+        for sdef in app.stream_definition_map.values():
+            sdef.annotations = [a for a in sdef.annotations if keep(a)]
+        for tdef in app.table_definition_map.values():
+            tdef.annotations = [a for a in tdef.annotations
+                                if a.name.lower() != "store"]
+        return self.create_siddhi_app_runtime(app, mesh=mesh)
+
     setPersistenceStore = set_persistence_store
     setConfigManager = set_config_manager
     setExtension = set_extension
+    createSandboxSiddhiAppRuntime = create_sandbox_siddhi_app_runtime
 
     def create_siddhi_app_runtime(
             self, app: Union[str, SiddhiApp],

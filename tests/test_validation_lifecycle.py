@@ -209,3 +209,46 @@ def test_in_table_inside_pattern_is_compile_error(manager):
         @info(name='q') from every e1=S[k in T] -> e2=S[v == 2]
         select e1.k as k insert into Out;
         """)
+
+
+def test_sandbox_runtime_strips_external_io(manager):
+    """createSandboxSiddhiAppRuntime keeps only inMemory transports and
+    drops @store annotations (reference: SandboxTestCase.sandboxTest1)."""
+    from siddhi_tpu.io.sink import register_sink_type, Sink
+    from siddhi_tpu.io.source import register_source_type, Source
+
+    class _Foo(Source):
+        def connect(self):
+            raise RuntimeError("external transport must not connect")
+
+    class _FooSink(Sink):
+        def publish(self, payload):
+            raise RuntimeError("external sink must not publish")
+
+    register_source_type("fooX", _Foo)
+    register_sink_type("fooX", _FooSink)
+    ql = """
+    @source(type='fooX')
+    @source(type='inMemory', topic='t1')
+    define stream S (a int);
+    @sink(type='fooX')
+    @sink(type='inMemory', topic='t2')
+    define stream Out (a int);
+    @info(name='q') from S select a insert into Out;
+    """
+    rt = manager.create_sandbox_siddhi_app_runtime(ql)
+    rt.start()      # fooX would raise on connect if it survived
+    assert len(rt.sources) == 1
+    assert len(rt.sinks) == 1
+    from siddhi_tpu.io.broker import InMemoryBroker
+    from siddhi_tpu.io import broker as _broker
+    got = []
+    sub = _broker.subscribe_fn("t2", lambda p: got.append(p))
+    InMemoryBroker.publish("t1", [7])
+    rt.flush()
+    import time as _t
+    deadline = _t.monotonic() + 3
+    while not got and _t.monotonic() < deadline:
+        _t.sleep(0.02)
+    assert got, "sandboxed inMemory pipeline did not deliver"
+    InMemoryBroker.unsubscribe(sub)
