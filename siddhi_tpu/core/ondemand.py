@@ -96,7 +96,7 @@ def execute_on_demand(rt, oq) -> List[ev.Event]:
             raise CompileError("on-condition must be boolean")
         table = rt.tables.get(store.store_id)
         sel = (_indexed_row_mask(table, store.on_condition, key, schema,
-                                 scope, env, mask)
+                                 scope, env, mask, c)
                if table is not None else None)
         if sel is not None:
             mask &= sel
@@ -119,34 +119,26 @@ def execute_on_demand(rt, oq) -> List[ev.Event]:
     return sel_events
 
 
-def _indexed_row_mask(table, cond_expr, key, schema, scope, env, valid):
+def _indexed_row_mask(table, cond_expr, key, schema, scope, env, valid,
+                      compiled_full):
     """Index-aware on-demand condition (reference: the store-query path of
     CollectionExpressionParser + IndexOperator.find). Returns a row mask, or
-    None when the condition has no usable indexed conjunct."""
-    from .table_index import split_index_condition
+    None when the condition has no usable indexed conjunct.
 
-    probe_positions = list(table.indexes)
-    if table.pkey_positions is not None and len(table.pkey_positions) == 1:
-        probe_positions.append(table.pkey_positions[0])
-    if not probe_positions:
-        return None
-    plan = split_index_condition(cond_expr, key, schema, probe_positions,
-                                 unqualified_is_table=True)
+    The probe only NARROWS: the full compiled condition re-evaluates on the
+    candidate rows, keeping exact dense semantics under dtype casts and
+    probe-structure staleness (same contract as TableRuntime._match)."""
+    tc = table.plan_condition(cond_expr, scope, table_id=key,
+                              unqualified_is_table=True)
+    plan = tc.plan
     if plan is None:
-        return None
-    if plan.kind == "range" and plan.pos not in table.indexes:
         return None
     rv = np.asarray(compile_expression(plan.rhs, scope).fn(env))
     val = rv.reshape(-1)[0]
     if plan.kind == "eq":
-        if plan.pos in table.indexes:
-            rows = table.indexes[plan.pos].rows_eq(val)
-        else:
-            rows = table.allocator.slots_for(
-                [np.asarray([val], ev.np_dtype(
-                    table.schema.types[plan.pos]))],
-                np.ones(1, bool), lookup_only=True)
-            rows = rows[rows >= 0].astype(np.int64)
+        cand, ok = table._probe_candidates(
+            plan.pos, np.asarray([val]))
+        rows = cand[0][ok[0]].astype(np.int64)
     else:
         rows = table.indexes[plan.pos].rows_range(
             np.asarray(table.valid), plan.op, val)
@@ -154,13 +146,12 @@ def _indexed_row_mask(table, cond_expr, key, schema, scope, env, valid):
     rows = rows[rows < valid.shape[0]]
     mask[rows] = True
     mask &= valid
-    if plan.residual is not None and mask.any():
+    if mask.any():
         ridx = np.nonzero(mask)[0]
         env_sub = dict(env)
         env_sub[key] = tuple(np.asarray(cc)[ridx] for cc in env[key])
         env_sub["__ts__"] = np.asarray(env["__ts__"])[ridx]
-        rmask = np.asarray(
-            compile_expression(plan.residual, scope).fn(env_sub))
+        rmask = np.asarray(compiled_full.fn(env_sub))
         mask[ridx] &= np.broadcast_to(rmask.astype(bool), ridx.shape)
     table.index_stats["indexed"] += 1
     return mask

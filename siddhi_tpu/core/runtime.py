@@ -2496,9 +2496,36 @@ class SiddhiAppRuntime:
                 alloc = _allocator_of(qr)
                 if d["kind"] == "keyed":
                     (b32, b64, scalars), _ = qr.state
-                    idx = jax.numpy.asarray(d["slots"])
-                    b32 = b32.at[:, idx].set(jax.numpy.asarray(d["b32"]))
-                    b64 = b64.at[:, idx].set(jax.numpy.asarray(d["b64"]))
+                    sharded = len(getattr(
+                        b32, "sharding", None).device_set) > 1 \
+                        if getattr(b32, "sharding", None) is not None else \
+                        False
+                    if sharded:
+                        # host-context scatters into sharded slabs drop
+                        # remote-shard columns (core/shardsafe.py): go
+                        # through a dense masked where instead
+                        from .shardsafe import key_mask, masked_fill
+                        slots = np.asarray(d["slots"])
+                        K = b32.shape[1]
+                        mask = key_mask(slots, K)
+                        up32 = np.zeros(b32.shape, np.asarray(
+                            d["b32"]).dtype)
+                        up32[:, slots] = d["b32"]
+                        up64 = np.zeros(b64.shape, np.asarray(
+                            d["b64"]).dtype)
+                        up64[:, slots] = d["b64"]
+                        b32 = masked_fill(b32, mask,
+                                          jax.numpy.asarray(up32),
+                                          key_axis=1)
+                        b64 = masked_fill(b64, mask,
+                                          jax.numpy.asarray(up64),
+                                          key_axis=1)
+                    else:
+                        idx = jax.numpy.asarray(d["slots"])
+                        b32 = b32.at[:, idx].set(
+                            jax.numpy.asarray(d["b32"]))
+                        b64 = b64.at[:, idx].set(
+                            jax.numpy.asarray(d["b64"]))
                     scalars = tuple(jax.numpy.asarray(s)
                                     for s in d["scalars"])
                     sel_state = jax.tree.map(lambda x: jax.numpy.asarray(x),
@@ -2640,6 +2667,11 @@ class SiddhiManager:
         from ..compiler import SiddhiCompiler
         if isinstance(app, str):
             app = SiddhiCompiler.parse(app)
+        else:
+            # never mutate the caller's app object: the same SiddhiApp may
+            # be deployed for real afterwards with its transports intact
+            import copy
+            app = copy.deepcopy(app)
 
         def keep(ann) -> bool:
             if ann.name.lower() not in ("source", "sink"):

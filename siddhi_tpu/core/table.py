@@ -173,6 +173,20 @@ class TableRuntime:
             batch = batch.with_cols(new_batch_cols)
         return batch
 
+    def _materialize_uuid_col(self, val, hit):
+        """`set T.s = UUID()` writes the sentinel; stored cells must hold
+        REAL interned ids or every read mints a different uuid (same
+        contract as _materialize_uuids on the insert path)."""
+        import uuid
+        vnp = np.asarray(val)
+        mask = np.asarray(hit) & (vnp == ev.UUID_SENTINEL)
+        if not mask.any():
+            return val
+        vnp = vnp.copy()
+        vnp[mask] = [self.schema.interner.intern(str(uuid.uuid4()))
+                     for _ in range(int(mask.sum()))]
+        return jnp.asarray(vnp)
+
     def insert(self, batch: ev.EventBatch, staged: ev.StagedBatch) -> None:
         """Insert CURRENT rows (keyed: upsert on primary key; else append)."""
         with self._lock:
@@ -204,12 +218,17 @@ class TableRuntime:
         return compile_expression(cond, scope)
 
     def plan_condition(self, cond_expr: Expression, scope: Scope,
+                       table_id: Optional[str] = None,
+                       unqualified_is_table: bool = False,
                        ) -> TableCondition:
         """Compile a table condition with index-aware planning: if one AND-
         conjunct is `table.attr == <stream expr>` on an indexed attribute (or
         a single-column primary key), later matches probe that index instead
         of the dense [B, C] broadcast (reference:
-        CollectionExpressionParser.java; IndexOperator.java)."""
+        CollectionExpressionParser.java; IndexOperator.java).
+
+        `table_id`/`unqualified_is_table` override the reference scoping for
+        on-demand store queries (alias id, bare names bind to the store)."""
         compiled = compile_expression(cond_expr, scope)
         probe_positions = list(self.indexes)
         if self.pkey_positions is not None and len(self.pkey_positions) == 1:
@@ -217,7 +236,8 @@ class TableRuntime:
         plan = None
         if probe_positions:
             plan = split_index_condition(
-                cond_expr, self.definition.id, self.schema, probe_positions)
+                cond_expr, table_id or self.definition.id, self.schema,
+                probe_positions, unqualified_is_table=unqualified_is_table)
         if plan is None:
             return TableCondition(compiled)
         if plan.kind == "range" and plan.pos not in self.indexes:
@@ -350,14 +370,21 @@ class TableRuntime:
                 "__ts__": batch.ts[src_c],
             }
             new_cols = list(self.cols)
-            # index maintenance needs host rows only when indexes exist
+            # index maintenance needs host rows only when a set expression
+            # actually writes an indexed column (the sync is not free)
+            touches_index = any(pos in self.indexes for pos, _ in set_fns)
             hit_rows = (np.nonzero(np.asarray(hit))[0]
-                        if self.indexes else None)
+                        if touches_index else None)
             for pos, fn in set_fns:
-                val = fn(env)
+                val = jnp.asarray(fn(env))
+                if val.ndim == 0:        # constant set expressions are 0-d
+                    val = jnp.broadcast_to(val, (self.capacity,))
+                if self.schema.types[pos] == "STRING":
+                    val = self._materialize_uuid_col(val, hit)
                 new_cols[pos] = jnp.where(hit, val.astype(self.cols[pos].dtype),
                                           self.cols[pos])
-                if self.indexes and pos in self.indexes and hit_rows.size:
+                if pos in self.indexes and hit_rows is not None \
+                        and hit_rows.size:
                     self.indexes[pos].on_write(
                         hit_rows, np.asarray(val)[hit_rows])
             self.cols = tuple(new_cols)

@@ -65,6 +65,15 @@ class AttributeIndex:
         if rows.size == 0:
             return
         values = np.asarray(values, self.dtype)
+        if rows.size > 1:
+            # a batch may hit one row several times (pkey upsert with a
+            # repeated key): only the LAST write per row is live — earlier
+            # ones would leave stale lane entries and leaked bucket counts
+            _, last_rev = np.unique(rows[::-1], return_index=True)
+            keep = rows.size - 1 - last_rev
+            if keep.size != rows.size:
+                rows = rows[keep]
+                values = values[keep]
         # drop stale lane entries for rows that already had a value
         stale = self.bucket_of[rows] >= 0
         if stale.any():

@@ -87,7 +87,7 @@ class SiddhiRestService:
                             self._json(409, {
                                 "error": f"app {name!r} already deployed"})
                             return
-                        rt = svc.manager.create_siddhi_app_runtime(ql)
+                        rt = svc.manager.create_siddhi_app_runtime(app)
                         rt.start()
                         self._json(201, {"app": rt.name})
                     elif len(parts) == 4 and parts[0] == "siddhi-apps" \

@@ -243,3 +243,82 @@ def test_split_index_condition_scoping():
     plan = split_index_condition(cond2, "T", sch, [0])
     assert plan is not None and plan.kind == "eq" and plan.pos == 0
     assert plan.residual is not None
+
+
+def test_indexed_update_with_constant_set(manager):
+    """`set T.sym = 'const'` on an indexed column: constant set
+    expressions are 0-d on device (regression: IndexError)."""
+    ql = """
+    define stream In (k string, sym string, v int);
+    define stream Up (k string);
+    @PrimaryKey('k')
+    @Index('sym')
+    define table T (k string, sym string, v int);
+    @info(name='w') from In insert into T;
+    @info(name='u') from Up update T set T.sym = 'done' on T.k == k;
+    """
+    rt = _mk(manager, ql)
+    rt.get_input_handler("In").send(["a", "x", 1])
+    rt.get_input_handler("Up").send(["a"])
+    rt.flush()
+    assert _rows(rt) == [("a", "done", 1)]
+    # the index moved the row to the new value
+    got = rt.query("from T on sym == 'done' select k")
+    assert [e.data[0] for e in got] == ["a"]
+    assert rt.query("from T on sym == 'x' select k") == []
+
+
+def test_ondemand_eq_reverifies_full_condition(manager):
+    """An indexed probe must not widen semantics: `on v == 5.5` against an
+    INT indexed column returns nothing (the cast probe alone would return
+    the v==5 rows)."""
+    ql = """
+    define stream In (k string, v int);
+    @PrimaryKey('k')
+    @Index('v')
+    define table T (k string, v int);
+    @info(name='w') from In insert into T;
+    """
+    rt = _mk(manager, ql)
+    rt.get_input_handler("In").send(["a", 5])
+    rt.flush()
+    assert rt.query("from T on v == 5.5 select k") == []
+    assert [e.data[0] for e in rt.query("from T on v == 5 select k")] == ["a"]
+
+
+def test_upsert_repeated_key_in_one_batch(manager):
+    """One batch hitting the same pkey twice: the index keeps only the
+    LAST write (regression: stale lane entries leaked buckets)."""
+    ql = """
+    define stream In (k string, sym string, v int);
+    @PrimaryKey('k')
+    @Index('sym')
+    define table T (k string, sym string, v int);
+    @info(name='w') from In insert into T;
+    """
+    rt = _mk(manager, ql)
+    rt.get_input_handler("In").send([["a", "x", 1], ["a", "y", 2]])
+    rt.flush()
+    assert _rows(rt) == [("a", "y", 2)]
+    assert rt.query("from T on sym == 'x' select k") == []
+    assert [e.data[0] for e in rt.query("from T on sym == 'y' select k")] \
+        == ["a"]
+
+
+def test_update_uuid_on_table_column(manager):
+    """`set T.s = UUID()` stores a REAL stable id, not the sentinel."""
+    ql = """
+    define stream In (k string, s string);
+    define stream Up (k string);
+    @PrimaryKey('k')
+    define table T (k string, s string);
+    @info(name='w') from In insert into T;
+    @info(name='u') from Up update T set T.s = UUID() on T.k == k;
+    """
+    rt = _mk(manager, ql)
+    rt.get_input_handler("In").send(["a", "orig"])
+    rt.get_input_handler("Up").send(["a"])
+    rt.flush()
+    r1 = rt.query("from T select s")[0].data[0]
+    r2 = rt.query("from T select s")[0].data[0]
+    assert r1 == r2 and len(r1) == 36      # stable across reads
