@@ -1,0 +1,50 @@
+"""Cross-process pipeline sample (reference role: the transport extension
+quick-starts): two runtimes linked only by the tcp source/sink pair —
+the host-side DCN leg of a multi-host deployment."""
+import time
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.utils.testing import EventPrinter
+
+
+def main():
+    manager = SiddhiManager()
+
+    receiver = manager.create_siddhi_app_runtime("""
+        @app:name('receiver')
+        @source(type='tcp', host='127.0.0.1', port='7071',
+                @map(type='json'))
+        define stream In (sym string, price double);
+        @info(name='q') from In[price > 10.0]
+        select sym, price insert into Out;
+    """)
+    printer = EventPrinter()
+    receiver.add_callback("q", printer)
+    receiver.start()
+    time.sleep(0.2)          # listener up
+
+    sender = manager.create_siddhi_app_runtime("""
+        @app:name('sender')
+        define stream S (sym string, price double);
+        @sink(type='tcp', host='127.0.0.1', port='7071',
+              @map(type='json'))
+        define stream T (sym string, price double);
+        @info(name='fwd') from S select sym, price insert into T;
+    """)
+    sender.start()
+
+    h = sender.get_input_handler("S")
+    h.send(["ACME", 25.0])
+    h.send(["SMALL", 5.0])    # filtered on the receiver side
+    h.send(["BIG", 99.0])
+    sender.flush()
+    receiver.flush()
+    deadline = time.monotonic() + 3
+    while printer.count < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    print(f"{printer.count} events crossed the socket and passed the filter")
+    manager.shutdown()
+
+
+if __name__ == "__main__":
+    main()
