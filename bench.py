@@ -303,8 +303,36 @@ def _enable_compile_cache():
         print(f"compile cache unavailable: {exc!r}", file=sys.stderr)
 
 
+def _probe_backend(timeout_s: float = 180.0) -> None:
+    """Fail FAST if the accelerator backend is unreachable: a wedged
+    device tunnel makes jax.devices() hang indefinitely, which would hang
+    the whole benchmark run rather than reporting an actionable error."""
+    import threading
+
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            result["devices"] = [str(d) for d in jax.devices()]
+        except Exception as exc:  # noqa: BLE001 — reported below
+            result["error"] = repr(exc)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise RuntimeError(
+            f"jax backend init did not respond within {timeout_s:.0f}s "
+            f"(device tunnel down?)")
+    if "error" in result:
+        raise RuntimeError(f"jax backend init failed: {result['error']}")
+    print(f"devices: {result['devices']}", file=sys.stderr)
+
+
 def main():
     _enable_compile_cache()
+    _probe_backend()
     baseline = run_python_baseline()
     # one failing mode must not kill the benchmark (the other mode's
     # number still stands); both failing is a real rc!=0
