@@ -94,18 +94,17 @@ def run_tpu(async_ingest: bool = False):
     rt.flush()            # all async deliveries done before the clock stops
     dt = time.perf_counter() - t0
     eps = total / dt
-    lat_ms = np.array(sorted(lat)) * 1000
+    stats = _lat_stats(lat)
     mode = "async" if async_ingest else "sync"
     print(f"tpu[{mode}]: {total} events in {dt:.2f}s -> {eps:,.0f} ev/s; "
-          f"matches={matches[0]}; batch p50={lat_ms[len(lat)//2]:.2f}ms "
-          f"p99={lat_ms[int(len(lat)*0.99)]:.2f}ms", file=sys.stderr)
+          f"matches={matches[0]}; batch p50={stats['p50_ms']}ms "
+          f"p99={stats['p99_ms']}ms", file=sys.stderr)
     expected = SWEEPS * blocks * BATCH  # one match per key per sweep
     if matches[0] - warm_matches != expected:
         print(f"WARNING: match count {matches[0]-warm_matches} != "
               f"{expected}", file=sys.stderr)
     manager.shutdown()
-    return eps, {"p50_ms": round(float(lat_ms[len(lat) // 2]), 2),
-                 "p99_ms": round(float(lat_ms[int(len(lat) * 0.99)]), 2)}
+    return eps, stats
 
 
 def run_python_baseline(n_events=400_000):
@@ -153,6 +152,15 @@ def run_python_baseline(n_events=400_000):
 # JSON line under "configs" and never break it: failures report as errors.
 # ---------------------------------------------------------------------------
 
+def _lat_stats(lat_s):
+    """{p50_ms, p99_ms} from a list of per-send wall times (seconds) —
+    the BASELINE metric is 'events/sec ...; p99 match latency'."""
+    arr = np.sort(np.asarray(lat_s, np.float64)) * 1000.0
+    return {"p50_ms": round(float(arr[len(arr) // 2]), 2),
+            "p99_ms": round(float(arr[min(len(arr) - 1,
+                                          int(len(arr) * 0.99))]), 2)}
+
+
 def _drive(ql, qname, stream, make_batch, n_batches, warmup=1,
            batch_cb=True):
     from siddhi_tpu import SiddhiManager
@@ -169,15 +177,18 @@ def _drive(ql, qname, stream, make_batch, n_batches, warmup=1,
         h.send_columns(wcols, **wkw)
     rt.flush()
     total = 0
+    lat = []
     t0 = time.perf_counter()
     for i in range(n_batches):
         cols, kw = make_batch(warmup + i)
+        tb = time.perf_counter()
         h.send_columns(cols, **kw)
+        lat.append(time.perf_counter() - tb)
         total += len(cols[0])
     rt.flush()
     dt = time.perf_counter() - t0
     manager.shutdown()
-    return total / dt, count[0]
+    return total / dt, count[0], _lat_stats(lat)
 
 
 def config_length_batch(n_batches=16, B=1 << 17):
@@ -193,8 +204,8 @@ def config_length_batch(n_batches=16, B=1 << 17):
         return ([np.zeros(B, np.int64),
                  rng.random(B, np.float32), np.ones(B, np.int32)],
                 {"timestamps": np.full(B, 1000 + i, np.int64)})
-    eps, _ = _drive(ql, "q", "StockStream", mk, n_batches)
-    return eps
+    eps, _, lat = _drive(ql, "q", "StockStream", mk, n_batches)
+    return eps, lat
 
 
 def config_time_groupby_having(n_batches=16, B=1 << 17, n_sym=256):
@@ -213,8 +224,8 @@ def config_time_groupby_having(n_batches=16, B=1 << 17, n_sym=256):
                  rng.random(B, np.float32),
                  np.ones(B, np.int32)],
                 {"timestamps": np.full(B, 1000 + i * 10, np.int64)})
-    eps, _ = _drive(ql, "q", "S", mk, n_batches)
-    return eps
+    eps, _, lat = _drive(ql, "q", "S", mk, n_batches)
+    return eps, lat
 
 
 def config_windowed_join(n_batches=16, B=1 << 13, n_sym=64):
@@ -248,14 +259,17 @@ def config_windowed_join(n_batches=16, B=1 << 13, n_sym=64):
     send(0)
     rt.flush()
     total = 0
+    lat = []
     t0 = time.perf_counter()
     for i in range(n_batches):
+        tb = time.perf_counter()
         send(1 + i)
+        lat.append(time.perf_counter() - tb)
         total += 2 * B
     rt.flush()
     dt = time.perf_counter() - t0
     manager.shutdown()
-    return total / dt
+    return total / dt, _lat_stats(lat)
 
 
 def config_sequence_within(n_batches=32, B=1 << 11):
@@ -281,8 +295,51 @@ def config_sequence_within(n_batches=32, B=1 << 11):
                  np.tile(np.array([1, 2], np.int32), B // 2)],
                 {"timestamps": 1000 + i * 50 +
                  np.arange(B, dtype=np.int64) % 50})
-    eps, _ = _drive(ql, "q", "S", mk, n_batches)
-    return eps
+    eps, _, lat = _drive(ql, "q", "S", mk, n_batches)
+    return eps, lat
+
+
+def flagship_small_batch(B, n_sends=64):
+    """Low-latency mode: B events per send (B/4 keys x 4 stages) against a
+    key space sized to the batch — the other end of the latency/throughput
+    curve (BASELINE metric: 'events/sec ...; p99 match latency').  Sync
+    ingest: each send runs staging + device step + emission inline, so the
+    per-send time IS the end-to-end match latency."""
+    from siddhi_tpu import SiddhiManager
+    nk = max(B // 4, 64)
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(QL_TEMPLATE.format(
+        async_ann="", n_keys=nk, slots=SLOTS))
+    matches = [0]
+    rt.add_batch_callback(
+        "flagship",
+        lambda ts, b: matches.__setitem__(0, matches[0] + b["n_current"]))
+    rt.start()
+    h = rt.get_input_handler("TradeStream")
+    keys = np.repeat(np.arange(nk, dtype=np.int64), 4)
+    vol4 = np.tile(np.array([1, 2, 3, 4], np.int32), nk)
+    price4 = vol4.astype(np.float32)
+    clock = [1000]
+
+    def send():
+        clock[0] += 10
+        ts = clock[0] + np.tile(np.arange(4, dtype=np.int64), nk)
+        h.send_columns([keys, price4, vol4], timestamps=ts)
+
+    send()   # warmup / compile
+    rt.flush()
+    lat = []
+    total = 0
+    t0 = time.perf_counter()
+    for _ in range(n_sends):
+        tb = time.perf_counter()
+        send()
+        lat.append(time.perf_counter() - tb)
+        total += 4 * nk
+    rt.flush()
+    dt = time.perf_counter() - t0
+    manager.shutdown()
+    return total / dt, _lat_stats(lat)
 
 
 def _enable_compile_cache():
@@ -357,12 +414,17 @@ def main():
     for key, fn in (("lengthBatch_avg", config_length_batch),
                     ("time_groupby_having", config_time_groupby_having),
                     ("windowed_join", config_windowed_join),
-                    ("sequence_within", config_sequence_within)):
+                    ("sequence_within", config_sequence_within),
+                    ("flagship_smallbatch_1k",
+                     lambda: flagship_small_batch(1 << 10)),
+                    ("flagship_smallbatch_8k",
+                     lambda: flagship_small_batch(1 << 13))):
         try:
             t0 = time.perf_counter()
-            v = fn()
-            configs[key] = {"value": round(v), "unit": "events/sec"}
-            print(f"config {key}: {v:,.0f} ev/s "
+            v, lat_c = fn()
+            configs[key] = {"value": round(v), "unit": "events/sec", **lat_c}
+            print(f"config {key}: {v:,.0f} ev/s p50={lat_c['p50_ms']}ms "
+                  f"p99={lat_c['p99_ms']}ms "
                   f"({time.perf_counter()-t0:.1f}s)", file=sys.stderr)
         except Exception as exc:  # noqa: BLE001 — never break the flagship
             configs[key] = {"error": repr(exc)[:200]}

@@ -7,7 +7,18 @@ event micro-batches `(state, batch) -> (state', outputs)`, partition keys
 shard across the TPU mesh, group-by aggregates run as segmented scans, and
 pattern NFAs advance as vectorized transitions.  See SURVEY.md.
 """
-import jax
+import os
+
+# XLA:CPU's new fusion emitters (jaxlib 0.9.0) miscompile some of our jitted
+# pattern steps (LLVM IR verifier failure in fusion_compiler.cc — e.g. a
+# 2-column (long,int) partitioned NFA step) and compile slower than the
+# legacy emitters.  Best-effort opt-out before the backend initializes; a
+# no-op for TPU and for processes that already compiled something.
+if "--xla_cpu_use_fusion_emitters" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_cpu_use_fusion_emitters=false")
+
+import jax                                                       # noqa: E402
 
 # LONG attributes and epoch-ms timestamps need 64-bit ints (i32 overflows in
 # 2038 and on any epoch-ms value); XLA:TPU emulates s64.  DOUBLE still maps

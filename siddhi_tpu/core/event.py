@@ -53,6 +53,77 @@ _DTYPES = {
 NULL_ID = -1  # interned id representing null string
 UUID_SENTINEL = -2  # UUID() marker id: decodes to a fresh uuid4 per cell
 
+# In-band numeric nulls (reference: events carry boxed Java nulls,
+# JoinProcessor emits them for unmatched outer-join rows).  Columnar numerics
+# carry no side mask; instead one value per dtype is reserved as null —
+# INT/LONG reserve their minimum (kdb-style), FLOAT/DOUBLE use NaN.  The
+# reserved values round-trip to Python None at every host decode boundary.
+# BOOL has no spare value: null bools decode as False (PARITY.md).
+NULL_INT = int(np.iinfo(np.int32).min)
+NULL_LONG = int(np.iinfo(np.int64).min)
+
+
+def null_value(attr_type: str):
+    """The encoded cell value representing null for this attribute type."""
+    t = attr_type.upper()
+    if t in ("STRING", "OBJECT"):
+        return NULL_ID
+    if t == "BOOL":
+        return False
+    if t in ("FLOAT", "DOUBLE"):
+        return float("nan")
+    if t == "INT":
+        return NULL_INT
+    return NULL_LONG
+
+
+def null_mask(x, attr_type: str):
+    """[B] bool mask of null cells; works on jnp arrays/tracers and np."""
+    t = attr_type.upper()
+    host = isinstance(x, np.ndarray)
+    if t in ("STRING", "OBJECT"):
+        # exactly NULL_ID: UUID_SENTINEL (-2) is a real pending value, not
+        # null — `UUID() != 'x'` must stay true, isNull(UUID()) false
+        return x == NULL_ID
+    if t in ("FLOAT", "DOUBLE"):
+        return np.isnan(x) if host else jnp.isnan(x)
+    if t == "INT":
+        return x == NULL_INT
+    if t == "LONG":
+        return x == NULL_LONG
+    return (np.zeros if host else jnp.zeros)(np.shape(x), bool)
+
+
+def fill_uuid_cells(interner, col: "np.ndarray",
+                    mask: "np.ndarray") -> "np.ndarray":
+    """Replace masked cells with freshly interned uuid4 ids (copy-on-write).
+    The single primitive behind every UUID_SENTINEL materialization site —
+    one contract, one implementation."""
+    import uuid
+    if not mask.any():
+        return col
+    col = col.copy()
+    col[mask] = [interner.intern(str(uuid.uuid4()))
+                 for _ in range(int(mask.sum()))]
+    return col
+
+
+def materialize_uuid_sentinels(schema, valid_np, cols):
+    """UUID() sentinels become real interned ids ONCE at a host boundary
+    (query emission, table storage), so every consumer observes the same id
+    per row (reference: CORE/executor/function/UUIDFunctionExecutor — one
+    UUID per event, not per reader).  Returns [(position, new_col)] for the
+    STRING columns that contained sentinels in valid rows."""
+    changed = []
+    for pos, t in enumerate(schema.types):
+        if t.upper() != "STRING":
+            continue
+        col = np.asarray(cols[pos])
+        mask = (col == UUID_SENTINEL) & valid_np
+        if mask.any():
+            changed.append((pos, fill_uuid_cells(schema.interner, col, mask)))
+    return changed
+
 _BUCKETS = (8, 32, 128, 512, 2048, 8192, 32768, 131072, 262144, 524288,
             1048576, 2097152)
 
@@ -174,7 +245,9 @@ class Schema:
         if t == "OBJECT":
             return self.objects.register(v)
         if v is None:
-            return default_value(t)
+            # reference events carry real nulls; numerics use the reserved
+            # in-band value so None round-trips through the device
+            return null_value(t)
         if t == "BOOL":
             return bool(v)
         if t in ("FLOAT", "DOUBLE"):
@@ -198,8 +271,12 @@ class Schema:
         if t == "BOOL":
             return bool(v)
         if t in ("FLOAT", "DOUBLE"):
-            return float(v)
-        return int(v)
+            f = float(v)
+            return None if f != f else f        # NaN is the float null
+        iv = int(v)
+        if iv == (NULL_INT if t == "INT" else NULL_LONG):
+            return None
+        return iv
 
 
 @jax.tree_util.register_pytree_node_class
@@ -338,7 +415,8 @@ def unpack(schema: Schema, batch: EventBatch,
         return []
     ts_l = np.asarray(batch.ts)[idx].tolist()
     kind_l = kind[idx].tolist()
-    col_ls = [np.asarray(c)[idx].tolist() for c in batch.cols]
+    col_np = [np.asarray(c)[idx] for c in batch.cols]
+    col_ls = [c.tolist() for c in col_np]
     decoders = []
 
     def _str_decode(i, _lk=schema.interner.lookup):
@@ -347,12 +425,21 @@ def unpack(schema: Schema, batch: EventBatch,
             return str(uuid.uuid4())
         return _lk(i)
 
-    for t in schema.types:
+    for t, cnp in zip(schema.types, col_np):
         tu = t.upper()
         if tu == "STRING":
             decoders.append(_str_decode)
         elif tu == "OBJECT":
             decoders.append(schema.objects.lookup)
+        elif cnp.size and null_mask(cnp, tu).any():
+            # numeric nulls present: reserved values decode to None.  The
+            # vectorized pre-check keeps null-free columns on the direct
+            # (no per-cell call) path.
+            nv = NULL_INT if tu == "INT" else NULL_LONG
+            if tu in ("FLOAT", "DOUBLE"):
+                decoders.append(lambda v: None if v != v else v)
+            else:
+                decoders.append(lambda v, _n=nv: None if v == _n else v)
         else:
             decoders.append(None)
     out: List[Tuple[int, Event]] = []

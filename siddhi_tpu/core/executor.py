@@ -80,6 +80,9 @@ class Scope:
     # `define function` script definitions (id -> FunctionDefinition); set by
     # the planner from the app
     script_functions = None
+    # set True when a UUID() call compiles through this scope; planners copy
+    # it onto the planned query so emission materializes sentinels exactly once
+    uses_uuid = False
 
     def __init__(self):
         self._sources: Dict[str, "ev.Schema"] = {}
@@ -143,6 +146,36 @@ def _cast_to(x, t: str):
         x, ev.dtype_of(t))
 
 
+# -- numeric null support (in-band reserved values, core/event.py) -----------
+# The reference's executors pass boxed Java nulls through every operator:
+# arithmetic on null yields null, comparisons with null yield false
+# (CORE/executor/condition/compare/*, math/*).  Columnar equivalents below:
+# null detection is one fused compare per nullable operand; constants are
+# statically never null so filters on constants pay one extra AND at most.
+
+def _maybe_null(c: CompiledExpr) -> bool:
+    """Can this expression's column contain the reserved null value?"""
+    return not c.is_constant and c.type in (
+        "INT", "LONG", "FLOAT", "DOUBLE", "STRING", "OBJECT")
+
+
+def _null_of(c: CompiledExpr, val):
+    """Null mask of an operand's ORIGINAL (pre-promotion) value."""
+    return ev.null_mask(val, c.type)
+
+
+def _null_cast(x, from_t: str, to_t: str):
+    """astype that maps from_t's null representation onto to_t's (an int
+    sentinel cast to float must become NaN, not -9.2e18)."""
+    d = ev.dtype_of(to_t)
+    out = jnp.asarray(x).astype(d)
+    if from_t == to_t or from_t not in NUMERIC_TYPES or \
+            to_t not in NUMERIC_TYPES:
+        return out
+    return jnp.where(ev.null_mask(x, from_t),
+                     jnp.asarray(ev.null_value(to_t), d), out)
+
+
 def compile_expression(expr: Expression, scope: Scope) -> CompiledExpr:
     """Recursively compile an expression tree to a column function."""
     if isinstance(expr, Constant):
@@ -180,26 +213,46 @@ def compile_expression(expr: Expression, scope: Scope) -> CompiledExpr:
         r = compile_expression(expr.right, scope)
         t = promote(l.type, r.type)
         dtype = ev.dtype_of(t)
+        # null in → null out (reference: math executors return null on null)
+        null_check = _maybe_null(l) or _maybe_null(r)
+        nv = jnp.asarray(ev.null_value(t), dtype)
+
+        def _nullify(out, a, b, _l=l, _r=r, _nv=nv):
+            n = None
+            if _maybe_null(_l):
+                n = _null_of(_l, a)
+            if _maybe_null(_r):
+                rn = _null_of(_r, b)
+                n = rn if n is None else jnp.logical_or(n, rn)
+            return jnp.where(n, _nv, out) if n is not None else out
+
         op = {
             Add: jnp.add, Subtract: jnp.subtract, Multiply: jnp.multiply,
             Mod: jnp.mod,
         }.get(type(expr))
         if op is not None:
             def fn(env, _l=l.fn, _r=r.fn, _op=op, _d=dtype):
-                return _op(_l(env).astype(_d), _r(env).astype(_d))
+                a, b = _l(env), _r(env)
+                out = _op(jnp.asarray(a).astype(_d),
+                          jnp.asarray(b).astype(_d))
+                return _nullify(out, a, b) if null_check else out
             return CompiledExpr(fn, t)
         # divide: integer types use truncating division toward zero (Java /)
         if t in ("INT", "LONG"):
             def fn(env, _l=l.fn, _r=r.fn, _d=dtype):
-                a = _l(env).astype(_d)
-                b = _r(env).astype(_d)
+                a0, b0 = _l(env), _r(env)
+                a = jnp.asarray(a0).astype(_d)
+                b = jnp.asarray(b0).astype(_d)
                 q = jnp.where(b == 0, jnp.zeros_like(a), a)  # guard div0
                 b = jnp.where(b == 0, jnp.ones_like(b), b)
-                return (jnp.sign(q) * jnp.sign(b) *
-                        (jnp.abs(q) // jnp.abs(b))).astype(_d)
+                out = (jnp.sign(q) * jnp.sign(b) *
+                       (jnp.abs(q) // jnp.abs(b))).astype(_d)
+                return _nullify(out, a0, b0) if null_check else out
         else:
             def fn(env, _l=l.fn, _r=r.fn, _d=dtype):
-                return _l(env).astype(_d) / _r(env).astype(_d)
+                a0, b0 = _l(env), _r(env)
+                out = jnp.asarray(a0).astype(_d) / jnp.asarray(b0).astype(_d)
+                return _nullify(out, a0, b0) if null_check else out
         return CompiledExpr(fn, t)
 
     if isinstance(expr, Compare):
@@ -217,8 +270,21 @@ def compile_expression(expr: Expression, scope: Scope) -> CompiledExpr:
             "<": jnp.less, "<=": jnp.less_equal, ">": jnp.greater,
             ">=": jnp.greater_equal, "==": jnp.equal, "!=": jnp.not_equal,
         }[expr.operator]
+        # comparisons with null are FALSE (reference: every compare executor
+        # null-checks first, including null == null and null != x)
+        null_check = _maybe_null(l) or _maybe_null(r)
+
         def fn(env, _l=l.fn, _r=r.fn, _op=opf):
-            return _op(_l(env), _r(env))
+            a, b = _l(env), _r(env)
+            out = _op(a, b)
+            if null_check:
+                if _maybe_null(l):
+                    out = jnp.logical_and(out,
+                                          jnp.logical_not(_null_of(l, a)))
+                if _maybe_null(r):
+                    out = jnp.logical_and(out,
+                                          jnp.logical_not(_null_of(r, b)))
+            return out
         return CompiledExpr(fn, "BOOL")
 
     if isinstance(expr, And):
@@ -245,9 +311,10 @@ def compile_expression(expr: Expression, scope: Scope) -> CompiledExpr:
             # isNull(stream) in patterns — handled by the pattern runtime
             raise CompileError("stream-level is null only valid inside patterns")
         inner = compile_expression(expr.expression, scope)
-        if inner.type in ("STRING", "OBJECT"):
+        if _maybe_null(inner):
             return CompiledExpr(
-                lambda env, _i=inner.fn: _i(env) < 0, "BOOL")
+                lambda env, _i=inner.fn, _t=inner.type:
+                ev.null_mask(_i(env), _t), "BOOL")
         return CompiledExpr(
             lambda env, _i=inner.fn: jnp.zeros(jnp.shape(_i(env)), jnp.bool_),
             "BOOL")
@@ -283,6 +350,10 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
     if name in AGGREGATOR_NAMES and not expr.namespace:
         raise CompileError(
             f"aggregator {name!r} outside a select clause is not valid")
+    from .extension import attribute_aggregator_registry
+    if full in attribute_aggregator_registry():
+        raise CompileError(
+            f"aggregator {full!r} outside a select clause is not valid")
 
     def carg(i):
         return compile_expression(args[i], scope)
@@ -299,9 +370,9 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
             if target == src.type:
                 return src
             raise CompileError("string<->numeric cast requires host fallback")
-        d = ev.dtype_of(target)
-        return CompiledExpr(lambda env, _s=src.fn, _d=d: _s(env).astype(_d),
-                            target)
+        return CompiledExpr(
+            lambda env, _s=src, _t=target: _null_cast(_s.fn(env), _s.type, _t),
+            target)
 
     if full == "coalesce":
         compiled = [carg(i) for i in range(len(args))]
@@ -310,18 +381,26 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
             def fn(env, _c=compiled):
                 out = _c[0].fn(env)
                 for c in _c[1:]:
-                    out = jnp.where(out < 0, c.fn(env), out)
+                    out = jnp.where(out == ev.NULL_ID, c.fn(env), out)
                 return out
             return CompiledExpr(fn, t)
-        return compiled[0]  # numerics carry no null mask
+        for c in compiled[1:]:
+            t = promote(t, c.type)
+
+        def fn(env, _c=compiled, _t=t):
+            out = _null_cast(_c[0].fn(env), _c[0].type, _t)
+            for c in _c[1:]:
+                out = jnp.where(ev.null_mask(out, _t),
+                                _null_cast(c.fn(env), c.type, _t), out)
+            return out
+        return CompiledExpr(fn, t)
 
     if full == "ifThenElse":
         cond, then, els = carg(0), carg(1), carg(2)
         t = then.type if then.type == els.type else promote(then.type, els.type)
-        d = ev.dtype_of(t)
-        def fn(env, _c=cond.fn, _t=then.fn, _e=els.fn, _d=d):
-            return jnp.where(_c(env), jnp.asarray(_t(env), _d),
-                             jnp.asarray(_e(env), _d))
+        def fn(env, _c=cond.fn, _t=then, _e=els, _ty=t):
+            return jnp.where(_c(env), _null_cast(_t.fn(env), _t.type, _ty),
+                             _null_cast(_e.fn(env), _e.type, _ty))
         return CompiledExpr(fn, t)
 
     if full in ("maximum", "minimum"):
@@ -331,11 +410,24 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
             t = promote(t, c.type)
         d = ev.dtype_of(t)
         red = jnp.maximum if full == "maximum" else jnp.minimum
-        def fn(env, _c=compiled, _d=d, _r=red):
-            out = jnp.asarray(_c[0].fn(env), _d)
-            for c in _c[1:]:
-                out = _r(out, jnp.asarray(c.fn(env), _d))
-            return out
+        # nulls are SKIPPED, all-null returns null (reference:
+        # MaximumFunctionExecutor ignores null arguments)
+        ident = jnp.asarray(
+            (-jnp.inf if full == "maximum" else jnp.inf)
+            if d in (jnp.float32, jnp.float64)
+            else (jnp.iinfo(d).min + 1 if full == "maximum"
+                  else jnp.iinfo(d).max), d)
+
+        def fn(env, _c=compiled, _d=d, _r=red, _t=t, _id=ident):
+            out = None
+            allnull = None
+            for c in _c:
+                v = _null_cast(c.fn(env), c.type, _t)
+                n = ev.null_mask(v, _t)
+                lifted = jnp.where(n, _id, v)
+                out = lifted if out is None else _r(out, lifted)
+                allnull = n if allnull is None else jnp.logical_and(allnull, n)
+            return jnp.where(allnull, jnp.asarray(ev.null_value(_t), _d), out)
         return CompiledExpr(fn, t)
 
     if full == "createSet":
@@ -354,8 +446,11 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
     if full == "UUID":
         # one unique id per output event (reference: CORE/executor/function/
         # UUIDFunctionExecutor).  Device-side the column is the sentinel;
-        # Schema.decode_value turns each delivered cell into a fresh uuid4 —
-        # strings never ride the device
+        # materialization to real interned ids happens once at the emission/
+        # storage boundary (planners read this flag) — strings never ride
+        # the device
+        scope.uses_uuid = True
+
         def fn(env):
             return jnp.full(jnp.shape(env["__ts__"]), ev.UUID_SENTINEL,
                             ev.dtype_of("STRING"))
@@ -388,9 +483,14 @@ def _compile_function(expr: AttributeFunction, scope: Scope) -> CompiledExpr:
         if src.type in ("STRING", "OBJECT"):
             def fn(env, _s=src.fn, _d=dflt.fn):
                 v = _s(env)
-                return jnp.where(v < 0, _d(env), v)
+                return jnp.where(v == ev.NULL_ID, _d(env), v)
             return CompiledExpr(fn, src.type)
-        return src
+
+        def fn(env, _s=src, _d=dflt):
+            v = _s.fn(env)
+            return jnp.where(ev.null_mask(v, _s.type),
+                             _null_cast(_d.fn(env), _d.type, _s.type), v)
+        return CompiledExpr(fn, src.type)
 
     # math extension namespace (device-friendly subset)
     _MATH = {
@@ -431,16 +531,28 @@ def _extension_registry():
 
 
 def _build_script_callable(fd):
-    """Compile a `define function` body into a python callable
-    fn(data: list) -> value (reference: script function executors; body
-    convention mirrors the reference's javascript scripts — the arguments
-    arrive as the `data` list and the body returns the result)."""
-    import textwrap
+    """Compile a `define function` body into a host callable
+    fn(data: list) -> value through the registered script engine for the
+    definition's language (reference: Script extensions resolved via
+    ScriptExtensionHolder; python ships built in, others plug in with
+    @script_engine('<lang>'))."""
+    from .extension import script_engine_registry
     lang = (fd.language or "").lower()
-    if lang not in ("python", "py"):
+    engine = script_engine_registry().get(lang)
+    if engine is None:
+        known = sorted(script_engine_registry())
         raise CompileError(
             f"script language {fd.language!r} is not available in this "
-            f"runtime; define function {fd.id}[python] ...")
+            f"runtime (registered engines: {known}); define function "
+            f"{fd.id}[python] ... or register a @script_engine")
+    return engine(fd)
+
+
+def _python_script_engine(fd):
+    """Built-in python script engine: the body sees its arguments as the
+    `data` list and returns the result (the reference's javascript scripts
+    follow the same convention)."""
+    import textwrap
     body = textwrap.dedent(fd.body).strip("\n")
     ns: Dict[str, Any] = {"np": __import__("numpy"),
                           "math": __import__("math")}
@@ -508,3 +620,11 @@ def _compile_script_function(fd, expr: AttributeFunction,
         return _jax.pure_callback(host, sds, *vals, vmap_method="expand_dims")
 
     return CompiledExpr(fn, rtype)
+
+
+# the built-in script engine registers through the same SPI custom engines
+# use (reference: core ships the javascript Script extension the same way)
+from .extension import script_engine as _script_engine  # noqa: E402
+
+_script_engine("python", replace=True)(_python_script_engine)
+_script_engine("py", replace=True)(_python_script_engine)

@@ -35,7 +35,48 @@ class ExtensionMeta:
 
 _SCALAR_FUNCTIONS: Dict[str, Callable] = {}
 _WINDOW_TYPES: Dict[str, type] = {}
+_ATTRIBUTE_AGGREGATORS: Dict[str, type] = {}
+_SCRIPT_ENGINES: Dict[str, Callable] = {}
 _METADATA: Dict[str, ExtensionMeta] = {}
+
+
+class AttributeAggregator:
+    """Custom attribute aggregator SPI (reference: custom @Extension
+    AttributeAggregatorExecutors resolved through
+    AttributeAggregatorExtensionHolder, CORE/util/extension/holder/
+    AttributeAggregatorExtensionHolder.java).
+
+    TPU design: instead of the reference's per-event processAdd/processRemove
+    object, a custom aggregator CONTRIBUTES accumulator columns to the
+    query's segmented-scan bank (core/selector.py AggregatorBank) — the same
+    machinery the 14 built-ins compile into, so customs jit and shard
+    identically.  Subclass and implement `build`:
+
+        @attribute_aggregator('custom:geomMean', return_type='DOUBLE')
+        class GeomMean(AttributeAggregator):
+            def build(self, args, add_spec, expr_key):
+                # args: list[CompiledExpr] (compiled call arguments)
+                # add_spec(suffix, op, init, dtype, vals_fn) -> spec index;
+                #   vals_fn(env, sign) -> [B] per-row contribution, sign is
+                #   +1 for CURRENT rows, -1 for EXPIRED (window retraction)
+                a = args[0]
+                i_log = add_spec('logsum', jnp.add, 0.0, jnp.float32,
+                                 lambda env, s: jnp.log(a.fn(env)) * s)
+                i_cnt = add_spec('cnt', jnp.add, 0, jnp.int64,
+                                 lambda env, s: jnp.asarray(s, jnp.int64))
+                def result(res):
+                    c = jnp.maximum(res[i_cnt], 1)
+                    return jnp.exp(res[i_log] / c.astype(jnp.float32))
+                return result
+
+    `result(scan_results)` maps the per-row running accumulator values to
+    the output column.  Set `return_type` (SiddhiQL type string) on the
+    class or return `(type, result)` from build to override per-call."""
+
+    return_type: str = "DOUBLE"
+
+    def build(self, args, add_spec, expr_key):
+        raise NotImplementedError
 
 
 def _validate(name: str, kind: str, replace: bool) -> None:
@@ -92,6 +133,110 @@ def window_extension(name: str, description: str = "",
             list(parameters or []))
         return cls
     return deco
+
+
+def attribute_aggregator(name: str, return_type: str = "",
+                         description: str = "",
+                         parameters: Optional[List[str]] = None,
+                         replace: bool = False):
+    """Register a custom attribute aggregator usable from SiddhiQL as
+    `namespace:name(args)` in select/having clauses."""
+    def deco(cls):
+        if not (isinstance(cls, type) and
+                issubclass(cls, AttributeAggregator)):
+            raise CompileError(
+                f"{name!r}: attribute aggregators subclass "
+                f"AttributeAggregator")
+        _validate(name, "attribute_aggregator", replace)
+        if not replace:
+            from .executor import AGGREGATOR_NAMES
+            if name in _ATTRIBUTE_AGGREGATORS or name in AGGREGATOR_NAMES:
+                raise CompileError(
+                    f"aggregator {name!r} is already registered; pass "
+                    f"replace=True to override")
+        if return_type:
+            cls.return_type = return_type.upper()
+        _ATTRIBUTE_AGGREGATORS[name] = cls
+        _METADATA[f"attribute_aggregator:{name}"] = ExtensionMeta(
+            name, "attribute_aggregator",
+            description or (cls.__doc__ or "").strip().split("\n")[0],
+            list(parameters or []), cls.return_type)
+        return cls
+    return deco
+
+
+def attribute_aggregator_registry() -> Dict[str, type]:
+    return _ATTRIBUTE_AGGREGATORS
+
+
+def source_mapper(name: str, description: str = "", replace: bool = False):
+    """Register a custom @map(type='<name>') payload->events mapper
+    (reference: custom SourceMapper @Extensions via
+    SourceMapperExtensionHolder)."""
+    def deco(cls):
+        from ..io.mappers import SOURCE_MAPPERS, SourceMapper as _Base
+        if not (isinstance(cls, type) and issubclass(cls, _Base)):
+            raise CompileError(
+                f"{name!r}: source mappers subclass io.mappers.SourceMapper")
+        _validate(name, "source_mapper", replace)
+        if not replace and name in SOURCE_MAPPERS:
+            raise CompileError(
+                f"source mapper {name!r} is already registered; pass "
+                f"replace=True to override")
+        SOURCE_MAPPERS[name] = cls
+        _METADATA[f"source_mapper:{name}"] = ExtensionMeta(
+            name, "source_mapper",
+            description or (cls.__doc__ or "").strip().split("\n")[0])
+        return cls
+    return deco
+
+
+def sink_mapper(name: str, description: str = "", replace: bool = False):
+    """Register a custom @map(type='<name>') events->payload mapper
+    (reference: custom SinkMapper @Extensions via
+    SinkMapperExtensionHolder)."""
+    def deco(cls):
+        from ..io.mappers import SINK_MAPPERS, SinkMapper as _Base
+        if not (isinstance(cls, type) and issubclass(cls, _Base)):
+            raise CompileError(
+                f"{name!r}: sink mappers subclass io.mappers.SinkMapper")
+        _validate(name, "sink_mapper", replace)
+        if not replace and name in SINK_MAPPERS:
+            raise CompileError(
+                f"sink mapper {name!r} is already registered; pass "
+                f"replace=True to override")
+        SINK_MAPPERS[name] = cls
+        _METADATA[f"sink_mapper:{name}"] = ExtensionMeta(
+            name, "sink_mapper",
+            description or (cls.__doc__ or "").strip().split("\n")[0])
+        return cls
+    return deco
+
+
+def script_engine(language: str, replace: bool = False):
+    """Register a `define function f[<language>] ...` script engine
+    (reference: Script extension type via ScriptExtensionHolder; core ships
+    javascript — here python is built in and other engines plug in).
+
+    The decorated callable receives the FunctionDefinition and returns a
+    host callable fn(data: list) -> value, invoked per row batch via
+    jax.pure_callback."""
+    def deco(fn):
+        key = language.lower()
+        if not replace and key in _SCRIPT_ENGINES:
+            raise CompileError(
+                f"script engine {language!r} is already registered; pass "
+                f"replace=True to override")
+        _SCRIPT_ENGINES[key] = fn
+        _METADATA[f"script_engine:{key}"] = ExtensionMeta(
+            key, "script_engine",
+            (fn.__doc__ or "").strip().split("\n")[0])
+        return fn
+    return deco
+
+
+def script_engine_registry() -> Dict[str, Callable]:
+    return _SCRIPT_ENGINES
 
 
 def extension_metadata() -> Dict[str, ExtensionMeta]:

@@ -74,6 +74,8 @@ class PlannedJoinQuery:
     slot_allocator2: Optional[Any] = None     # right-side group allocator
     gl_pos: List[int] = dataclasses.field(default_factory=list)
     gr_pos: List[int] = dataclasses.field(default_factory=list)
+    # UUID() appears in this query: emission materializes sentinels once
+    emits_uuid: bool = False
 
 
 def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
@@ -96,8 +98,15 @@ def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
                 f"probe-able buffer for joins")
         schema = nw.schema
         scope.add_source(key, schema, alias=None)
-        return JoinSide(sid, key, schema, None, is_table=True,
-                        is_named_window=True)
+        # bidirectional (reference: Window.java:145-184 — the join both
+        # probes the shared window's buffer AND triggers on events flowing
+        # through it).  The trigger path gets a pass-through window: rows
+        # the named window emits probe the other side; retention lives in
+        # the NamedWindowRuntime, never here.
+        from .window import PassAllWindow
+        return JoinSide(sid, key, schema,
+                        PassAllWindow(schema, [], batch_capacity),
+                        is_table=True, is_named_window=True)
     is_table = sid in tables
     schema = tables[sid].schema if is_table else schemas[sid]
     scope.add_source(key, schema, alias=None)
@@ -121,6 +130,23 @@ def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
     return side
 
 
+def _constrain_state(state, mesh):
+    """Pin the persistent state's sharding INSIDE the jitted step.  The
+    host-side device_put in JoinQueryRuntime.place_state only seeds the
+    layout; without an in-graph constraint GSPMD is free to (and does)
+    choose replicated output shardings, silently un-distributing the
+    window buffers after the first step.  One constraint per eligible leaf
+    keeps each buffer at 1/n rows per device across steps."""
+    if mesh is None or mesh.devices.size < 2:
+        return state
+    from .shardsafe import axis0_sharding
+
+    def _c(x):
+        s = axis0_sharding(mesh, x)
+        return jax.lax.with_sharding_constraint(x, s) if s is not None else x
+    return jax.tree.map(_c, state)
+
+
 def plan_join_query(
     query: Query,
     name: str,
@@ -131,6 +157,7 @@ def plan_join_query(
     window_capacity_hint: int = 512,
     aggregations=None,
     named_windows=None,
+    mesh=None,
 ) -> PlannedJoinQuery:
     jis = query.input_stream
     assert isinstance(jis, JoinInputStream)
@@ -141,11 +168,8 @@ def plan_join_query(
     right = _mk_side(jis.right_input_stream, schemas, tables, batch_capacity,
                      scope, window_capacity_hint, aggregations,
                      named_windows)
-    if left.is_table and right.is_table:
-        if left.is_named_window or right.is_named_window:
-            raise CompileError(
-                "a named-window join side is probe-only here: join it "
-                "against a stream side that triggers the query")
+    if left.is_table and right.is_table and \
+            not (left.is_named_window or right.is_named_window):
         raise CompileError("cannot join two tables in a streaming query")
     if not left.is_table and not right.is_table and (
             isinstance(left.window, NoWindow) or
@@ -296,9 +320,12 @@ def plan_join_query(
 
             N = all_valid.shape[0]
             this_cols = tuple(c[li] for c in orows.cols)
+            # unmatched outer-join rows carry REAL nulls on the other side
+            # (reference: JoinProcessor.java:107-190 emits null attributes;
+            # numerics use the reserved in-band null, core/event.py)
             other_cols_g = tuple(
                 jnp.where(null_tail,
-                          jnp.asarray(ev.default_value(t), dtype=c.dtype),
+                          jnp.asarray(ev.null_value(t), dtype=c.dtype),
                           c[ri])
                 for c, t in zip(o_cols, other.schema.types))
             sel_env = {
@@ -327,21 +354,27 @@ def plan_join_query(
             sel_state, out = sel.process(sel_state, jrows, sel_env)
             nstate = ((this_state, other_state) if this_is_left
                       else (other_state, this_state))
-            return (nstate[0], nstate[1], sel_state), out, wout.next_wakeup
+            new_state = _constrain_state(
+                (nstate[0], nstate[1], sel_state), mesh)
+            return new_state, out, wout.next_wakeup
 
         return jax.jit(step, donate_argnums=(0,))
 
     step_left = None
     step_right = None
-    if not left.is_table and trigger in ("ALL_EVENTS", "LEFT"):
+    # named-window sides trigger too (bidirectional, Window.java:145-184);
+    # plain table/aggregation sides stay probe-only
+    if (not left.is_table or left.is_named_window) and \
+            trigger in ("ALL_EVENTS", "LEFT"):
         step_left = make_step(left, right, True)
-    if not right.is_table and trigger in ("ALL_EVENTS", "RIGHT"):
+    if (not right.is_table or right.is_named_window) and \
+            trigger in ("ALL_EVENTS", "RIGHT"):
         step_right = make_step(right, left, False)
     # non-triggering stream sides still need their window maintained
     if not left.is_table and step_left is None:
-        step_left = _make_feed_only(left, True)
+        step_left = _make_feed_only(left, True, mesh)
     if not right.is_table and step_right is None:
-        step_right = _make_feed_only(right, False)
+        step_right = _make_feed_only(right, False, mesh)
 
     def init_state():
         wl = left.window.init_state() if left.window else ()
@@ -363,10 +396,11 @@ def plan_join_query(
         slot_allocator=gl_alloc, slot_allocator2=gr_alloc,
         gl_pos=gl_pos, gr_pos=gr_pos,
         needs_timer=(left.window is not None and left.window.needs_timer) or
-                    (right.window is not None and right.window.needs_timer))
+                    (right.window is not None and right.window.needs_timer),
+        emits_uuid=scope.uses_uuid)
 
 
-def _make_feed_only(side: JoinSide, is_left: bool):
+def _make_feed_only(side: JoinSide, is_left: bool, mesh=None):
     def step(state, ts, kind, valid, cols, gslot, other_table_cols, now):
         wl_state, wr_state, sel_state = state
         this_state = wl_state if is_left else wr_state
@@ -382,9 +416,9 @@ def _make_feed_only(side: JoinSide, is_left: bool):
         out_empty = (
             jnp.zeros((1,), jnp.int64), jnp.zeros((1,), jnp.int32),
             jnp.zeros((1,), jnp.bool_), tuple())
-        if is_left:
-            return (this_state, wr_state, sel_state), out_empty, \
-                wout.next_wakeup
-        return (wl_state, this_state, sel_state), out_empty, wout.next_wakeup
+        new_state = (this_state, wr_state, sel_state) if is_left else \
+            (wl_state, this_state, sel_state)
+        return _constrain_state(new_state, mesh), out_empty, \
+            wout.next_wakeup
 
     return jax.jit(step, donate_argnums=(0,))

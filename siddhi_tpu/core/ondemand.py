@@ -213,32 +213,48 @@ def _find(rt, oq, scope, schema, env, mask, key) -> List[ev.Event]:
             else:
                 out_cols.append(vals)
             continue
-        # aggregate
+        # aggregate (null inputs skipped, empty aggregates return null —
+        # same contract as the streaming AggregatorBank)
         if agg == "count":
             vals = np.ones((idx.size,), np.float64)
+            nul = np.zeros((idx.size,), bool)
             out_types.append("LONG")
         else:
             c = compile_expression(expr.parameters[0], scope)
-            vals = np.asarray(c.fn(env), np.float64)[idx] if idx.size else \
-                np.zeros((0,), np.float64)
+            raw_t = np.asarray(c.fn(env))
+            if raw_t.ndim == 0:
+                raw_t = np.broadcast_to(raw_t, mask.shape)
+            rv = raw_t[idx] if idx.size else \
+                np.zeros((0,), ev.np_dtype(c.type))
+            nul = np.asarray(ev.null_mask(rv, c.type))
+            vals = rv.astype(np.float64)
             out_types.append("DOUBLE" if agg in ("avg",) else
                              ("LONG" if c.type in ("INT", "LONG") and
                               agg in ("sum", "min", "max") else c.type
                               if agg in ("min", "max") else "DOUBLE"))
+        out_t = out_types[-1]
+        nullv = float(ev.null_value(out_t)) if out_t != "LONG" \
+            else float(ev.NULL_LONG)
+        nonnull = np.zeros((max(n_groups, 1),), np.float64)
+        np.add.at(nonnull, inv, (~nul).astype(np.float64))
         acc = np.zeros((max(n_groups, 1),), np.float64)
         if agg in ("sum", "count"):
-            np.add.at(acc, inv, vals)
+            np.add.at(acc, inv, np.where(nul, 0.0, vals))
+            if agg == "sum":
+                acc = np.where(nonnull > 0, acc, nullv)
         elif agg == "avg":
             cnt = np.zeros_like(acc)
-            np.add.at(acc, inv, vals)
-            np.add.at(cnt, inv, np.ones_like(vals))
-            acc = np.where(cnt > 0, acc / np.maximum(cnt, 1), 0.0)
+            np.add.at(acc, inv, np.where(nul, 0.0, vals))
+            np.add.at(cnt, inv, (~nul).astype(np.float64))
+            acc = np.where(cnt > 0, acc / np.maximum(cnt, 1), np.nan)
         elif agg == "min":
             acc[:] = np.inf
-            np.minimum.at(acc, inv, vals)
+            np.minimum.at(acc, inv, np.where(nul, np.inf, vals))
+            acc = np.where(nonnull > 0, acc, nullv)
         elif agg == "max":
             acc[:] = -np.inf
-            np.maximum.at(acc, inv, vals)
+            np.maximum.at(acc, inv, np.where(nul, -np.inf, vals))
+            acc = np.where(nonnull > 0, acc, nullv)
         elif agg == "distinctCount":
             acc = np.zeros((max(n_groups, 1),), np.float64)
             for g in range(n_groups):

@@ -218,8 +218,12 @@ class PatternExec:
                 self.scope.add_source(a.ref, schemas[a.stream_id])
 
         # per-atom filter scopes: unqualified attrs bind to the atom's OWN
-        # stream (the incoming event); qualified refs reach earlier captures
+        # stream (the incoming event); qualified refs reach earlier captures.
+        # `x in Table` conditions compile to device probes against the
+        # table's column snapshot, shipped into the step as in_tabs
+        # (reference: InConditionExpressionExecutor inside NFA filters)
         self._filters: Dict[str, Optional[CompiledExpr]] = {}
+        self.in_deps: List[str] = []
         for a in spec.all_atoms():
             if a.filter_expr is None:
                 self._filters[a.ckey] = None
@@ -233,14 +237,9 @@ class PatternExec:
                     fscope.add_source(other.ref, schemas[other.stream_id],
                                       default=False)
             from ..query_api.expression import In, walk
-            if any(isinstance(n, In) for n in walk(a.filter_expr)):
-                # the In-probe rides the plain-query step env; pattern
-                # steps have no table plumbing yet — fail at compile time
-                # instead of a runtime KeyError
-                raise CompileError(
-                    "`in <table>` inside pattern/sequence filters is not "
-                    "supported; join the match output against the table "
-                    "instead")
+            for n in walk(a.filter_expr):
+                if isinstance(n, In) and n.source_id not in self.in_deps:
+                    self.in_deps.append(n.source_id)
             self._filters[a.ckey] = compile_expression(a.filter_expr, fscope)
 
     # -- state ----------------------------------------------------------------
@@ -271,7 +270,7 @@ class PatternExec:
 
     # -- one event per key ----------------------------------------------------
     def tick(self, st: PatternState, stream_id: str, ev_cols, ev_ts,
-             ev_valid, now_k):
+             ev_valid, now_k, in_tabs=()):
         spec = self.spec
         S = self.S
         P, K = st.active.shape
@@ -308,7 +307,7 @@ class PatternExec:
                 )
 
         # ---- phase 3: match evaluation (pre-capture state) -----------------
-        env = self._build_env(st, stream_id, ev_cols, ev_ts)
+        env = self._build_env(st, stream_id, ev_cols, ev_ts, in_tabs)
         ev_ok = jnp.logical_and(ev_valid, jnp.logical_not(st.done))   # [K]
 
         advance_inplace = F
@@ -650,8 +649,17 @@ class PatternExec:
         return st._replace(caps=newcaps)
 
     # -- env ------------------------------------------------------------------
-    def _build_env(self, st: PatternState, stream_id: str, ev_cols, ev_ts):
+    def _build_env(self, st: PatternState, stream_id: str, ev_cols, ev_ts,
+                   in_tabs=()):
         env: Dict[str, Any] = {"__ts__": ev_ts[None, :]}
+        # `x in Table` probes: one dense compare against the table's first
+        # column snapshot, broadcasting over whatever shape the filter's
+        # operand carries ([P,K] slabs here, [B] in plain queries)
+        for dep, (tcol0, tvalid) in zip(self.in_deps, in_tabs):
+            def probe(vals, _tc=tcol0, _tv=tvalid):
+                return jnp.any(
+                    jnp.logical_and(vals[..., None] == _tc, _tv), axis=-1)
+            env["__in__:" + dep] = probe
         for a in self.spec.all_atoms():
             if a.absent:
                 continue

@@ -146,6 +146,8 @@ class PlannedPatternQuery:
     # the SelectorExec whose per-key accumulator slabs ride sel_state —
     # purge resets them through bank.specs (init values / slot spaces)
     selector_exec: Any = None
+    # UUID() appears in this query: emission materializes sentinels once
+    emits_uuid: bool = False
 
 
 def plan_pattern_query(
@@ -206,7 +208,8 @@ def plan_pattern_query(
     def make_step(stream_id: str, dense: bool = False):
         schema = schemas[stream_id]
 
-        def step(packed, sel_state, raw_cols, raw_ts, sel_idx, key_ref, now):
+        def step(packed, sel_state, raw_cols, raw_ts, sel_idx, key_ref, now,
+                 in_tabs=()):
             # raw_cols/raw_ts are the UNGROUPED batch [B]; sel_idx [Kb,E]
             # holds batch indices (-1 = padding).  The [Kb,E] gather happens
             # here on device (~60us) so the host ships ~40% fewer bytes and
@@ -241,7 +244,7 @@ def plan_pattern_query(
                 cols_e, ts_e, valid_e = xs
                 now_k = jnp.where(valid_e, ts_e, now)
                 st, emit = pexec.tick(st, stream_id, cols_e, ts_e, valid_e,
-                                      now_k)
+                                      now_k, in_tabs)
                 return st, emit
 
             xs = (tuple(c.T for c in cols), ts.T, valid.T)   # scan over E
@@ -282,7 +285,7 @@ def plan_pattern_query(
         any_sid = spec.stream_ids[0]
         schema0 = schemas[any_sid]
 
-        def tstep(packed, sel_state, now):
+        def tstep(packed, sel_state, now, in_tabs=()):
             b32, b64, scalars = packed
             pstate = packer.unpack(b32, b64, scalars)
             K = pstate.active.shape[-1]
@@ -293,7 +296,7 @@ def plan_pattern_query(
             valid_e = jnp.zeros((K,), jnp.bool_)
             now_k = jnp.full((K,), now, jnp.int64)
             st, emit = pexec.tick(pstate, any_sid, zero_cols, ts_e, valid_e,
-                                  now_k)
+                                  now_k, in_tabs)
             emits = jax.tree.map(lambda x: x[None], emit)  # E=1
             ord_ = jnp.zeros((K, 1), jnp.int64)
             sel_state, out, wake = _emit_matches(
@@ -326,7 +329,7 @@ def plan_pattern_query(
         partition_positions=partition_positions,
         partition_key_fns=partition_key_fns,
         raw_steps=raw_steps, mesh=mesh, emit_explicit=emit_explicit,
-        selector_exec=sel)
+        selector_exec=sel, emits_uuid=pexec.scope.uses_uuid)
 
 
 def _first_schema(spec: PatternSpec, schemas) -> ev.Schema:
@@ -387,7 +390,8 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
     bspec = P("shard")    # sharded inputs: [n*Kb, ...] on axis 0
     rspec = P()           # raw event columns [B]: replicated to all shards
 
-    def local(packed, sel_state, raw_cols, raw_ts, sel, key_idx, now):
+    def local(packed, sel_state, raw_cols, raw_ts, sel, key_idx, now,
+              in_tabs=()):
         b32, b64, scalars = packed
         old_scalars = scalars
         # replicated scalar counters become device-varying inside; mark them
@@ -396,8 +400,10 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
         raw_cols = tuple(lax.pcast(c, ("shard",), to="varying")
                          for c in raw_cols)
         raw_ts = lax.pcast(raw_ts, ("shard",), to="varying")
+        in_tabs = jax.tree.map(
+            lambda x: lax.pcast(x, ("shard",), to="varying"), in_tabs)
         ps, ss, out, wake = body((b32, b64, scalars), sel_state, raw_cols,
-                                 raw_ts, sel, key_idx, now)
+                                 raw_ts, sel, key_idx, now, in_tabs)
         out = (lax.psum(out[0], "shard"), lax.psum(out[1], "shard")) + out[2:]
         nb32, nb64, nscal = ps
         # re-replicate scalar counters: old + psum(local delta)
@@ -410,7 +416,7 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
 
     sharded = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(pspec, sspec, rspec, rspec, bspec, bspec, P()),
+        in_specs=(pspec, sspec, rspec, rspec, bspec, bspec, P(), P()),
         out_specs=(pspec, sspec, (P(), P(), bspec, bspec, bspec, bspec), P()))
     return jax.jit(sharded, donate_argnums=(0, 1))
 

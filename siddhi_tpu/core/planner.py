@@ -77,6 +77,8 @@ class PlannedQuery:
     # set when the keyed-window slab is sharded (key k at row
     # (k % n) * (K/n) + k // n; selector state stays replicated)
     keyed_mesh: Any = None
+    # UUID() appears in this query: emission materializes sentinels once
+    emits_uuid: bool = False
 
 
 def _env_for(scope_key: str, cols, ts):
@@ -577,4 +579,5 @@ def plan_single_query(
         pair_allocs=pair_allocs,
         mesh=plain_mesh,
         keyed_mesh=keyed_mesh,
+        emits_uuid=scope.uses_uuid,
     )

@@ -141,6 +141,7 @@ class InputHandler:
 
     def send(self, data, timestamp: Optional[int] = None) -> None:
         """Accepts one event's data list/tuple, an Event, or a list of those."""
+        self._runtime._gate_wait()     # entry valve, see _gate_wait
         events = self._to_events(data, timestamp)
         self._runtime._route(self.stream_id, events)
 
@@ -159,6 +160,7 @@ class InputHandler:
         """Columnar high-throughput ingestion: `cols` is a sequence of numpy
         arrays (one per attribute, equal length; strings pre-encoded as
         interner ids).  Bypasses per-event Python staging."""
+        self._runtime._gate_wait()     # entry valve, see _gate_wait
         self._runtime._route_columns(self.stream_id, cols, timestamps)
 
 
@@ -222,9 +224,7 @@ class QueryRuntime:
                 [gslot, staged.cols[pos]], valid))
             for alloc, pos in p.pair_allocs)
         batch = staged.to_device(p.in_schema)
-        in_tabs = tuple(
-            (self.app.tables[d].cols[0], self.app.tables[d].valid)
-            for d in p.in_deps)
+        in_tabs = self.app.in_probe_tables(p.in_deps)
         self.state, out, wake = p.step(
             self.state, batch.ts, batch.kind, batch.valid, batch.cols,
             jax.numpy.asarray(gslot), jax.numpy.asarray(now, jax.numpy.int64),
@@ -278,9 +278,7 @@ class QueryRuntime:
             gslot = np.zeros((staged.ts.shape[0],), np.int32)
         batch = ev.StagedBatch(staged.ts, staged.kind, valid, staged.cols,
                                staged.n).to_device(p.in_schema)
-        in_tabs = tuple(
-            (self.app.tables[d].cols[0], self.app.tables[d].valid)
-            for d in p.in_deps)
+        in_tabs = self.app.in_probe_tables(p.in_deps)
         self.state, out, wake = p.step(
             self.state, batch.ts, batch.kind, batch.valid, batch.cols,
             jax.numpy.asarray(gslot), jax.numpy.asarray(key_idx),
@@ -341,6 +339,12 @@ class PatternQueryRuntime:
     def name(self):
         return self.planned.name
 
+    def _in_tabs(self):
+        """Table snapshots for `x in Table` probes inside NFA filters
+        (reference: InConditionExpressionExecutor in pattern conditions)."""
+        return self.app.in_probe_tables(
+            getattr(self.planned.exec, "in_deps", None) or ())
+
     def process_staged(self, stream_id: str, staged: ev.StagedBatch,
                        now: int) -> None:
         p = self.planned
@@ -385,7 +389,8 @@ class PatternQueryRuntime:
                 pstate, sel_state, out, wake = p.dense_steps[stream_id](
                     pstate, sel_state, raw_cols, raw_ts, sel_d,
                     jax.numpy.asarray(int(key_idx_np[0]), jax.numpy.int32),
-                    jax.numpy.asarray(now, jax.numpy.int64))
+                    jax.numpy.asarray(now, jax.numpy.int64),
+                    self._in_tabs())
                 self.state = (pstate, sel_state)
                 _emit_output(self, out, now, wake=self._wake_arg(wake))
                 return
@@ -398,7 +403,7 @@ class PatternQueryRuntime:
         pstate, sel_state = self.state
         pstate, sel_state, out, wake = p.steps[stream_id](
             pstate, sel_state, raw_cols, raw_ts, sel_d, key_idx,
-            jax.numpy.asarray(now, jax.numpy.int64))
+            jax.numpy.asarray(now, jax.numpy.int64), self._in_tabs())
         self.state = (pstate, sel_state)
         _emit_output(self, out, now, wake=self._wake_arg(wake))
 
@@ -450,7 +455,7 @@ class PatternQueryRuntime:
             jax.numpy.asarray(staged.ts),
             jax.numpy.asarray(flat(sel)),
             jax.numpy.asarray(flat(key_idx)),
-            jax.numpy.asarray(now, jax.numpy.int64))
+            jax.numpy.asarray(now, jax.numpy.int64), self._in_tabs())
         self.state = (pstate, sel_state)
         _emit_output(self, out, now, wake=self._wake_arg(wake))
 
@@ -460,7 +465,8 @@ class PatternQueryRuntime:
             return
         pstate, sel_state = self.state
         pstate, sel_state, out, wake, changed = p.timer_step(
-            pstate, sel_state, jax.numpy.asarray(now, jax.numpy.int64))
+            pstate, sel_state, jax.numpy.asarray(now, jax.numpy.int64),
+            self._in_tabs())
         self.state = (pstate, sel_state)
         if self._dirty is not None:
             # timer-driven expiry/absent firing mutates key NFA state;
@@ -675,6 +681,21 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
             ovalid_np = np.asarray(ovalid)
             if not ovalid_np.any():
                 return
+        if getattr(p, "emits_uuid", False):
+            # UUID() sentinels materialize ONCE here, at the device->host
+            # emission boundary, so every consumer of this emission (event
+            # callbacks, batch payloads, downstream routing, table writes)
+            # observes the same id per row
+            if len(out) == 6:
+                ots, okind, ovalid, ocols = jax.device_get(
+                    (ots, okind, ovalid, ocols))
+            changed = ev.materialize_uuid_sentinels(
+                p.out_schema, np.asarray(ovalid), ocols)
+            if changed:
+                oc = list(ocols)
+                for pos, col in changed:
+                    oc[pos] = col
+                ocols = tuple(oc)
         if qr.batch_callbacks:
             payload = _LazyBatchPayload(p.out_schema.names, ots, okind,
                                         ovalid, ocols, counts)
@@ -813,15 +834,11 @@ class JoinQueryRuntime:
         mesh = getattr(self.app, "mesh", None)
         if mesh is None or mesh.devices.size < 2:
             return state
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        n = mesh.devices.size
+        from .shardsafe import axis0_sharding
 
         def _place(x):
-            if getattr(x, "ndim", 0) >= 1 and x.shape[0] >= n and \
-                    x.shape[0] % n == 0:
-                spec = P(*(["shard"] + [None] * (x.ndim - 1)))
-                return jax.device_put(x, NamedSharding(mesh, spec))
-            return x
+            s = axis0_sharding(mesh, x)
+            return jax.device_put(x, s) if s is not None else x
         return jax.tree.map(_place, state)
 
     def _other_table(self, is_left):
@@ -1058,6 +1075,10 @@ class StreamJunction:
             t = threading.Thread(
                 target=self._drain_async, daemon=True,
                 name=f"siddhi-ingest-{self.stream_id}-{i}")
+            # exempt from the snapshot ingress gate: a worker whose callback
+            # re-ingests must keep draining or _quiesce's queue join would
+            # deadlock against the closed gate
+            t._siddhi_internal = True
             t.start()
             self._async_workers.append(t)
 
@@ -1393,6 +1414,7 @@ class _EmissionDrainer:
         self._q = queue.Queue(maxsize=capacity)
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="siddhi-drain")
+        self._thread._siddhi_internal = True   # see StreamJunction workers
         self._stop = object()
         self._started = False
 
@@ -1494,6 +1516,7 @@ class _Scheduler:
         self._running = True
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="siddhi-scheduler")
+        self._thread._siddhi_internal = True   # see StreamJunction workers
         self._thread.start()
 
     def drain_playback(self, now: int) -> None:
@@ -1570,6 +1593,11 @@ class SiddhiAppRuntime:
         self.config_manager = manager.config_manager
         self.objects = ev.ObjectRegistry()
         self._lock = threading.RLock()
+        # open => InputHandler sends flow; cleared by _quiesce so snapshots
+        # can drain async queues without racing persistent producers
+        # (reference: ThreadBarrier, CORE/util/ThreadBarrier.java:27)
+        self._ingress_gate = threading.Event()
+        self._ingress_gate.set()
         self._scheduler = _Scheduler(self)
         self._drainer = _EmissionDrainer()
         self._started = False
@@ -1754,6 +1782,8 @@ class SiddhiAppRuntime:
             planned = plan_pattern_query(
                 q, name, self.schemas, self.interner,
                 script_functions=self.app.function_definition_map)
+            self._validate_in_deps(
+                getattr(planned.exec, "in_deps", ()), name)
             runtime = PatternQueryRuntime(planned, self)
             runtime.async_emit = self._async_enabled(q)
             self.query_runtimes[name] = runtime
@@ -1782,6 +1812,7 @@ class SiddhiAppRuntime:
             window_capacity_hint=wch,
             config_manager=self.config_manager,
             script_functions=self.app.function_definition_map)
+        self._validate_in_deps(planned.in_deps, name)
         runtime = QueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
         self.query_runtimes[name] = runtime
@@ -1904,19 +1935,28 @@ class SiddhiAppRuntime:
         planned = plan_join_query(q, name, self.schemas, self.tables,
                                   self.interner,
                                   aggregations=self.aggregations,
-                                  named_windows=self.named_windows)
+                                  named_windows=self.named_windows,
+                                  mesh=self.mesh)
         runtime = JoinQueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
         self.query_runtimes[name] = runtime
         for side, is_left in ((planned.left, True), (planned.right, False)):
-            if not side.is_table:
-                class _JSub:
-                    def __init__(self, qr, left):
-                        self._qr, self._left = qr, left
+            class _JSub:
+                def __init__(self, qr, left):
+                    self._qr, self._left = qr, left
 
-                    def process_staged(self, staged, now):
-                        self._qr.process_staged(self._left, staged, now)
+                def process_staged(self, staged, now):
+                    self._qr.process_staged(self._left, staged, now)
+            if not side.is_table:
                 self.junctions[side.stream_id].subscribe_query(
+                    _JSub(runtime, is_left))
+            elif side.is_named_window and (
+                    planned.step_left if is_left else
+                    planned.step_right) is not None:
+                # bidirectional named-window join: events flowing through
+                # the shared window trigger the join side too (reference:
+                # Window.java:145-184 publishes to subscribing queries)
+                self.named_windows[side.stream_id].subscribers.append(
                     _JSub(runtime, is_left))
         self._wire_output(runtime, q, planned, name)
 
@@ -2035,6 +2075,8 @@ class SiddhiAppRuntime:
                     partition_positions=ppos,
                     partition_key_fns=pfns or None, mesh=self.mesh,
                     script_functions=self.app.function_definition_map)
+                self._validate_in_deps(
+                    getattr(planned.exec, "in_deps", ()), qname)
                 runtime = PatternQueryRuntime(planned, self,
                                               slot_allocator=shared_allocator)
                 runtime.async_emit = self._async_enabled(q)
@@ -2119,6 +2161,7 @@ class SiddhiAppRuntime:
                     config_manager=self.config_manager,
                     script_functions=self.app.function_definition_map,
                     mesh=self.mesh)
+                self._validate_in_deps(planned.in_deps, qname)
                 runtime = QueryRuntime(planned, self)
                 self.query_runtimes[qname] = runtime
                 part_runtimes.append(runtime)
@@ -2237,22 +2280,61 @@ class SiddhiAppRuntime:
             "flush() gave up after 64 rounds with async batches still "
             "pending (sustained re-ingestion?)")
 
+    def in_probe_tables(self, deps):
+        """Snapshots for `x in Table` probes: (first column, validity) per
+        dep — the ONE place defining what an In-probe sees (plain, keyed,
+        and pattern steps all ship these into their jitted programs)."""
+        return tuple((self.tables[d].cols[0], self.tables[d].valid)
+                     for d in deps)
+
+    def _validate_in_deps(self, deps, qname: str) -> None:
+        """`x in <id>` only probes DEFINED TABLES (reference:
+        InConditionExpressionExecutor resolves a table); reject named
+        windows / aggregations / typos at plan time, not as a KeyError on
+        the first send."""
+        for d in deps:
+            if d not in self.tables:
+                raise CompileError(
+                    f"query {qname!r}: `in {d}` requires a defined table "
+                    f"(named windows and aggregations are not probe-able "
+                    f"with `in`; defined tables: {sorted(self.tables)})")
+
+    def _gate_wait(self) -> None:
+        """Entry valve (reference: InputEntryValve + ThreadBarrier): external
+        producer threads block while a snapshot quiesces the app.  The
+        app's OWN threads (async ingest workers, emission drainer,
+        scheduler) are exempt — a worker whose callback re-ingests must
+        keep draining or _quiesce's queue join would deadlock against the
+        closed gate."""
+        if getattr(threading.current_thread(), "_siddhi_internal", False):
+            return
+        self._ingress_gate.wait()
+
+    @contextlib.contextmanager
     def _quiesce(self):
-        """Drain async ingress, then acquire the app lock plus EVERY query
-        lock (the reference's ThreadBarrier quiescing event threads for
-        snapshots).  The drain comes FIRST: accepted-but-queued events must
-        land in the state being snapshotted (at-least-once across a
-        persist/restore), and draining takes query locks internally."""
-        for j in self.junctions.values():
-            j.flush_async()
-        locks = [self._lock]
-        for qname in sorted(self.query_runtimes):
-            lk = getattr(self.query_runtimes[qname], "_qlock", None)
-            if lk is not None:
-                locks.append(lk)
-        for wid in sorted(self.named_windows):
-            locks.append(self.named_windows[wid]._qlock)
-        return _acquire_all(locks)
+        """Close the ingress gate (producers block at the entry valve),
+        drain async queues, then acquire the app lock plus EVERY query lock
+        (the reference's ThreadBarrier quiescing event threads for
+        snapshots).  The gate must close BEFORE the drain: joining a queue
+        that a persistent producer keeps refilling livelocks — observed as
+        an indefinitely-spinning snapshot under load.  Accepted-but-queued
+        events still land in the snapshotted state (at-least-once across a
+        persist/restore)."""
+        self._ingress_gate.clear()
+        try:
+            for j in self.junctions.values():
+                j.flush_async()
+            locks = [self._lock]
+            for qname in sorted(self.query_runtimes):
+                lk = getattr(self.query_runtimes[qname], "_qlock", None)
+                if lk is not None:
+                    locks.append(lk)
+            for wid in sorted(self.named_windows):
+                locks.append(self.named_windows[wid]._qlock)
+            with _acquire_all(locks):
+                yield
+        finally:
+            self._ingress_gate.set()
 
     def timestamp_millis(self) -> int:
         if self.playback:
@@ -2639,12 +2721,21 @@ class SiddhiManager:
         inferred: WindowProcessor subclasses register as windows, Source/
         Sink subclasses as transports, callables as scalar functions
         (returning a CompiledExpr from a list of compiled args)."""
+        from ..io.mappers import SinkMapper, SourceMapper
         from ..io.sink import Sink, register_sink_type
         from ..io.source import Source, register_source_type
-        from .extension import scalar_function, window_extension
+        from .extension import (AttributeAggregator, attribute_aggregator,
+                                scalar_function, sink_mapper, source_mapper,
+                                window_extension)
         from .window import WindowProcessor
         if isinstance(impl, type) and issubclass(impl, WindowProcessor):
             window_extension(name, replace=True)(impl)
+        elif isinstance(impl, type) and issubclass(impl, AttributeAggregator):
+            attribute_aggregator(name, replace=True)(impl)
+        elif isinstance(impl, type) and issubclass(impl, SourceMapper):
+            source_mapper(name, replace=True)(impl)
+        elif isinstance(impl, type) and issubclass(impl, SinkMapper):
+            sink_mapper(name, replace=True)(impl)
         elif isinstance(impl, type) and issubclass(impl, Source):
             register_source_type(name, impl)
         elif isinstance(impl, type) and issubclass(impl, Sink):
@@ -2654,8 +2745,10 @@ class SiddhiManager:
         else:
             raise TypeError(
                 f"cannot infer extension kind for {type(impl).__name__}; "
-                f"use the @scalar_function/@window_extension decorators or "
-                f"register_source_type/register_sink_type directly")
+                f"use the @scalar_function/@window_extension/"
+                f"@attribute_aggregator/@source_mapper/@sink_mapper "
+                f"decorators or register_source_type/register_sink_type "
+                f"directly")
 
     def create_sandbox_siddhi_app_runtime(
             self, app: Union[str, SiddhiApp],
@@ -2683,6 +2776,11 @@ class SiddhiManager:
             sdef.annotations = [a for a in sdef.annotations if keep(a)]
         for tdef in app.table_definition_map.values():
             tdef.annotations = [a for a in tdef.annotations
+                                if a.name.lower() != "store"]
+        # aggregations may also carry @store (distributed shardId mode) —
+        # a sandboxed app must not reach that external DB either
+        for adef in app.aggregation_definition_map.values():
+            adef.annotations = [a for a in adef.annotations
                                 if a.name.lower() != "store"]
         return self.create_siddhi_app_runtime(app, mesh=mesh)
 

@@ -110,6 +110,26 @@ class AggregatorBank:
         """Returns (result_type, result_fn(scan_results)->array, name).
         `scan_results` is the tuple of per-row running values, one per spec."""
         name = fn_expr.name
+        full = f"{fn_expr.namespace}:{name}" if fn_expr.namespace else name
+        from .extension import attribute_aggregator_registry
+        ext = attribute_aggregator_registry().get(full)
+        if ext is not None:
+            # custom aggregator: contributes scan columns through the same
+            # bank as the built-ins (jits and shards identically)
+            ext_args = [compile_expression(p, scope)
+                        for p in fn_expr.parameters]
+
+            def add_spec(suffix, op, init, dtype, vals_fn,
+                         _full=full, _key=expr_key):
+                return self._add(_AggSpec(
+                    f"{_full}:{suffix}:{_key}", op, init, dtype, vals_fn))
+
+            built = ext().build(ext_args, add_spec, expr_key)
+            if isinstance(built, tuple):
+                out_t, result = built
+            else:
+                out_t, result = ext.return_type, built
+            return out_t.upper(), result, full
         if name == "distinctCount":
             orig = fn_expr.parameters[0]
             if not isinstance(orig, Variable):
@@ -137,8 +157,21 @@ class AggregatorBank:
         args = [compile_expression(p, scope) for p in fn_expr.parameters]
 
         def fvals(c: CompiledExpr, dtype):
+            # null arguments contribute nothing (reference: every aggregator
+            # executor skips null inputs — Sum/Avg/StdDev processAdd)
             def vals(env, sign):
-                return jnp.asarray(c.fn(env), dtype) * jnp.asarray(sign, dtype)
+                v = c.fn(env)
+                contrib = jnp.asarray(v, dtype) * jnp.asarray(sign, dtype)
+                return jnp.where(ev.null_mask(v, c.type),
+                                 jnp.asarray(0, dtype), contrib)
+            return vals
+
+        def fcount_nonnull(c: CompiledExpr):
+            def vals(env, sign):
+                v = c.fn(env)
+                return jnp.where(ev.null_mask(v, c.type),
+                                 jnp.asarray(0, jnp.int64),
+                                 jnp.asarray(sign, jnp.int64))
             return vals
 
         if name == "sum" or name == "avg" or name == "stdDev":
@@ -150,29 +183,41 @@ class AggregatorBank:
             i_sum = self._add(_AggSpec(
                 f"sum:{expr_key}", jnp.add, 0, acc_dtype, fvals(a, acc_dtype)))
             i_cnt = self._add(_AggSpec(
-                f"cnt:{expr_key}", jnp.add, 0, jnp.int64,
-                lambda env, sign: jnp.asarray(sign, jnp.int64)))
+                f"cnt:{expr_key}", jnp.add, 0, jnp.int64, fcount_nonnull(a)))
             if name == "sum":
-                return out_t, (lambda res, _i=i_sum: res[_i]), name
+                # null until the first non-null value arrives (and again if
+                # the window retracts every contribution — reference: Sum
+                # returns null at count 0)
+                def fsum(res, _s=i_sum, _c=i_cnt, _t=out_t):
+                    return jnp.where(
+                        res[_c] != 0, res[_s],
+                        jnp.asarray(ev.null_value(_t), res[_s].dtype))
+                return out_t, fsum, name
             if name == "avg":
                 def favg(res, _s=i_sum, _c=i_cnt):
                     c = res[_c]
+                    # zero non-null contributions -> null (reference: Avg
+                    # returns null before the first value arrives)
                     return jnp.where(
                         c != 0,
                         res[_s].astype(jnp.float32) / c.astype(jnp.float32),
-                        jnp.asarray(0.0, jnp.float32))
+                        jnp.asarray(jnp.nan, jnp.float32))
                 return "DOUBLE", favg, name
             # stdDev = sqrt(E[x^2] - E[x]^2)
             def sqvals(env, sign, _a=a):
-                v = jnp.asarray(_a.fn(env), jnp.float32)
-                return v * v * jnp.asarray(sign, jnp.float32)
+                v0 = _a.fn(env)
+                v = jnp.asarray(v0, jnp.float32)
+                return jnp.where(ev.null_mask(v0, _a.type),
+                                 jnp.asarray(0.0, jnp.float32),
+                                 v * v * jnp.asarray(sign, jnp.float32))
             i_sq = self._add(_AggSpec(
                 f"sumsq:{expr_key}", jnp.add, 0, jnp.float32, sqvals))
             def fstd(res, _s=i_sum, _c=i_cnt, _q=i_sq):
                 c = jnp.maximum(res[_c], 1).astype(jnp.float32)
                 m = res[_s].astype(jnp.float32) / c
                 var = jnp.maximum(res[_q] / c - m * m, 0.0)
-                return jnp.sqrt(var)
+                return jnp.where(res[_c] != 0, jnp.sqrt(var),
+                                 jnp.asarray(jnp.nan, jnp.float32))
             return "DOUBLE", fstd, name
 
         if name == "count":
@@ -196,12 +241,35 @@ class AggregatorBank:
                                         else -big)
             opf = jnp.minimum if is_min else jnp.maximum
             def vals(env, sign, _a=a, _id=ident, _d=dtype):
-                v = jnp.asarray(_a.fn(env), _d)
-                # only CURRENT rows contribute; EXPIRED need window exposure
-                return jnp.where(jnp.asarray(sign) > 0, v, _id)
+                v0 = _a.fn(env)
+                v = jnp.asarray(v0, _d)
+                # only CURRENT rows contribute; EXPIRED need window exposure;
+                # null inputs contribute the identity (reference: MinMax
+                # aggregators skip nulls)
+                contribute = jnp.logical_and(
+                    jnp.asarray(sign) > 0,
+                    jnp.logical_not(ev.null_mask(v0, _a.type)))
+                return jnp.where(contribute, v, _id)
             i = self._add(_AggSpec(
                 f"{name}:{expr_key}", opf, ident, dtype, vals))
-            return a.type, (lambda res, _i=i: res[_i]), name
+            # null until the first non-null CURRENT value is seen — the
+            # accumulator identity must never leak to callbacks (reference:
+            # MinMax aggregators return null before the first value).  The
+            # seen-count is monotone because this min/max does not retract.
+            def seen_vals(env, sign, _a=a):
+                v = _a.fn(env)
+                hit = jnp.logical_and(
+                    jnp.asarray(sign) > 0,
+                    jnp.logical_not(ev.null_mask(v, _a.type)))
+                return jnp.where(hit, jnp.asarray(1, jnp.int64),
+                                 jnp.asarray(0, jnp.int64))
+            i_seen = self._add(_AggSpec(
+                f"seen:{expr_key}", jnp.add, 0, jnp.int64, seen_vals))
+
+            def fminmax(res, _i=i, _s=i_seen, _t=a.type, _d=dtype):
+                return jnp.where(res[_s] > 0, res[_i],
+                                 jnp.asarray(ev.null_value(_t), _d))
+            return a.type, fminmax, name
 
         if name in ("and", "or"):
             (a,) = args
@@ -327,7 +395,13 @@ def _rewrite_aggregators(expr: Expression, found: List[AttributeFunction],
                          prefix: str) -> Expression:
     """Replace aggregator calls with bound pseudo-variables __agg<i>."""
     if isinstance(expr, AttributeFunction):
-        if not expr.namespace and expr.name in AGGREGATOR_NAMES:
+        is_agg = not expr.namespace and expr.name in AGGREGATOR_NAMES
+        if not is_agg:
+            from .extension import attribute_aggregator_registry
+            full = f"{expr.namespace}:{expr.name}" if expr.namespace \
+                else expr.name
+            is_agg = full in attribute_aggregator_registry()
+        if is_agg:
             found.append(expr)
             return Variable(f"{prefix}{len(found) - 1}")
         return AttributeFunction(expr.namespace, expr.name, [

@@ -29,3 +29,21 @@ def masked_fill(arr, mask, init, key_axis: int = 0):
     shape[key_axis] = mask.shape[0]
     m = mask.reshape(shape)
     return jax.numpy.where(m, jax.numpy.asarray(init, arr.dtype), arr)
+
+
+def axis0_sharding(mesh, x):
+    """NamedSharding splitting a leaf's axis 0 over the mesh's first axis,
+    or None when the leaf is not evenly divisible (replicate it).  The ONE
+    eligibility rule shared by host placement (JoinQueryRuntime.place_state
+    seeds the layout with device_put) and the in-graph pin
+    (join._constrain_state keeps GSPMD from re-replicating the buffers) —
+    two hand-rolled copies of this predicate WILL drift."""
+    if mesh is None or mesh.devices.size < 2:
+        return None
+    n = mesh.devices.size
+    if getattr(x, "ndim", 0) >= 1 and x.shape[0] >= n and \
+            x.shape[0] % n == 0:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(
+            mesh, P(*([mesh.axis_names[0]] + [None] * (x.ndim - 1))))
+    return None

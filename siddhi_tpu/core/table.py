@@ -149,43 +149,29 @@ class TableRuntime:
         """UUID() sentinels must become real interned strings at the storage
         boundary — a stored sentinel would decode to a different id on every
         read (reference: one UUID per event, UUIDFunctionExecutor)."""
-        import uuid
-        interner = self.schema.interner
-        new_batch_cols = None
-        for pos, t in enumerate(self.schema.types):
-            if t != "STRING":
-                continue
-            col = np.asarray(staged.cols[pos])
-            mask = staged.valid & (col == ev.UUID_SENTINEL)
-            if not mask.any():
-                continue
-            col = col.copy()
-            col[mask] = [interner.intern(str(uuid.uuid4()))
-                         for _ in range(int(mask.sum()))]
+        changed = ev.materialize_uuid_sentinels(
+            self.schema, np.asarray(staged.valid), staged.cols)
+        if not changed:
+            return batch
+        new_batch_cols = list(batch.cols)
+        for pos, col in changed:
             scols = list(staged.cols)
             scols[pos] = col
             staged.cols = scols
-            if new_batch_cols is None:
-                new_batch_cols = list(batch.cols)
             new_batch_cols[pos] = jnp.asarray(col).astype(
                 batch.cols[pos].dtype)
-        if new_batch_cols is not None:
-            batch = batch.with_cols(new_batch_cols)
-        return batch
+        return batch.with_cols(new_batch_cols)
 
     def _materialize_uuid_col(self, val, hit):
         """`set T.s = UUID()` writes the sentinel; stored cells must hold
         REAL interned ids or every read mints a different uuid (same
         contract as _materialize_uuids on the insert path)."""
-        import uuid
         vnp = np.asarray(val)
         mask = np.asarray(hit) & (vnp == ev.UUID_SENTINEL)
         if not mask.any():
             return val
-        vnp = vnp.copy()
-        vnp[mask] = [self.schema.interner.intern(str(uuid.uuid4()))
-                     for _ in range(int(mask.sum()))]
-        return jnp.asarray(vnp)
+        return jnp.asarray(
+            ev.fill_uuid_cells(self.schema.interner, vnp, mask))
 
     def insert(self, batch: ev.EventBatch, staged: ev.StagedBatch) -> None:
         """Insert CURRENT rows (keyed: upsert on primary key; else append)."""

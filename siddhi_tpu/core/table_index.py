@@ -170,7 +170,35 @@ class AttributeIndex:
                    value) -> np.ndarray:
         """Rows satisfying `col <op> value` (op in < <= > >=)."""
         self._ensure_sorted(valid_mask)
-        v = np.asarray(value, self.dtype)
+        bound = np.asarray(value)
+        if (np.issubdtype(self.dtype, np.integer)
+                and np.issubdtype(bound.dtype, np.floating)):
+            # Compare in the value domain: casting a fractional bound to the
+            # integer dtype truncates toward zero, which under-approximates
+            # strict probes (`v < 27.5` would miss v==27). O(1) exact
+            # adjustment: tighten a fractional bound to the adjacent integer
+            # (`v < 27.5` == `v <= 27`); out-of-range bounds resolve to
+            # all/none rows.
+            import math
+            fv = float(bound)
+            if math.isnan(fv):
+                return self._sorted_rows[:0]
+            below = op in ("<", "<=")
+            if math.isinf(fv):
+                everything = below == (fv > 0)
+                return self._sorted_rows if everything \
+                    else self._sorted_rows[:0]
+            b = math.floor(fv) if below else math.ceil(fv)
+            if b != fv:
+                op = "<=" if below else ">="
+            info = np.iinfo(self.dtype)
+            if b > info.max:
+                return self._sorted_rows if below else self._sorted_rows[:0]
+            if b < info.min:
+                return self._sorted_rows[:0] if below else self._sorted_rows
+            v = np.asarray(b, self.dtype)
+        else:
+            v = np.asarray(value, self.dtype)
         if op == "<":
             hi = np.searchsorted(self._sorted_vals, v, side="left")
             return self._sorted_rows[:hi]

@@ -36,7 +36,22 @@ def collect() -> Dict[str, List[Tuple[str, str]]]:
         (name, _first_paragraph(
             getattr(fn, "__doc__", "") or type(fn).__doc__))
         for name, fn in STREAM_FUNCTIONS.items())
-    out["aggregators"] = sorted((n, "") for n in AGGREGATOR_NAMES)
+    from ..core.extension import (attribute_aggregator_registry,
+                                  script_engine_registry)
+    from ..io.mappers import SINK_MAPPERS, SOURCE_MAPPERS
+    out["aggregators"] = sorted(
+        [(n, "") for n in AGGREGATOR_NAMES] +
+        [(n, _first_paragraph(cls.__doc__))
+         for n, cls in attribute_aggregator_registry().items()])
+    out["source-mappers"] = sorted(
+        (name, _first_paragraph(cls.__doc__))
+        for name, cls in SOURCE_MAPPERS.items())
+    out["sink-mappers"] = sorted(
+        (name, _first_paragraph(cls.__doc__))
+        for name, cls in SINK_MAPPERS.items())
+    out["script-engines"] = sorted(
+        (name, _first_paragraph(fn.__doc__))
+        for name, fn in script_engine_registry().items())
     def _scalar_summary(name, fn):
         m = meta.get(f"scalar_function:{name}")
         return (m.description if m else "") or \

@@ -1,0 +1,157 @@
+"""Round-4 advisor-finding regression tests.
+
+1. rows_range compares in the value domain: a fractional float bound on an
+   integer-indexed column must not truncate toward zero (reference: dense
+   scans promote int/float comparisons, IndexEventHolder range probes must
+   match them).
+2. UUID() columns materialize ONE id per event at the emission boundary,
+   shared by the originating query's callback, downstream queries, and
+   table writes (reference: CORE/executor/function/UUIDFunctionExecutor).
+3. Sandbox runtimes strip @store from aggregation definitions too
+   (reference: SiddhiManager.createSandboxSiddhiAppRuntime).
+"""
+import numpy as np
+
+from siddhi_tpu.core.table_index import AttributeIndex
+
+
+def _collect(rt, name):
+    got = []
+    rt.add_callback(
+        name, lambda ts, cur, exp: got.extend(e.data for e in (cur or [])))
+    return got
+
+
+def test_fractional_bound_on_int_index_direct():
+    idx = AttributeIndex(64, np.int64, name="t")
+    rows = np.arange(10)
+    vals = np.arange(-5, 5, dtype=np.int64)   # -5..4 at rows 0..9
+    idx.on_write(rows, vals)
+    valid = np.zeros(64, bool)
+    valid[:10] = True
+    # v < 2.5 must include v==2 (row 7); a truncated bound of 2 would not
+    assert sorted(idx.rows_range(valid, "<", 2.5).tolist()) == list(range(8))
+    # v > -2.5 must include v==-2 (row 3)
+    assert sorted(idx.rows_range(valid, ">", -2.5).tolist()) == \
+        list(range(3, 10))
+    assert sorted(idx.rows_range(valid, "<=", 2.5).tolist()) == list(range(8))
+    assert sorted(idx.rows_range(valid, ">=", -2.5).tolist()) == \
+        list(range(3, 10))
+    # integral float bounds keep exact-boundary semantics
+    assert sorted(idx.rows_range(valid, "<", 2.0).tolist()) == list(range(7))
+    assert sorted(idx.rows_range(valid, "<=", 2.0).tolist()) == list(range(8))
+
+
+def test_fractional_bound_matches_dense_path(manager):
+    ql = """
+    define stream In (k string, v int);
+    @PrimaryKey('k')
+    @Index('v')
+    define table T (k string, v int);
+    @info(name='w') from In insert into T;
+    define stream In2 (k string, v int);
+    @info(name='w2') from In2 insert into T2;
+    define table T2 (k string, v int);
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    h, h2 = rt.get_input_handler("In"), rt.get_input_handler("In2")
+    for i in range(-30, 31, 3):
+        h.send([f"k{i}", i])
+        h2.send([f"k{i}", i])
+    rt.flush()
+    for cond in ("v < 27.5", "v > -27.5", "v <= 26.5", "v >= -26.5"):
+        indexed = sorted(e.data[1] for e in
+                         rt.query(f"from T on {cond} select k, v"))
+        dense = sorted(e.data[1] for e in
+                       rt.query(f"from T2 on {cond} select k, v"))
+        assert indexed == dense, cond
+
+
+def test_uuid_consistent_across_inner_streams(manager):
+    ql = """
+    define stream In (v int);
+    @info(name='q1') from In select UUID() as id, v insert into Mid;
+    @info(name='q2') from Mid select id, v insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    got1, got2 = _collect(rt, "q1"), _collect(rt, "q2")
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(5):
+        h.send([i])
+    rt.flush()
+    assert len(got1) == 5 and len(got2) == 5
+    ids1 = [d[0] for d in sorted(got1, key=lambda d: d[1])]
+    ids2 = [d[0] for d in sorted(got2, key=lambda d: d[1])]
+    # downstream consumers observe the SAME id the originating callback saw
+    assert ids1 == ids2
+    # and each event got a distinct id (not a shared sentinel decode)
+    assert len(set(ids1)) == 5
+
+
+def test_uuid_consistent_with_table_write(manager):
+    ql = """
+    define stream In (v int);
+    define table T (id string, v int);
+    @info(name='q1') from In select UUID() as id, v insert into T;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "q1")
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(4):
+        h.send([i])
+    rt.flush()
+    rows = rt.query("from T select id, v")
+    by_v_cb = {d[1]: d[0] for d in got}
+    by_v_tab = {e.data[1]: e.data[0] for e in rows}
+    assert by_v_cb == by_v_tab
+
+
+def test_uuid_groupby_downstream(manager):
+    # group-by on a UUID column downstream must see distinct groups per
+    # event, not one collapsed sentinel group
+    ql = """
+    define stream In (v int);
+    @info(name='q1') from In select UUID() as id, v insert into Mid;
+    @info(name='q2') from Mid select id, sum(v) as total
+        group by id insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = _collect(rt, "q2")
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in (10, 20, 30):
+        h.send([i])
+    rt.flush()
+    totals = sorted(d[1] for d in got)
+    assert totals == [10, 20, 30]
+
+
+def test_sandbox_strips_aggregation_store(manager):
+    from siddhi_tpu.io.store import RecordTable, record_store
+
+    @record_store("boomX")
+    class _BoomStore(RecordTable):
+        def init(self, *a, **k):
+            raise RuntimeError("sandboxed aggregation must not reach store")
+
+        def connect(self):
+            raise RuntimeError("sandboxed aggregation must not reach store")
+    ql = """
+    define stream In (sym string, price double, ts long);
+    @store(type='boomX')
+    define aggregation Agg
+    from In select sym, sum(price) as total
+    group by sym aggregate by ts every sec ... min;
+    """
+    rt = manager.create_sandbox_siddhi_app_runtime(ql)
+    rt.start()   # would raise on connect if @store survived
+    h = rt.get_input_handler("In")
+    h.send(["a", 1.5, 1_000])
+    h.send(["a", 2.5, 1_500])
+    rt.flush()
+    rows = rt.query(
+        "from Agg within 0L, 10000L per 'sec' select sym, total")
+    assert rows and abs(rows[0].data[1] - 4.0) < 1e-9

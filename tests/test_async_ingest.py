@@ -145,3 +145,36 @@ def test_snapshot_drains_async_ingress():
     rt2.flush()
     assert got == [51]      # 50 pre-snapshot + 1
     m2.shutdown()
+
+
+def test_snapshot_with_reingesting_callback(manager):
+    """A worker-thread callback that re-ingests via InputHandler.send must
+    not deadlock persist(): internal threads are exempt from the snapshot
+    ingress gate (regression: queue join waited on a send blocked at the
+    closed gate)."""
+    rt = manager.create_siddhi_app_runtime("""
+    @async(buffer.size='16', workers='1')
+    define stream S (v int);
+    define stream S2 (v int);
+    @info(name='q') from S[v < 3] select v insert into Out;
+    @info(name='q2') from S2 select v insert into Out2;
+    """)
+    h2 = rt.get_input_handler("S2")
+    rt.add_callback("q", lambda ts, cur, exp: [
+        h2.send([e.data[0] + 100]) for e in (cur or [])])
+    rt.start()
+    h = rt.get_input_handler("S")
+    stop = threading.Event()
+
+    def pump():
+        while not stop.is_set():
+            h.send([1])
+    t = threading.Thread(target=pump)
+    t.start()
+    try:
+        for _ in range(3):
+            assert rt.snapshot()
+    finally:
+        stop.set()
+        t.join()
+    rt.flush()

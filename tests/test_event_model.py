@@ -85,7 +85,9 @@ def test_string_null_and_uuid_sentinel_decode():
 
 def test_encode_value_types():
     schema = _schema(("s", "string"), ("i", "int"), ("b", "bool"))
-    assert schema.encode_value("INT", None) == 0       # null -> default
+    # null -> in-band null value, round-tripping back to None
+    assert schema.encode_value("INT", None) == ev.NULL_INT
+    assert schema.decode_value("INT", ev.NULL_INT) is None
     assert schema.encode_value("BOOL", 1) is True
     sid = schema.encode_value("STRING", "x")
     assert schema.decode_value("STRING", sid) == "x"

@@ -43,11 +43,11 @@ def test_right_outer_join(manager):
         ("L", ["a", 9.0], 1001),        # matches buffered R
         ("R", ["b", 2], 1002),          # never matches
     ])
-    # unmatched numeric outer side fills with the type default (0.0) —
-    # columnar numerics carry no null mask (string sides decode to None)
-    assert ("a", 0.0, 1) in got
+    # unmatched numeric outer side emits real nulls (reference:
+    # JoinProcessor.java:107-190; numerics ride the in-band null value)
+    assert ("a", None, 1) in got
     assert ("a", 9.0, 1) in got
-    assert ("b", 0.0, 2) in got
+    assert ("b", None, 2) in got
     # L arrivals alone don't emit on a right-outer join... except matches
     assert all(g[0] in ("a", "b") for g in got)
 

@@ -1,0 +1,109 @@
+"""Prove the mesh physically distributes state — not just a layout hint
+(VERDICT r3 weak #4).  Checks leaf.addressable_shards occupancy (1/n rows
+per device) and that the compiled sharded join step carries collectives
+rather than replicating the whole computation."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core import event as ev
+
+
+def _mesh(n=8):
+    devs = jax.devices()
+    if len(devs) < n:
+        pytest.skip(f"needs {n} virtual devices")
+    return Mesh(np.array(devs[:n]), ("shard",))
+
+
+def _sharded_leaves(state, n):
+    out = 0
+    for leaf in jax.tree.leaves(state):
+        if getattr(leaf, "ndim", 0) < 1 or not hasattr(
+                leaf, "addressable_shards"):
+            continue
+        shards = leaf.addressable_shards
+        if len(shards) == n and leaf.size > 0 and \
+                shards[0].data.size * n == leaf.size:
+            out += 1
+    return out
+
+
+def test_join_window_buffers_stay_distributed(manager):
+    n = 8
+    mesh = _mesh(n)
+    ql = """
+    @app:playback
+    define stream L (sym long, price float);
+    define stream R (sym long, qty int);
+    @info(name='j')
+    from L#window.length(16) join R#window.length(16) on L.sym == R.sym
+    select L.sym as s, R.qty as q insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql, mesh=mesh)
+    rt.start()
+    jqr = rt.query_runtimes["j"]
+    assert _sharded_leaves(jqr.state, n) > 0, "initial placement not sharded"
+    for k in range(6):
+        rt.get_input_handler("L").send([[k, 1.0]], timestamp=1000 + k)
+        rt.get_input_handler("R").send([[k, k + 1]], timestamp=1000 + k)
+    rt.flush()
+    # the constraint must HOLD across steps — GSPMD must not un-shard the
+    # window buffers into full replicas (regression: it did)
+    assert _sharded_leaves(jqr.state, n) > 0, \
+        "join state replicated after steps"
+
+
+def test_join_step_hlo_has_collectives(manager):
+    n = 8
+    mesh = _mesh(n)
+    ql = """
+    @app:playback
+    define stream L (sym long, price float);
+    define stream R (sym long, qty int);
+    @info(name='j')
+    from L#window.length(16) join R#window.length(16) on L.sym == R.sym
+    select L.sym as s, R.qty as q insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql, mesh=mesh)
+    rt.start()
+    jqr = rt.query_runtimes["j"]
+    side = jqr.planned.left
+    staged = ev.pack_np(side.schema, [ev.Event(2000, [1, 1.0])])
+    batch = staged.to_device(side.schema)
+    gslot = jnp.zeros((staged.ts.shape[0],), jnp.int32)
+    hlo = jqr.planned.step_left.lower(
+        jqr.state, batch.ts, batch.kind, batch.valid, batch.cols, gslot,
+        jqr._other_table(True), jnp.asarray(2000, jnp.int64)
+    ).compile().as_text()
+    assert any(tok in hlo for tok in (
+        "all-gather", "all-reduce", "collective-permute", "all-to-all",
+        "reduce-scatter")), "sharded join step compiled without collectives"
+
+
+def test_pattern_state_distributed(manager):
+    n = 8
+    mesh = _mesh(n)
+    ql = """
+    @app:playback
+    define stream T (key long, v int);
+    partition with (key of T) begin
+    @capacity(keys='64', slots='4') @info(name='p')
+    from every e1=T[v == 1] -> e2=T[v == 2]
+    select e1.key as k insert into Out;
+    end;
+    """
+    rt = manager.create_siddhi_app_runtime(ql, mesh=mesh)
+    got = []
+    rt.add_callback("p", lambda ts, i, o: got.extend(i or []))
+    rt.start()
+    h = rt.get_input_handler("T")
+    h.send([[k, 1] for k in range(16)], timestamp=1000)
+    h.send([[k, 2] for k in range(16)], timestamp=1001)
+    rt.flush()
+    assert len(got) == 16
+    qr = rt.query_runtimes["p"]
+    assert _sharded_leaves(qr.state, n) > 0, "NFA slabs not distributed"

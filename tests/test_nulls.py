@@ -1,0 +1,235 @@
+"""Numeric null semantics (reference: events carry boxed Java nulls —
+JoinProcessor emits them for unmatched outer rows, compare executors return
+false on null, math executors propagate null, aggregators skip null).
+
+TPU design: in-band reserved values (INT/LONG minimum, float NaN) ride the
+columns; every host decode boundary maps them back to None (core/event.py
+null_value/null_mask)."""
+import math
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def _run(manager, ql, sends, query="q", stream="S"):
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(query, lambda ts, ins, outs: got.extend(
+        list(e.data) for e in ins or []))
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for e in sends:
+        h.send(e)
+    rt.flush()
+    return got
+
+
+def test_none_round_trips_all_numeric_types(manager):
+    ql = """
+    define stream S (i int, l long, f float, d double, s string);
+    @info(name='q') from S select i, l, f, d, s insert into Out;
+    """
+    got = _run(manager, ql, [[None, None, None, None, None],
+                             [1, 2, 1.5, 2.5, "x"]])
+    assert got[0] == [None, None, None, None, None]
+    assert got[1] == [1, 2, 1.5, 2.5, "x"]
+
+
+def test_comparison_with_null_is_false(manager):
+    # reference: every compare executor null-checks first; null events are
+    # filtered out by ANY comparison, including == and !=
+    ql = """
+    define stream S (v int, w int);
+    @info(name='q') from S[v > 0 or v <= 0 or v == w or v != w]
+    select v insert into Out;
+    """
+    got = _run(manager, ql, [[None, 1], [3, 1], [None, None]])
+    assert got == [[3]]
+
+
+def test_is_null_on_numerics(manager):
+    ql = """
+    define stream S (v int, d double);
+    @info(name='q') from S[v is null and d is null]
+    select count() as c insert into Out;
+    """
+    got = _run(manager, ql, [[None, None], [1, None], [None, 1.0], [2, 2.0]])
+    assert got == [[1]]
+
+
+def test_arithmetic_propagates_null(manager):
+    ql = """
+    define stream S (v int, d double);
+    @info(name='q') from S
+    select v + 1 as vi, v * 2 as vm, v + d as vd, d / 2.0 as dd
+    insert into Out;
+    """
+    got = _run(manager, ql, [[None, 4.0], [3, None], [None, None], [2, 8.0]])
+    assert got[0] == [None, None, None, 2.0]
+    assert got[1] == [4, 6, None, None]
+    assert got[2] == [None, None, None, None]
+    assert got[3] == [3, 4, 10.0, 4.0]
+
+
+def test_coalesce_and_default_on_numerics(manager):
+    ql = """
+    define stream S (a int, b int);
+    @info(name='q') from S
+    select coalesce(a, b) as c, default(a, 42) as d insert into Out;
+    """
+    got = _run(manager, ql, [[None, 7], [5, None], [None, None]])
+    assert got[0] == [7, 42]
+    assert got[1] == [5, 5]
+    assert got[2] == [None, 42]
+
+
+def test_aggregators_skip_nulls(manager):
+    ql = """
+    define stream S (k string, v int);
+    @info(name='q') from S
+    select k, sum(v) as s, avg(v) as a, min(v) as mn, max(v) as mx,
+           count() as c
+    group by k insert into Out;
+    """
+    got = _run(manager, ql, [["g", 4], ["g", None], ["g", 2]])
+    # null contributes to count() (row count) but not to sum/avg/min/max
+    assert got[0] == ["g", 4, 4.0, 4, 4, 1]
+    assert got[1] == ["g", 4, 4.0, 4, 4, 2]
+    assert got[2] == ["g", 6, 3.0, 2, 4, 3]
+
+
+def test_avg_all_null_is_null(manager):
+    ql = """
+    define stream S (v int);
+    @info(name='q') from S select avg(v) as a insert into Out;
+    """
+    got = _run(manager, ql, [[None], [None]])
+    assert got == [[None], [None]]
+
+
+def test_outer_join_null_numerics_full(manager):
+    ql = """
+    @app:playback
+    define stream L (sym string, price double, lots int);
+    define stream R (sym string, qty long);
+    @info(name='q')
+    from L#window.length(8) full outer join R#window.length(8)
+      on L.sym == R.sym
+    select L.sym as ls, R.sym as rs, price, lots, qty insert into Out;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(
+        tuple(e.data) for e in ins or []))
+    rt.start()
+    rt.get_input_handler("L").send([["a", 1.5, 3]], timestamp=1000)
+    rt.get_input_handler("R").send([["b", 9]], timestamp=1001)
+    rt.flush()
+    # L-only row: R side all null (string AND both numerics)
+    assert ("a", None, 1.5, 3, None) in got
+    # R-only row: L side all null
+    assert (None, "b", None, None, 9) in got
+
+
+def test_null_arith_through_inner_stream(manager):
+    # nulls survive an inner-stream hop and keep propagating
+    ql = """
+    define stream S (v int);
+    @info(name='q1') from S select v + 1 as w insert into Mid;
+    @info(name='q2') from Mid select w * 2 as x insert into Out;
+    """
+    got = _run(manager, ql, [[None], [5]], query="q2")
+    assert got == [[None], [12]]
+
+
+def test_null_group_by_groups_together(manager):
+    # reference: GroupByKeyGenerator renders null as a key slot of its own
+    ql = """
+    define stream S (k string, v int);
+    @info(name='q') from S select k, sum(v) as s group by k insert into Out;
+    """
+    got = _run(manager, ql, [[None, 1], ["x", 5], [None, 2]])
+    assert got[0] == [None, 1]
+    assert got[1] == ["x", 5]
+    assert got[2] == [None, 3]
+
+
+def test_legit_nan_decodes_none(manager):
+    # 0.0/0.0 produces NaN which IS the float null representation; it
+    # decodes as None (documented in PARITY.md)
+    ql = """
+    define stream S (a double, b double);
+    @info(name='q') from S select a / b as r insert into Out;
+    """
+    got = _run(manager, ql, [[0.0, 0.0], [1.0, 2.0]])
+    assert got == [[None], [0.5]]
+
+
+def test_cast_preserves_null(manager):
+    ql = """
+    define stream S (v int);
+    @info(name='q') from S
+    select cast(v, 'double') as d, cast(v, 'long') as l insert into Out;
+    """
+    got = _run(manager, ql, [[None], [7]])
+    assert got[0] == [None, None]
+    assert got[1] == [7.0, 7]
+
+
+def test_sum_min_max_null_before_first_value(manager):
+    ql = """
+    define stream S (v int);
+    @info(name='q') from S
+    select sum(v) as s, min(v) as mn, max(v) as mx, stdDev(v) as sd
+    insert into Out;
+    """
+    got = _run(manager, ql, [[None], [None], [3]])
+    # reference: Sum/Min/Max/StdDev return null until the first non-null
+    assert got[0] == [None, None, None, None]
+    assert got[1] == [None, None, None, None]
+    assert got[2] == [3, 3, 3, 0.0]
+
+
+def test_ondemand_aggregates_skip_nulls(manager):
+    ql = """
+    define stream S (k string, v int);
+    define table T (k string, v int);
+    @info(name='w') from S insert into T;
+    """
+    rt = manager.create_siddhi_app_runtime(ql)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for row in [["a", 10], ["b", None], ["c", 5]]:
+        h.send(row)
+    rt.flush()
+    r = rt.query("from T select sum(v) as s, avg(v) as a, min(v) as mn, "
+                 "max(v) as mx, count() as c")
+    assert r[0].data == [15, 7.5, 5, 10, 3]
+    # all-null table -> null aggregates (count still counts rows)
+    rt2 = manager.create_siddhi_app_runtime("""
+    define stream S2 (v int);
+    define table T2 (v int);
+    @info(name='w2') from S2 insert into T2;
+    """)
+    rt2.start()
+    h2 = rt2.get_input_handler("S2")
+    h2.send([None])
+    h2.send([None])
+    rt2.flush()
+    r2 = rt2.query("from T2 select sum(v) as s, avg(v) as a, min(v) as mn, "
+                   "max(v) as mx, count() as c")
+    assert r2[0].data == [None, None, None, None, 2]
+
+
+def test_uuid_sentinel_is_not_null(manager):
+    # UUID_SENTINEL (-2) is a pending value, not a null: comparisons stay
+    # live and isNull is false (regression: null_mask used x < 0 which
+    # captured the sentinel and silently filtered every row)
+    ql = """
+    define stream S (v int);
+    @info(name='q') from S[UUID() != "x"]
+    select UUID() is null as isn, v insert into Out;
+    """
+    got = _run(manager, ql, [[1], [2]])
+    assert got == [[False, 1], [False, 2]]

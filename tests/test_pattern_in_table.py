@@ -1,0 +1,132 @@
+"""`x in Table` probes inside pattern/sequence NFA filters (reference:
+CORE/executor/condition/InConditionExpressionExecutor evaluated inside
+StreamPreStateProcessor conditions).  The table's column snapshot ships
+into the jitted NFA step per batch; the probe is one dense compare."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def _mk(manager, ql, query="q"):
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    rt.add_callback(query, lambda ts, ins, outs: got.extend(
+        tuple(e.data) for e in ins or []))
+    rt.start()
+    return rt, got
+
+
+def test_pattern_filter_probes_table(manager):
+    ql = """
+    define stream TI (k long);
+    define table T (k long);
+    @info(name='w') from TI insert into T;
+    define stream S (k long, v int);
+    @info(name='q') from every e1=S[k in T and v == 1] -> e2=S[v == 2]
+    select e1.k as k insert into Out;
+    """
+    rt, got = _mk(manager, ql)
+    rt.get_input_handler("S").send([5, 1])     # 5 not in T: e1 must not arm
+    rt.get_input_handler("S").send([5, 2])
+    rt.flush()
+    assert got == []
+    rt.get_input_handler("TI").send([5])       # now 5 IS in T
+    rt.get_input_handler("S").send([5, 1])
+    rt.get_input_handler("S").send([5, 2])
+    rt.flush()
+    assert got == [(5,)]
+
+
+def test_pattern_in_table_sees_live_mutations(manager):
+    # the probe snapshots the table at EVENT time: deletions take effect
+    ql = """
+    define stream TI (k long);
+    define stream TD (k long);
+    define table T (k long);
+    @info(name='w') from TI insert into T;
+    @info(name='d') from TD delete T on T.k == k;
+    define stream S (k long, v int);
+    @info(name='q') from every e1=S[k in T and v == 1] -> e2=S[v == 2]
+    select e1.k as k insert into Out;
+    """
+    rt, got = _mk(manager, ql)
+    rt.get_input_handler("TI").send([9])
+    rt.get_input_handler("S").send([9, 1])
+    rt.get_input_handler("S").send([9, 2])
+    rt.flush()
+    assert got == [(9,)]
+    rt.get_input_handler("TD").send([9])       # remove 9
+    rt.get_input_handler("S").send([9, 1])     # must not arm again
+    rt.get_input_handler("S").send([9, 2])
+    rt.flush()
+    assert got == [(9,)]
+
+
+def test_partitioned_pattern_in_table_dense_and_gappy(manager):
+    ql = """
+    define stream TI (k long);
+    define table T (k long);
+    @info(name='w') from TI insert into T;
+    define stream S (k long, v int);
+    partition with (k of S) begin
+    @capacity(keys='64', slots='4') @info(name='q')
+    from every e1=S[k in T and v == 1] -> e2=S[v == 2]
+    select e1.k as k insert into Out;
+    end;
+    """
+    rt, got = _mk(manager, ql)
+    hti, hs = rt.get_input_handler("TI"), rt.get_input_handler("S")
+    for k in (0, 1, 2, 3):                     # whitelist even+odd low keys
+        hti.send([k])
+    # dense contiguous keys 0..7: only 0..3 are in T
+    hs.send([[k, 1] for k in range(8)])
+    hs.send([[k, 2] for k in range(8)])
+    rt.flush()
+    assert sorted(g[0] for g in got) == [0, 1, 2, 3], got
+    got.clear()
+    hti.send([500])
+    # gappy keys -> generic step
+    for k in (100, 500):
+        hs.send([k, 1])
+    for k in (100, 500):
+        hs.send([k, 2])
+    rt.flush()
+    assert sorted(g[0] for g in got) == [500], got
+
+
+def test_sequence_in_table_negation(manager):
+    # `not (k in T)` composes with the probe
+    ql = """
+    define stream TI (k long);
+    define table T (k long);
+    @info(name='w') from TI insert into T;
+    define stream S (k long, v int);
+    @info(name='q') from every e1=S[not (k in T) and v == 1] -> e2=S[v == 2]
+    select e1.k as k insert into Out;
+    """
+    rt, got = _mk(manager, ql)
+    rt.get_input_handler("TI").send([7])
+    rt.get_input_handler("S").send([7, 1])     # 7 in T: not-in fails
+    rt.get_input_handler("S").send([7, 2])
+    rt.flush()
+    assert got == []
+    rt.get_input_handler("S").send([8, 1])     # 8 not in T: passes
+    rt.get_input_handler("S").send([8, 2])
+    rt.flush()
+    assert got == [(8,)]
+
+
+def test_in_unknown_source_is_compile_error(manager):
+    import pytest as _pytest
+    from siddhi_tpu.exceptions import CompileError
+    with _pytest.raises(CompileError, match="requires a defined table"):
+        manager.create_siddhi_app_runtime("""
+        define stream S (k long, v int);
+        @info(name='q') from every e1=S[k in NoSuchTable] -> e2=S[v == 2]
+        select e1.k as k insert into Out;
+        """)
+    with _pytest.raises(CompileError, match="requires a defined table"):
+        manager.create_siddhi_app_runtime("""
+        define stream S (k long, v int);
+        @info(name='q') from S[k in Typo] select k insert into Out;
+        """)

@@ -274,7 +274,9 @@ def test_sharded_windowed_join_matches_unsharded(mesh):
                 [[int(rng.integers(0, 6)), int(rng.integers(1, 9))]
                  for _ in range(8)], timestamp=1000 + i)
         m.shutdown()
-        return sorted(got)
+        # outer-join rows carry real None cells: sort None-last
+        return sorted(got, key=lambda r: tuple(
+            (v is None, 0 if v is None else v) for v in r))
 
     sharded = run(mesh)
     assert sharded == run(None)

@@ -199,16 +199,17 @@ def test_window_missing_param_is_compile_error(manager):
         """)
 
 
-def test_in_table_inside_pattern_is_compile_error(manager):
-    """`in <table>` inside pattern filters fails at COMPILE time with a
-    clear message (regression: used to KeyError at runtime)."""
-    with pytest.raises(CompileError, match="pattern/sequence filters"):
-        manager.create_siddhi_app_runtime("""
-        define stream S (k long, v int);
-        define table T (k long);
-        @info(name='q') from every e1=S[k in T] -> e2=S[v == 2]
-        select e1.k as k insert into Out;
-        """)
+def test_in_table_inside_pattern_compiles(manager):
+    """`in <table>` inside pattern filters compiles to a device probe
+    (reference: InConditionExpressionExecutor inside NFA conditions);
+    behavioral coverage lives in test_pattern_in_table.py."""
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (k long, v int);
+    define table T (k long);
+    @info(name='q') from every e1=S[k in T] -> e2=S[v == 2]
+    select e1.k as k insert into Out;
+    """)
+    rt.start()
 
 
 def test_sandbox_runtime_strips_external_io(manager):
