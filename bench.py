@@ -29,6 +29,7 @@ partition with (key of TradeStream)
 begin
   @capacity(keys='{n_keys}', slots='{slots}')
   @emit(rows='2')
+  {pipe_ann}
   @info(name='flagship')
   from every e1=TradeStream[volume == 1]
        -> e2=TradeStream[volume == 2 and price >= e1.price]
@@ -40,19 +41,24 @@ end;
 """
 
 
-def run_tpu(async_ingest: bool = False):
-    """One flagship measurement.  Both ingestion modes are legitimate
-    configurations (@async = the reference's Disruptor opt-in); on a
-    single-core driver host the sync path usually wins because the worker
-    thread contends with the producer, so main() measures both and
-    reports the best.  The second runtime reuses the in-process jit cache
-    (the device program is identical — @async only changes host threading).
+def run_tpu(async_ingest: bool = False, pipeline: bool = False):
+    """One flagship measurement.  All three ingestion/emission modes are
+    legitimate configurations (@async = the reference's Disruptor opt-in;
+    @pipeline = one-deep deferred emission overlapping host staging with
+    the device step on the producer thread).  On a single-core driver host
+    the sync path beats @async (the worker thread contends with the
+    producer) while @pipeline should win on a tunneled device (the
+    emission fetch of batch N hides behind the dispatch of N+1), so
+    main() measures all and reports the best.  Each runtime reuses the
+    in-process jit cache (the device program is identical — the modes
+    only change host threading/ordering).
     """
     from siddhi_tpu import SiddhiManager
 
     manager = SiddhiManager()
     rt = manager.create_siddhi_app_runtime(QL_TEMPLATE.format(
         async_ann="@async" if async_ingest else "",
+        pipe_ann="@pipeline" if pipeline else "",
         n_keys=N_KEYS, slots=SLOTS))
     matches = [0]
     # n_current is the device-computed count of valid CURRENT rows riding
@@ -95,7 +101,8 @@ def run_tpu(async_ingest: bool = False):
     dt = time.perf_counter() - t0
     eps = total / dt
     stats = _lat_stats(lat)
-    mode = "async" if async_ingest else "sync"
+    mode = "async" if async_ingest else (
+        "pipeline" if pipeline else "sync")
     print(f"tpu[{mode}]: {total} events in {dt:.2f}s -> {eps:,.0f} ev/s; "
           f"matches={matches[0]}; batch p50={stats['p50_ms']}ms "
           f"p99={stats['p99_ms']}ms", file=sys.stderr)
@@ -309,7 +316,7 @@ def flagship_small_batch(B, n_sends=64):
     nk = max(B // 4, 64)
     manager = SiddhiManager()
     rt = manager.create_siddhi_app_runtime(QL_TEMPLATE.format(
-        async_ann="", n_keys=nk, slots=SLOTS))
+        async_ann="", pipe_ann="", n_keys=nk, slots=SLOTS))
     matches = [0]
     rt.add_batch_callback(
         "flagship",
@@ -395,9 +402,10 @@ def main():
     # number still stands); both failing is a real rc!=0
     results = {}
     errors = {}
-    for mode_name, flag in (("sync", False), ("async", True)):
+    for mode_name, kw in (("sync", {}), ("pipeline", {"pipeline": True}),
+                          ("async", {"async_ingest": True})):
         try:
-            results[mode_name] = run_tpu(async_ingest=flag)
+            results[mode_name] = run_tpu(**kw)
         except Exception as exc:  # noqa: BLE001 — isolate mode failures
             errors[mode_name] = repr(exc)[:300]
             print(f"flagship[{mode_name}] FAILED: {exc!r}", file=sys.stderr)

@@ -511,9 +511,13 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
     """Emission entry: async mode (@async) defers the device->host sync to a
     background drainer thread so the producer keeps dispatching device work
     (the reference's Disruptor-decoupled delivery, StreamJunction.java:276);
-    sync mode delivers inline.  `wake` is the device-computed next-wakeup
-    scalar (or None): it is fetched WITH the output in one tunnel roundtrip
-    and applied before delivery."""
+    @pipeline mode keeps a ONE-DEEP deferred emission on the producer
+    thread itself — the device_get for step N happens only after step N+1
+    has been dispatched, so host staging overlaps device compute without a
+    second thread to contend with (the win on a 1-core driver host feeding
+    an accelerator); sync mode delivers inline.  `wake` is the
+    device-computed next-wakeup scalar (or None): fetched WITH the output
+    in one roundtrip and applied before delivery."""
     if not _has_consumers(qr):
         if wake is not None:
             qr._apply_wake(int(wake))
@@ -521,6 +525,20 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
     if getattr(qr, "async_emit", False) and qr.app._drainer is not None:
         qr.app._drainer.enqueue(qr, out, now, wake)
         return
+    if getattr(qr, "pipeline_emit", False) and wake is None:
+        # wake is a device-computed scheduler deadline: deferring it would
+        # stall time-driven expiry on an idle stream, so timer-bearing
+        # emissions deliver inline and only wake-free ones pipeline
+        pending = getattr(qr, "_pending_emit", None)
+        qr._pending_emit = (out, now, None)
+        if pending is not None:
+            _deliver_output(qr, *pending)
+        return
+    _deliver_output(qr, out, now, wake)
+
+
+def _deliver_output(qr, out, now: int, wake) -> None:
+    """Blocking device->host fetch + delivery of one emission."""
     if len(out) == 6:
         header, wake_h = jax.device_get(((out[0], out[1]), wake))
     else:
@@ -529,6 +547,22 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
     if wake_h is not None:
         qr._apply_wake(int(wake_h))
     _emit_output_sync(qr, out, now, header=header)
+
+
+def _drain_pending_emit(qr) -> None:
+    """Deliver a @pipeline runtime's held emission (flush/quiesce/shutdown).
+    Swap + delivery run under the query lock — the producer's pipeline
+    branch in _emit_output also runs under it (junction dispatch), so a
+    concurrent flush can never double-deliver the same emission."""
+    if getattr(qr, "_pending_emit", None) is None:
+        return
+    lk = getattr(qr, "_qlock", None) or contextlib.nullcontext()
+    with lk:
+        pending = getattr(qr, "_pending_emit", None)
+        if pending is None:
+            return
+        qr._pending_emit = None
+        _deliver_output(qr, *pending)
 
 
 class _LazyBatchPayload(dict):
@@ -1786,6 +1820,7 @@ class SiddhiAppRuntime:
                 getattr(planned.exec, "in_deps", ()), name)
             runtime = PatternQueryRuntime(planned, self)
             runtime.async_emit = self._async_enabled(q)
+            runtime.pipeline_emit = self._pipeline_enabled(q)
             self.query_runtimes[name] = runtime
             for sid in planned.spec.stream_ids:
 
@@ -1815,6 +1850,7 @@ class SiddhiAppRuntime:
         self._validate_in_deps(planned.in_deps, name)
         runtime = QueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
+        runtime.pipeline_emit = self._pipeline_enabled(q)
         self.query_runtimes[name] = runtime
         if from_window:
             self.named_windows[in_sid].subscribers.append(runtime)
@@ -1939,6 +1975,7 @@ class SiddhiAppRuntime:
                                   mesh=self.mesh)
         runtime = JoinQueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
+        runtime.pipeline_emit = self._pipeline_enabled(q)
         self.query_runtimes[name] = runtime
         for side, is_left in ((planned.left, True), (planned.right, False)):
             class _JSub:
@@ -1976,6 +2013,14 @@ class SiddhiAppRuntime:
             if sdef is not None and sdef.get_annotation("async") is not None:
                 return True
         return False
+
+    def _pipeline_enabled(self, q) -> bool:
+        """@pipeline on the app or the query: one-deep deferred emission so
+        host staging of batch N+1 overlaps the device step of batch N (no
+        extra thread; callbacks arrive one send late until flush())."""
+        if self.app.get_annotation("app:pipeline") is not None:
+            return True
+        return q.get_annotation("pipeline") is not None
 
     def _add_partition(self, part: Partition, qi: int) -> int:
         """Partitions: key-scoped state clones (reference:
@@ -2080,6 +2125,7 @@ class SiddhiAppRuntime:
                 runtime = PatternQueryRuntime(planned, self,
                                               slot_allocator=shared_allocator)
                 runtime.async_emit = self._async_enabled(q)
+                runtime.pipeline_emit = self._pipeline_enabled(q)
                 self.query_runtimes[qname] = runtime
                 part_runtimes.append(runtime)
                 for sid in planned.spec.stream_ids:
@@ -2163,6 +2209,8 @@ class SiddhiAppRuntime:
                     mesh=self.mesh)
                 self._validate_in_deps(planned.in_deps, qname)
                 runtime = QueryRuntime(planned, self)
+                runtime.async_emit = self._async_enabled(q)
+                runtime.pipeline_emit = self._pipeline_enabled(q)
                 self.query_runtimes[qname] = runtime
                 part_runtimes.append(runtime)
                 self.junctions[sid].subscribe_query(runtime)
@@ -2250,6 +2298,10 @@ class SiddhiAppRuntime:
                 self._stats_reporter.stop()
             for j in self.junctions.values():
                 j.stop_async()       # drain accepted sends, stop workers
+            for qr in self.query_runtimes.values():
+                # held @pipeline emissions deliver before teardown: an
+                # accepted send's output must not vanish (at-least-once)
+                _drain_pending_emit(qr)
             for sk in self.sinks:
                 sk.stop()
             self._drainer.stop()
@@ -2272,8 +2324,12 @@ class SiddhiAppRuntime:
         for _ in range(64):
             for j in self.junctions.values():
                 j.flush_async()
+            for qr in self.query_runtimes.values():
+                _drain_pending_emit(qr)
             self._drainer.flush()
-            if all(j.pending_async() == 0 for j in self.junctions.values()):
+            if all(j.pending_async() == 0 for j in self.junctions.values()) \
+                    and not any(getattr(qr, "_pending_emit", None)
+                                for qr in self.query_runtimes.values()):
                 return
         import logging
         logging.getLogger("siddhi_tpu").warning(
@@ -2321,9 +2377,25 @@ class SiddhiAppRuntime:
         events still land in the snapshotted state (at-least-once across a
         persist/restore)."""
         self._ingress_gate.clear()
+        cur = threading.current_thread()
+        prev_internal = getattr(cur, "_siddhi_internal", False)
+        # the quiescing thread delivers held @pipeline emissions below; a
+        # delivery callback that re-ingests must not block on the gate THIS
+        # thread closed (it would deadlock the snapshot) — mark it internal
+        # for the duration, and iterate drain+deliver to a fixpoint so
+        # re-ingested events land in the snapshotted state too
+        cur._siddhi_internal = True
         try:
-            for j in self.junctions.values():
-                j.flush_async()
+            for _ in range(64):
+                for j in self.junctions.values():
+                    j.flush_async()
+                for qr in self.query_runtimes.values():
+                    _drain_pending_emit(qr)
+                if all(j.pending_async() == 0
+                       for j in self.junctions.values()) and \
+                        not any(getattr(qr, "_pending_emit", None)
+                                for qr in self.query_runtimes.values()):
+                    break
             locks = [self._lock]
             for qname in sorted(self.query_runtimes):
                 lk = getattr(self.query_runtimes[qname], "_qlock", None)
@@ -2334,6 +2406,7 @@ class SiddhiAppRuntime:
             with _acquire_all(locks):
                 yield
         finally:
+            cur._siddhi_internal = prev_internal
             self._ingress_gate.set()
 
     def timestamp_millis(self) -> int:
