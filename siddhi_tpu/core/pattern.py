@@ -150,15 +150,43 @@ def linearize(sis: StateInputStream, count_cap: int = 8) -> PatternSpec:
             a.capture_depth = max(cap, 1)
             atoms.append(a)
         elif isinstance(el, LogicalStateElement):
-            def as_stream(x):
+            def to_parts(x):
                 if isinstance(x, StreamStateElement):
-                    return x.basic_single_input_stream
+                    return x.basic_single_input_stream, False
+                if isinstance(x, AbsentStreamStateElement):
+                    if x.waiting_time is not None:
+                        raise CompileError(
+                            "'not X for <time>' inside and/or is not "
+                            "supported in this build; use the instant "
+                            "'not X and Y' or a separate '-> not X for t' "
+                            "stage")
+                    return x.basic_single_input_stream, True
                 raise CompileError(
-                    "logical pattern sides must be plain stream elements")
+                    "logical pattern sides must be plain or absent stream "
+                    "elements")
+            s1, ab1 = to_parts(el.stream_state_element_1)
+            s2, ab2 = to_parts(el.stream_state_element_2)
+            if ab1 and ab2:
+                raise CompileError(
+                    "both sides of a logical pattern cannot be absent")
+            if (ab1 or ab2) and el.type == "OR":
+                raise CompileError(
+                    "'not X or Y' is not a valid pattern (reference: "
+                    "logical absent combines with 'and' only)")
             pos = len(atoms)
-            a = mk_atom(as_stream(el.stream_state_element_1), pos, every)
-            b = mk_atom(as_stream(el.stream_state_element_2), pos, False)
-            if b.ref == f"__p{pos}":
+            # the PRESENCE side is always the primary atom (it seeds and
+            # captures); an absent side rides as the partner and its
+            # arrival kills the pending state (reference:
+            # AbsentLogicalPreStateProcessor)
+            if ab1:
+                a = mk_atom(s2, pos, every)
+                b = mk_atom(s1, pos, False)
+                b.absent = True
+            else:
+                a = mk_atom(s1, pos, every)
+                b = mk_atom(s2, pos, False)
+                b.absent = ab2
+            if b.ref == a.ref or b.ref == f"__p{pos}":
                 b.ref = f"__p{pos}b"
             a.logical = el.type
             a.partner = b
@@ -344,15 +372,19 @@ class PatternExec:
                 at_pos = jnp.logical_and(st.active, st.pos == a.pos)
                 m = jnp.logical_and(jnp.logical_and(at_pos, cond),
                                     ev_ok[None, :])
-                if a.absent:
+                if atom.absent:
                     kill = jnp.logical_or(kill, m)   # absence violated
                     continue
                 matched_any = jnp.logical_or(matched_any, m)
                 if a.logical is not None:
                     bit = 1 << side
                     have_other = (lmask_new & (3 ^ bit)) != 0
-                    adv = m if a.logical == "OR" else jnp.logical_and(
-                        m, have_other)
+                    # AND with an absent partner: the presence side alone
+                    # completes (absence holds unless the partner's arrival
+                    # killed the state first)
+                    pair_absent = a.partner is not None and a.partner.absent
+                    adv = m if (a.logical == "OR" or pair_absent) \
+                        else jnp.logical_and(m, have_other)
                     lmask_new = jnp.where(m, lmask_new | bit, lmask_new)
                     mark(capture, atom.ckey, m)
                     if last:
@@ -393,10 +425,30 @@ class PatternExec:
             kill = jnp.logical_or(kill, no_match)
 
         # ---- seed (virtual pending slot at position 0) ---------------------
+        # an absent FIRST side (`not A and B` at position 0): A's arrival
+        # disarms the virtual seed (non-every; `every` re-arms immediately,
+        # so the arrival has no lasting effect there — reference:
+        # AbsentLogicalPreStateProcessor restart semantics)
+        if a0.partner is not None and a0.partner.absent and \
+                a0.partner.stream_id == stream_id and not a0.every:
+            patom = a0.partner
+            pfilt = self._filters[patom.ckey]
+            if pfilt is None:
+                pc = jnp.ones((K,), jnp.bool_)
+            else:
+                env_p = dict(env)
+                env_p[patom.ref] = tuple(
+                    jnp.broadcast_to(cc[None, :], st.active.shape)
+                    for cc in ev_cols)
+                pc = _seed_eval(pfilt, env_p, K)
+            disarm = jnp.logical_and(jnp.logical_and(st.seed_on, ev_ok), pc)
+            st = st._replace(seed_on=jnp.logical_and(
+                st.seed_on, jnp.logical_not(disarm)))
         seed_match = jnp.zeros((K,), jnp.bool_)
         seed_side = jnp.zeros((K,), jnp.int32)
         for atom, side in [(a0, 0)] + ([(a0.partner, 1)] if a0.partner else []):
-            if atom is None or atom.stream_id != stream_id or a0.absent:
+            if atom is None or atom.stream_id != stream_id or a0.absent \
+                    or atom.absent:
                 continue
             filt = self._filters[atom.ckey]
             if filt is None:
@@ -416,7 +468,8 @@ class PatternExec:
         # a seed advances immediately iff the first atom completes with one
         # event: single non-count atom, count with min<=1, or logical OR
         if a0.logical is not None:
-            seed_immediate = a0.logical == "OR"
+            seed_immediate = a0.logical == "OR" or (
+                a0.partner is not None and a0.partner.absent)
         elif a0.is_count:
             seed_immediate = a0.min_count <= 1
         else:

@@ -525,10 +525,12 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
     if getattr(qr, "async_emit", False) and qr.app._drainer is not None:
         qr.app._drainer.enqueue(qr, out, now, wake)
         return
-    if getattr(qr, "pipeline_emit", False) and wake is None:
-        # wake is a device-computed scheduler deadline: deferring it would
-        # stall time-driven expiry on an idle stream, so timer-bearing
-        # emissions deliver inline and only wake-free ones pipeline
+    if getattr(qr, "pipeline_emit", False) and wake is None and \
+            not getattr(qr.planned, "needs_timer", False):
+        # timer-bearing queries never pipeline: a device wake scalar would
+        # stall time-driven expiry if deferred, and host-scheduled (cron)
+        # windows pass wake=None yet their flush emissions must not slip a
+        # period — needs_timer covers both
         pending = getattr(qr, "_pending_emit", None)
         qr._pending_emit = (out, now, None)
         if pending is not None:
@@ -2017,7 +2019,12 @@ class SiddhiAppRuntime:
     def _pipeline_enabled(self, q) -> bool:
         """@pipeline on the app or the query: one-deep deferred emission so
         host staging of batch N+1 overlaps the device step of batch N (no
-        extra thread; callbacks arrive one send late until flush())."""
+        extra thread).  The WHOLE delivery lags one send until flush():
+        callbacks, table writes, and downstream stream/window inserts — a
+        reader query in the same app observes this query's effects one
+        batch behind (same relaxation @async makes, minus the thread).
+        Timer-bearing (time/cron-window, absent-pattern) queries are
+        excluded in _emit_output."""
         if self.app.get_annotation("app:pipeline") is not None:
             return True
         return q.get_annotation("pipeline") is not None

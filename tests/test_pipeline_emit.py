@@ -158,3 +158,24 @@ def test_pipeline_partitioned_plain_query(manager):
     h.send([3, 5])
     rt.flush()
     assert got == [(3, 10), (3, 15)]
+
+
+def test_pipeline_cron_window_not_deferred(manager):
+    # host-scheduled (cron) windows pass wake=None yet must deliver their
+    # flush on time — needs_timer excludes them from the deferral
+    # (regression: the flush slipped exactly one cron period)
+    import time as _t
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @pipeline @info(name='q') from S#window.cron('*/1 * * * * ?')
+    select sum(v) as t insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(
+        e.data[0] for e in (cur or [])))
+    rt.start()
+    rt.get_input_handler("S").send([5])
+    deadline = _t.monotonic() + 2.5
+    while not got and _t.monotonic() < deadline:
+        _t.sleep(0.05)
+    assert got, "cron flush did not arrive within ~2 periods"
