@@ -367,12 +367,15 @@ def _enable_compile_cache():
         print(f"compile cache unavailable: {exc!r}", file=sys.stderr)
 
 
-def _probe_backend(timeout_s: float = 180.0) -> None:
+def _probe_backend(timeout_s: float = None) -> None:
     """Fail FAST if the accelerator backend is unreachable: a wedged
     device tunnel makes jax.devices() hang indefinitely, which would hang
     the whole benchmark run rather than reporting an actionable error."""
+    import os
     import threading
 
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
     result = {}
 
     def probe():
@@ -395,8 +398,40 @@ def _probe_backend(timeout_s: float = 180.0) -> None:
 
 
 def main():
+    global N_KEYS, BATCH
+    import os
     _enable_compile_cache()
-    _probe_backend()
+    backend_note = None
+    if os.environ.get("BENCH_CPU_FALLBACK") == "1":
+        # fallback child process: force the CPU platform (a sitecustomize
+        # may pin the tunnel platform at boot) and shrink the workload
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        N_KEYS = 1 << 16
+        BATCH = 1 << 13
+        backend_note = (
+            f"TPU tunnel unreachable; numbers are a CPU-backend fallback "
+            f"at {N_KEYS} keys / {BATCH}-key batches — relative mode "
+            f"comparison only, NOT the TPU measurement")
+        _probe_backend()
+    else:
+        try:
+            _probe_backend()
+        except RuntimeError as exc:
+            # the device tunnel is unreachable: rather than report nothing,
+            # re-exec as a FRESH CPU-only process and say so (round-3
+            # verdict: "if the tunnel stays down, say so and attach the
+            # CPU-backend relative numbers").  A fresh process is required:
+            # the wedged in-process backend-init thread holds jax's init
+            # lock, so an in-process platform switch would hang too.
+            import subprocess
+            print(f"DEVICE BACKEND UNREACHABLE ({exc}); re-running on the "
+                  f"CPU backend at reduced scale", file=sys.stderr)
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       BENCH_CPU_FALLBACK="1")
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env)
+            sys.exit(r.returncode)
     baseline = run_python_baseline()
     # one failing mode must not kill the benchmark (the other modes'
     # numbers still stand); ALL modes failing is a real rc!=0
@@ -419,14 +454,26 @@ def main():
                                     "unit": "events/sec", **l}
     for m, e in errors.items():
         configs[f"flagship_{m}"] = {"error": e}
-    for key, fn in (("lengthBatch_avg", config_length_batch),
-                    ("time_groupby_having", config_time_groupby_having),
-                    ("windowed_join", config_windowed_join),
-                    ("sequence_within", config_sequence_within),
-                    ("flagship_smallbatch_1k",
-                     lambda: flagship_small_batch(1 << 10)),
-                    ("flagship_smallbatch_8k",
-                     lambda: flagship_small_batch(1 << 13))):
+    small = backend_note is not None   # CPU fallback: reduced config scale
+    config_table = (
+        ("lengthBatch_avg", config_length_batch,
+         {"n_batches": 4, "B": 1 << 14}),
+        ("time_groupby_having", config_time_groupby_having,
+         {"n_batches": 4, "B": 1 << 14}),
+        ("windowed_join", config_windowed_join,
+         {"n_batches": 4, "B": 1 << 10}),
+        ("sequence_within", config_sequence_within,
+         {"n_batches": 8, "B": 1 << 10}),
+        ("flagship_smallbatch_1k",
+         lambda **kw: flagship_small_batch(1 << 10, **kw),
+         {"n_sends": 16}),
+        ("flagship_smallbatch_8k",
+         lambda **kw: flagship_small_batch(1 << 13, **kw),
+         {"n_sends": 16}),
+    )
+    for key, cfg_fn, small_kwargs in config_table:
+        fn = (lambda _f=cfg_fn, _kw=(small_kwargs if small else {}):
+              _f(**_kw))
         try:
             t0 = time.perf_counter()
             v, lat_c = fn()
@@ -446,6 +493,7 @@ def main():
         "p50_ms": lat["p50_ms"],
         "p99_ms": lat["p99_ms"],
         "configs": configs,
+        **({"backend_fallback": backend_note} if backend_note else {}),
         "baseline_note": (
             "vs_baseline compares against a measured CPython per-event NFA "
             "interpreter (no JVM exists in this image). A JVM runs that "

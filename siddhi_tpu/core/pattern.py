@@ -104,7 +104,13 @@ class PatternSpec:
 
     @property
     def has_absent(self) -> bool:
-        return any(a.absent for a in self.atoms)
+        """True when timer-driven absent machinery is needed: standalone
+        `not X for t` atoms, or timed absent sides of logical pairs
+        (instant `not A and B` needs no timers)."""
+        return any(
+            a.absent or (a.partner is not None and a.partner.absent and
+                         a.partner.waiting_time is not None)
+            for a in self.atoms)
 
 
 def linearize(sis: StateInputStream, count_cap: int = 8) -> PatternSpec:
@@ -152,20 +158,14 @@ def linearize(sis: StateInputStream, count_cap: int = 8) -> PatternSpec:
         elif isinstance(el, LogicalStateElement):
             def to_parts(x):
                 if isinstance(x, StreamStateElement):
-                    return x.basic_single_input_stream, False
+                    return x.basic_single_input_stream, False, None
                 if isinstance(x, AbsentStreamStateElement):
-                    if x.waiting_time is not None:
-                        raise CompileError(
-                            "'not X for <time>' inside and/or is not "
-                            "supported in this build; use the instant "
-                            "'not X and Y' or a separate '-> not X for t' "
-                            "stage")
-                    return x.basic_single_input_stream, True
+                    return x.basic_single_input_stream, True, x.waiting_time
                 raise CompileError(
                     "logical pattern sides must be plain or absent stream "
                     "elements")
-            s1, ab1 = to_parts(el.stream_state_element_1)
-            s2, ab2 = to_parts(el.stream_state_element_2)
+            s1, ab1, wt1 = to_parts(el.stream_state_element_1)
+            s2, ab2, wt2 = to_parts(el.stream_state_element_2)
             if ab1 and ab2:
                 raise CompileError(
                     "both sides of a logical pattern cannot be absent")
@@ -174,10 +174,17 @@ def linearize(sis: StateInputStream, count_cap: int = 8) -> PatternSpec:
                     "'not X or Y' is not a valid pattern (reference: "
                     "logical absent combines with 'and' only)")
             pos = len(atoms)
+            wt = wt1 if ab1 else wt2
+            if (ab1 or ab2) and wt is not None and pos == 0:
+                raise CompileError(
+                    "leading 'not X for <time> and Y' is not supported in "
+                    "this build (the wait clock starts at a preceding "
+                    "stage); precede it with a stage or drop 'for <time>'")
             # the PRESENCE side is always the primary atom (it seeds and
-            # captures); an absent side rides as the partner and its
-            # arrival kills the pending state (reference:
-            # AbsentLogicalPreStateProcessor)
+            # captures); an absent side rides as the partner: its arrival
+            # kills the pending state until the waiting time (if any) has
+            # elapsed, after which the absence obligation is satisfied
+            # (reference: AbsentLogicalPreStateProcessor)
             if ab1:
                 a = mk_atom(s2, pos, every)
                 b = mk_atom(s1, pos, False)
@@ -186,6 +193,7 @@ def linearize(sis: StateInputStream, count_cap: int = 8) -> PatternSpec:
                 a = mk_atom(s1, pos, every)
                 b = mk_atom(s2, pos, False)
                 b.absent = ab2
+            b.waiting_time = wt if (ab1 or ab2) else None
             if b.ref == a.ref or b.ref == f"__p{pos}":
                 b.ref = f"__p{pos}b"
             a.logical = el.type
@@ -334,6 +342,37 @@ class PatternExec:
                                        st.entry_ts),
                 )
 
+        # timed logical-absent pairs (`not A for t and B`): when the wait
+        # elapses without a matching A, the absence obligation is SATISFIED
+        # (bit 2 in lmask); the state fires once B has also arrived —
+        # whichever of {deadline, B} comes last triggers the completion
+        for a in spec.atoms:
+            p = a.partner
+            if p is None or not p.absent or p.waiting_time is None:
+                continue
+            at_pos = jnp.logical_and(st.active, st.pos == a.pos)
+            pend = jnp.logical_and(at_pos, (st.lmask & 2) == 0)
+            due = jnp.logical_and(
+                pend, st.entry_ts + p.waiting_time <= now_k[None, :])
+            have_b = (st.lmask & 1) != 0
+            fire = jnp.logical_and(due, have_b)
+            st = st._replace(lmask=jnp.where(due, st.lmask | 2, st.lmask)
+                             .astype(jnp.int32))
+            if a.pos == S - 1:
+                absent_complete = jnp.logical_or(absent_complete, fire)
+                absent_ts = jnp.where(fire, st.entry_ts + p.waiting_time,
+                                      absent_ts)
+                st = st._replace(active=jnp.logical_and(
+                    st.active, jnp.logical_not(fire)))
+            else:
+                st = st._replace(
+                    pos=jnp.where(fire, a.pos + 1, st.pos).astype(jnp.int32),
+                    count=jnp.where(fire, 0, st.count).astype(jnp.int32),
+                    lmask=jnp.where(fire, 0, st.lmask).astype(jnp.int32),
+                    entry_ts=jnp.where(fire, st.entry_ts + p.waiting_time,
+                                       st.entry_ts),
+                )
+
         # ---- phase 3: match evaluation (pre-capture state) -----------------
         env = self._build_env(st, stream_id, ev_cols, ev_ts, in_tabs)
         ev_ok = jnp.logical_and(ev_valid, jnp.logical_not(st.done))   # [K]
@@ -373,18 +412,27 @@ class PatternExec:
                 m = jnp.logical_and(jnp.logical_and(at_pos, cond),
                                     ev_ok[None, :])
                 if atom.absent:
-                    kill = jnp.logical_or(kill, m)   # absence violated
+                    # absence violated — unless the obligation was already
+                    # satisfied (timed pair whose wait elapsed, bit 1<<side)
+                    live = (st.lmask & (1 << side)) == 0
+                    kill = jnp.logical_or(kill, jnp.logical_and(m, live))
                     continue
                 matched_any = jnp.logical_or(matched_any, m)
                 if a.logical is not None:
                     bit = 1 << side
                     have_other = (lmask_new & (3 ^ bit)) != 0
-                    # AND with an absent partner: the presence side alone
-                    # completes (absence holds unless the partner's arrival
-                    # killed the state first)
+                    # AND with an absent partner: instant pairs complete on
+                    # the presence side alone; TIMED pairs additionally need
+                    # the satisfied-absence bit the deadline pass sets
                     pair_absent = a.partner is not None and a.partner.absent
-                    adv = m if (a.logical == "OR" or pair_absent) \
-                        else jnp.logical_and(m, have_other)
+                    timed_pair = pair_absent and \
+                        a.partner.waiting_time is not None
+                    if timed_pair:
+                        adv = jnp.logical_and(m, have_other)
+                    elif a.logical == "OR" or pair_absent:
+                        adv = m
+                    else:
+                        adv = jnp.logical_and(m, have_other)
                     lmask_new = jnp.where(m, lmask_new | bit, lmask_new)
                     mark(capture, atom.ckey, m)
                     if last:
@@ -642,10 +690,14 @@ class PatternExec:
             ccount = jnp.concatenate(
                 [jnp.zeros((P, K), jnp.int32),
                  jnp.full((1, K), seed_count, jnp.int32)], axis=0)
+        # lmask only matters while the seed STAYS at position 0 collecting
+        # the other logical side; an immediately-advancing seed (OR, or
+        # AND-with-absent) must start its next position with a CLEAN mask —
+        # residue bits corrupt the absent/logical logic of position 1
         seed_lmask = jnp.where(
             seed_spawn, jnp.left_shift(jnp.ones((K,), jnp.int32), seed_side),
-            0)[None, :] if a0.logical is not None else jnp.zeros((1, K),
-                                                                 jnp.int32)
+            0)[None, :] if (a0.logical is not None and seed_pos == 0) \
+            else jnp.zeros((1, K), jnp.int32)
         clmask = jnp.concatenate(
             [jnp.zeros((P, K), jnp.int32)] + [seed_lmask] * extra, axis=0)
         cstart = jnp.concatenate(

@@ -505,12 +505,21 @@ def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,
     # 16-byte read) and overflow count (rows beyond R matches/key/batch)
     out = (n_valid, n_dropped) + out
 
-    # next wakeup: earliest absent deadline
+    # next wakeup: earliest absent deadline (standalone `not X for t` atoms
+    # and timed absent sides of logical pairs whose wait hasn't elapsed)
     wake = jnp.asarray(NO_WAKEUP, jnp.int64)
     for a in spec.atoms:
         if a.absent:
             at_pos = jnp.logical_and(pstate.active, pstate.pos == a.pos)
             w = jnp.min(jnp.where(at_pos, pstate.entry_ts + a.waiting_time,
                                   NO_WAKEUP))
+            wake = jnp.minimum(wake, w)
+        elif a.partner is not None and a.partner.absent and \
+                a.partner.waiting_time is not None:
+            at_pos = jnp.logical_and(
+                jnp.logical_and(pstate.active, pstate.pos == a.pos),
+                (pstate.lmask & 2) == 0)
+            w = jnp.min(jnp.where(
+                at_pos, pstate.entry_ts + a.partner.waiting_time, NO_WAKEUP))
             wake = jnp.minimum(wake, w)
     return sel_state, out, wake

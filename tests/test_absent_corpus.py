@@ -255,12 +255,125 @@ def test_logical_absent_or_rejected():
         """)
 
 
-def test_logical_absent_with_time_rejected():
+def test_leading_timed_logical_absent_rejected():
+    # the wait clock needs a preceding stage to start from
     from siddhi_tpu.exceptions import CompileError
     m = SiddhiManager()
-    with pytest.raises(CompileError, match="not supported in this build"):
+    with pytest.raises(CompileError, match="leading 'not X for"):
         m.create_siddhi_app_runtime(BASE + """
         @info(name='q') from not S2[price > 20.0] for 1 sec and
             e3=S3[price > 30.0]
         select e3.sym as c insert into Out;
         """)
+
+
+# -- timed logical absent: e1 -> not A for t and B --------------------------
+
+TIMED_QL = """
+@info(name='q') from e1=S1[vol == 1] ->
+    not S2[price > 20.0] for 1 sec and e3=S3[price > 30.0]
+select e1.sym as a, e3.sym as c insert into Out;
+"""
+
+
+def test_timed_logical_absent_b_before_deadline():
+    # B arrives during the wait; fires AT the deadline if no A by then
+    got = run(TIMED_QL, [
+        ("S1", ["a", 1.0, 1], 1000),
+        ("S3", ["c", 35.0, 1], 1400),          # B inside the wait
+        ("S1", ["tick", 1.0, 9], 2500)])       # clock past deadline
+    assert got == [("a", "c")]
+
+
+def test_timed_logical_absent_b_after_deadline():
+    # wait elapses silently, B arrives later -> fires on B
+    got = run(TIMED_QL, [
+        ("S1", ["a", 1.0, 1], 1000),
+        ("S3", ["c", 35.0, 1], 2600)])
+    assert got == [("a", "c")]
+
+
+def test_timed_logical_absent_violated_by_a():
+    got = run(TIMED_QL, [
+        ("S1", ["a", 1.0, 1], 1000),
+        ("S2", ["kill", 25.0, 1], 1300),       # A inside the wait
+        ("S3", ["c", 35.0, 1], 1400),
+        ("S1", ["tick", 1.0, 9], 2500)])
+    assert got == []
+
+
+def test_timed_logical_absent_a_after_deadline_harmless():
+    # A arriving AFTER the wait elapsed cannot un-satisfy the absence
+    got = run(TIMED_QL, [
+        ("S1", ["a", 1.0, 1], 1000),
+        ("S2", ["late", 25.0, 1], 2200),       # after deadline
+        ("S3", ["c", 35.0, 1], 2600)])
+    assert got == [("a", "c")]
+
+
+def test_timed_logical_absent_nonmatching_a_ignored():
+    got = run(TIMED_QL, [
+        ("S1", ["a", 1.0, 1], 1000),
+        ("S2", ["low", 5.0, 1], 1200),         # filter fails: not a violation
+        ("S3", ["c", 35.0, 1], 1500),
+        ("S1", ["tick", 1.0, 9], 2500)])
+    assert got == [("a", "c")]
+
+
+# -- OR-seed residue regressions (review repro): a logical first stage
+# advancing immediately must not leak its lmask bits into absent stages
+
+def test_or_seed_then_absent_killable():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] or e2=S2[vol == 1] ->
+        not S3 for 1 sec
+    select e1.sym as a insert into Out;
+    """, [("S1", ["WSO2", 1.0, 1], 1000),
+          ("S3", ["kill", 1.0, 2], 1300),      # inside wait: must suppress
+          ("S1", ["tick", 1.0, 9], 2500)])
+    assert got == []
+
+
+def test_or_seed_then_absent_fires_clean():
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] or e2=S2[vol == 1] ->
+        not S3 for 1 sec
+    select e1.sym as a insert into Out;
+    """, [("S2", ["viaB", 1.0, 1], 1000),      # seed via side 1
+          ("S1", ["tick", 1.0, 9], 2500)])
+    assert len(got) == 1
+
+
+def test_or_seed_then_timed_logical_absent_needs_presence():
+    # residue bit 1 must not read as "B arrived": no e3 -> no firing
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] or e2=S2[vol == 1] ->
+        not S3[vol == 3] for 1 sec and e3=S3[vol == 4]
+    select e3.sym as c insert into Out;
+    """, [("S1", ["a", 1.0, 1], 1000),
+          ("S1", ["tick", 1.0, 9], 2600)])
+    assert got == []
+
+
+def test_or_seed_then_timed_logical_absent_killable():
+    # residue bit 2 must not read as "absence satisfied"
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] or e2=S2[vol == 1] ->
+        not S3[vol == 3] for 1 sec and e3=S3[vol == 4]
+    select e3.sym as c insert into Out;
+    """, [("S2", ["viaB", 1.0, 1], 1000),      # seed via side 1
+          ("S3", ["kill", 1.0, 3], 1200),      # violates inside the wait
+          ("S3", ["c", 1.0, 4], 1400),
+          ("S1", ["tick", 1.0, 9], 2600)])
+    assert got == []
+
+
+def test_or_seed_then_logical_pair_clean():
+    # residue also corrupted have_other for a PRESENCE pair at position 1
+    got = run("""
+    @info(name='q') from e1=S1[vol == 1] or e2=S2[vol == 1] ->
+        e3=S3[vol == 3] and e4=S3[vol == 4]
+    select e3.sym as c, e4.sym as d insert into Out;
+    """, [("S1", ["a", 1.0, 1], 1000),
+          ("S3", ["c", 1.0, 3], 1100)])
+    assert got == []                            # e4 never arrived
