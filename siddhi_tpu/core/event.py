@@ -94,6 +94,31 @@ def null_mask(x, attr_type: str):
     return (np.zeros if host else jnp.zeros)(np.shape(x), bool)
 
 
+def decode_scalar(attr_type: str, v, interner, objects=None):
+    """Encoded cell -> Python value at a host boundary: the ONE scalar
+    decode rule (Events, on-demand results, script-function arguments all
+    share it).  Reserved null values decode to None; UUID sentinels
+    materialize a fresh id (reference: UUIDFunctionExecutor)."""
+    t = attr_type.upper()
+    if t == "STRING":
+        iv = int(v)
+        if iv == UUID_SENTINEL:
+            import uuid
+            return str(uuid.uuid4())
+        return interner.lookup(iv)
+    if t == "OBJECT":
+        return objects.lookup(int(v)) if objects is not None else None
+    if t == "BOOL":
+        return bool(v)
+    if t in ("FLOAT", "DOUBLE"):
+        f = float(v)
+        return None if f != f else f            # NaN is the float null
+    iv = int(v)
+    if iv == (NULL_INT if t == "INT" else NULL_LONG):
+        return None
+    return iv
+
+
 def fill_uuid_cells(interner, col: "np.ndarray",
                     mask: "np.ndarray") -> "np.ndarray":
     """Replace masked cells with freshly interned uuid4 ids (copy-on-write).
@@ -255,28 +280,7 @@ class Schema:
         return int(v)
 
     def decode_value(self, attr_type: str, v):
-        t = attr_type.upper()
-        if t == "STRING":
-            iv = int(v)
-            if iv == UUID_SENTINEL:
-                # UUID() columns materialize one fresh id per decoded cell
-                # (reference: CORE/executor/function/UUIDFunctionExecutor —
-                # one UUID per event); device-side the column carries the
-                # sentinel, the string exists only at the host boundary
-                import uuid
-                return str(uuid.uuid4())
-            return self.interner.lookup(iv)
-        if t == "OBJECT":
-            return self.objects.lookup(int(v))
-        if t == "BOOL":
-            return bool(v)
-        if t in ("FLOAT", "DOUBLE"):
-            f = float(v)
-            return None if f != f else f        # NaN is the float null
-        iv = int(v)
-        if iv == (NULL_INT if t == "INT" else NULL_LONG):
-            return None
-        return iv
+        return decode_scalar(attr_type, v, self.interner, self.objects)
 
 
 @jax.tree_util.register_pytree_node_class

@@ -594,20 +594,17 @@ def _compile_script_function(fd, expr: AttributeFunction,
         n = flat[0].shape[0] if flat else 1
         out = _np.empty((n,), ev.np_dtype(rtype))
         for i in range(n):
-            data = []
-            for a, t in zip(flat, arg_types):
-                v = a[i]
-                if t == "STRING":
-                    data.append(interner.lookup(int(v)))
-                elif t == "BOOL":
-                    data.append(bool(v))
-                elif t in ("FLOAT", "DOUBLE"):
-                    data.append(float(v))
-                else:
-                    data.append(int(v))
+            # reference scripts receive real nulls: the shared scalar
+            # decode maps in-band null values to None at this boundary
+            data = [ev.decode_scalar(t, a[i], interner)
+                    for a, t in zip(flat, arg_types)]
             r = pyfn(data)
             if rtype == "STRING":
                 out[i] = interner.intern(None if r is None else str(r))
+            elif r is None:
+                # symmetric with the input decode: a script returning None
+                # writes the return type's in-band null value
+                out[i] = ev.null_value(rtype)
             else:
                 out[i] = r
         return out.reshape(shape)

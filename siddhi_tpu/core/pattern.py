@@ -421,18 +421,16 @@ class PatternExec:
                 if a.logical is not None:
                     bit = 1 << side
                     have_other = (lmask_new & (3 ^ bit)) != 0
-                    # AND with an absent partner: instant pairs complete on
-                    # the presence side alone; TIMED pairs additionally need
-                    # the satisfied-absence bit the deadline pass sets
+                    # only OR and INSTANT absent pairs advance on the
+                    # presence side alone; AND-of-presences needs the other
+                    # side's bit and TIMED absent pairs need the
+                    # satisfied-absence bit the deadline pass sets — both
+                    # ride have_other
                     pair_absent = a.partner is not None and a.partner.absent
-                    timed_pair = pair_absent and \
-                        a.partner.waiting_time is not None
-                    if timed_pair:
-                        adv = jnp.logical_and(m, have_other)
-                    elif a.logical == "OR" or pair_absent:
-                        adv = m
-                    else:
-                        adv = jnp.logical_and(m, have_other)
+                    instant_pair = pair_absent and \
+                        a.partner.waiting_time is None
+                    adv = m if (a.logical == "OR" or instant_pair) \
+                        else jnp.logical_and(m, have_other)
                     lmask_new = jnp.where(m, lmask_new | bit, lmask_new)
                     mark(capture, atom.ckey, m)
                     if last:

@@ -1,0 +1,94 @@
+"""Grammar corpus round 3: the syntax surface added in round 4 —
+logical absent forms, pattern in-table probes, @pipeline, custom
+extension namespaces (reference shape: query-compiler parse fixtures)."""
+import pytest
+
+from siddhi_tpu.compiler import SiddhiCompiler
+
+VALID_APPS = [
+    # logical absent — instant, both side orders, chained
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from not A1[x > 0] and e2=B1 "
+    "select e2.y as y insert into O;",
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from e2=B1 and not A1[x > 0] "
+    "select e2.y as y insert into O;",
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from e1=A1 -> not A1[x > 5] and e2=B1 "
+    "select e1.x as x, e2.y as y insert into O;",
+    # logical absent — timed
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from e1=A1 -> not A1[x > 5] for 2 sec and e2=B1 "
+    "select e1.x as x insert into O;",
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from e1=A1 -> e2=B1 and not A1 for 500 ms "
+    "select e1.x as x insert into O;",
+    # pattern filters probing tables
+    "define stream S (k long, v int); define table T (k long);\n"
+    "@info(name='q') from every e1=S[k in T] -> e2=S[v == 2] "
+    "select e1.k as k insert into O;",
+    "define stream S (k long, v int); define table T (k long);\n"
+    "@info(name='q') from every e1=S[not (k in T) and v == 1] -> e2=S[v == 2]"
+    " select e1.k as k insert into O;",
+    # @pipeline — query and app level
+    "define stream S (a int);\n"
+    "@pipeline @info(name='q') from S select a insert into O;",
+    "@app:pipeline define stream S (a int);\n"
+    "@info(name='q') from S select a insert into O;",
+    # custom extension namespaces in select
+    "define stream S (a int);\n"
+    "@info(name='q') from S select ns1:myAgg(a) as m insert into O;",
+    "define stream S (a double);\n"
+    "@info(name='q') from S select k1:f1(a, 2.0) as r group by a "
+    "insert into O;",
+    # UUID + null-centric functions
+    "define stream S (a int, b int);\n"
+    "@info(name='q') from S select UUID() as id, coalesce(a, b) as c, "
+    "default(a, 0) as d, a is null as n insert into O;",
+    # named-window joins (bidirectional) incl. with tables
+    "define stream S (k string, q int); "
+    "define window W (k string, p double) length(8);\n"
+    "@info(name='q') from S#window.length(4) join W on S.k == W.k "
+    "select S.k as k insert into O;",
+    "define table T (k string, f double); "
+    "define window W (k string, p double) length(8);\n"
+    "@info(name='q') from W join T on W.k == T.k "
+    "select W.k as k insert into O;",
+    # unidirectional keyword
+    "define stream S (k string); define stream R (k string);\n"
+    "@info(name='q') from S#window.length(4) unidirectional join "
+    "R#window.length(4) on S.k == R.k select S.k as k insert into O;",
+]
+
+INVALID_APPS = [
+    # both sides absent
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from not A1 and not B1 select 1 as o insert into O;",
+    # or with absent
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from not A1[x > 0] or e2=B1 "
+    "select e2.y as y insert into O;",
+    # leading timed logical absent
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from not A1 for 1 sec and e2=B1 "
+    "select e2.y as y insert into O;",
+    # standalone absent without a waiting time
+    "define stream A1 (x int); define stream B1 (y int);\n"
+    "@info(name='q') from e1=A1 -> not B1 select e1.x as x insert into O;",
+]
+
+
+@pytest.mark.parametrize("ql", VALID_APPS)
+def test_parses(ql):
+    app = SiddhiCompiler.parse(ql)
+    assert app.execution_element_list or app.stream_definition_map
+
+
+@pytest.mark.parametrize("ql", INVALID_APPS)
+def test_rejected_at_compile(ql):
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.exceptions import CompileError, SiddhiParserException
+    m = SiddhiManager()
+    with pytest.raises((CompileError, SiddhiParserException)):
+        m.create_siddhi_app_runtime(ql)
+    m.shutdown()

@@ -1,0 +1,409 @@
+"""Second round-4 corpus batch: expression/function edges, rate-limiter
+variants, window-family edges, on-demand query surface, triggers, and
+playback-clock behaviors (reference shape: FilterTestCase*, ratelimit ×3
+classes, window classes, TEST/store)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def _run(manager, ql, sends, query="q", stream="S", want="current"):
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+
+    def cb(ts, cur, exp):
+        src = cur if want == "current" else exp
+        got.extend(tuple(e.data) for e in (src or []))
+    rt.add_callback(query, cb)
+    rt.start()
+    h = rt.get_input_handler(stream)
+    for e in sends:
+        if isinstance(e, tuple):
+            h.send(list(e[0]), timestamp=e[1])
+        else:
+            h.send(e)
+    rt.flush()
+    return got
+
+
+# -- expression / function edges --------------------------------------------
+
+def test_math_namespace_functions(manager):
+    got = _run(manager, """
+    define stream S (v double);
+    @info(name='q') from S
+    select math:abs(v) as a, math:floor(v) as f, math:ceil(v) as c,
+           math:round(v) as r insert into Out;
+    """, [[-2.7]])
+    a, f, c, r = got[0]
+    assert a == pytest.approx(2.7) and (f, c, r) == (-3.0, -2.0, -3.0)
+
+
+def test_string_equality_and_inequality(manager):
+    got = _run(manager, """
+    define stream S (a string, b string);
+    @info(name='q') from S[a == b or a == "x"] select a, b insert into Out;
+    """, [["p", "p"], ["x", "z"], ["p", "q"]])
+    assert got == [("p", "p"), ("x", "z")]
+
+
+def test_nested_if_then_else(manager):
+    got = _run(manager, """
+    define stream S (v int);
+    @info(name='q') from S
+    select ifThenElse(v > 10, ifThenElse(v > 100, 3, 2), 1) as tier
+    insert into Out;
+    """, [[5], [50], [500]])
+    assert [g[0] for g in got] == [1, 2, 3]
+
+
+def test_modulo_and_integer_division(manager):
+    got = _run(manager, """
+    define stream S (a int, b int);
+    @info(name='q') from S select a % b as m, a / b as d insert into Out;
+    """, [[7, 3], [-7, 3]])
+    assert got[0] == (1, 2)
+    # Java semantics: % keeps dividend sign, / truncates toward zero
+    assert got[1][1] == -2
+
+
+def test_instance_of_checks(manager):
+    got = _run(manager, """
+    define stream S (v int, s string);
+    @info(name='q') from S
+    select instanceOfInteger(v) as i, instanceOfString(v) as x
+    insert into Out;
+    """, [[1, "a"]])
+    assert got == [(True, False)]
+
+
+def test_event_timestamp_function(manager):
+    got = _run(manager, """
+    @app:playback
+    define stream S (v int);
+    @info(name='q') from S select eventTimestamp() as t, v insert into Out;
+    """, [(([1]), 1234)])
+    assert got == [(1234, 1)]
+
+
+def test_convert_function(manager):
+    got = _run(manager, """
+    define stream S (v int);
+    @info(name='q') from S
+    select convert(v, 'double') as d, convert(v, 'long') as l
+    insert into Out;
+    """, [[3]])
+    assert got == [(3.0, 3)]
+
+
+# -- rate limiters ----------------------------------------------------------
+
+def test_rate_limit_first_per_events(manager):
+    got = _run(manager, """
+    define stream S (v int);
+    @info(name='q') from S select v output first every 3 events
+    insert into Out;
+    """, [[i] for i in range(7)])
+    assert [g[0] for g in got] == [0, 3, 6]
+
+
+def test_rate_limit_last_per_events(manager):
+    got = _run(manager, """
+    define stream S (v int);
+    @info(name='q') from S select v output last every 3 events
+    insert into Out;
+    """, [[i] for i in range(6)])
+    assert [g[0] for g in got] == [2, 5]
+
+
+def test_rate_limit_all_batches(manager):
+    got = _run(manager, """
+    define stream S (v int);
+    @info(name='q') from S select v output all every 2 events
+    insert into Out;
+    """, [[i] for i in range(4)])
+    assert [g[0] for g in got] == [0, 1, 2, 3]
+
+
+def test_rate_limit_first_group_by(manager):
+    got = _run(manager, """
+    define stream S (k string, v int);
+    @info(name='q') from S select k, v
+    output first every 2 events insert into Out;
+    """, [["a", 1], ["a", 2], ["a", 3]])
+    assert got[0] == ("a", 1)
+
+
+# -- window-family edges ----------------------------------------------------
+
+def test_length_batch_exact_boundaries(manager):
+    batches = []
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @info(name='q') from S#window.lengthBatch(3)
+    select sum(v) as t insert into Out;
+    """)
+    rt.add_callback("q", lambda ts, cur, exp: batches.append(
+        [e.data[0] for e in (cur or [])]))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in range(1, 8):
+        h.send([v])
+    rt.flush()
+    flat = [v for b in batches for v in b]
+    assert 6 in flat and 15 in flat          # 1+2+3, 4+5+6; 7 pending
+
+
+def test_time_batch_flush(manager):
+    got = _run(manager, """
+    @app:playback
+    define stream S (v int);
+    @info(name='q') from S#window.timeBatch(1 sec)
+    select sum(v) as t insert into Out;
+    """, [(([1]), 1000), (([2]), 1400), (([5]), 2500)])
+    assert (3,) in got                        # first window flushed 1+2
+
+
+def test_delay_window_shifts_events(manager):
+    got = _run(manager, """
+    @app:playback
+    define stream S (v int);
+    @info(name='q') from S#window.delay(1 sec) select v insert into Out;
+    """, [(([1]), 1000), (([2]), 2500)])
+    # the delayed '1' releases when the clock passes 2000 (second send)
+    assert (1,) in got and (2,) not in got
+
+
+def test_sort_window_keeps_top(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @info(name='q') from S#window.sort(2, v, 'desc') select v insert into Out;
+    """)
+    pairs = []
+    rt.add_callback("q", lambda ts, cur, exp: pairs.append(
+        ([e.data[0] for e in (cur or [])], [e.data[0] for e in (exp or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in (5, 9, 1, 7):
+        h.send([v])
+    rt.flush()
+    expired = [v for _, exp in pairs for v in exp]
+    # capacity 2 keeping the largest: 1 and 5 must have been expelled
+    assert 1 in expired and 5 in expired
+    assert 9 not in expired
+
+
+def test_frequent_window_keeps_frequent(manager):
+    got = _run(manager, """
+    define stream S (sym string);
+    @info(name='q') from S#window.frequent(1, sym) select sym insert into Out;
+    """, [["a"], ["a"], ["b"], ["a"]])
+    assert ("a",) in got
+
+
+def test_external_time_window_uses_column(manager):
+    got = _run(manager, """
+    define stream S (ts long, v int);
+    @info(name='q') from S#window.externalTime(ts, 1 sec)
+    select sum(v) as t insert into Out;
+    """, [[1000, 1], [1500, 2], [2600, 4]])
+    # at ts=2600 both earlier events sit outside the 1s window: sum = 4
+    assert got[-1] == (4,) and (3,) in got
+
+
+# -- on-demand query surface -------------------------------------------------
+
+def _table_rt(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (k string, v int);
+    @PrimaryKey('k')
+    define table T (k string, v int);
+    @info(name='w') from S insert into T;
+    """)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(6):
+        h.send([f"k{i}", i * 10])
+    rt.flush()
+    return rt
+
+
+def test_ondemand_select_with_order_and_limit(manager):
+    rt = _table_rt(manager)
+    rows = rt.query("from T select k, v order by v desc limit 2")
+    assert [r.data[1] for r in rows] == [50, 40]
+
+
+def test_ondemand_update_then_verify(manager):
+    rt = _table_rt(manager)
+    rt.query("from T on T.k == 'k2' select k, 999 as nv "
+             "update T set T.v = nv on T.k == k")
+    rows = rt.query("from T on k == 'k2' select v")
+    assert rows[0].data == [999]
+
+
+def test_ondemand_delete_compound_condition(manager):
+    rt = _table_rt(manager)
+    rt.query("from T delete T on T.v > 10 and T.v < 40")
+    rows = rt.query("from T select v")
+    assert sorted(r.data[0] for r in rows) == [0, 10, 40, 50]
+
+
+def test_ondemand_aggregate_having(manager):
+    rt = _table_rt(manager)
+    rows = rt.query(
+        "from T select count() as c having c > 0")
+    assert rows[0].data == [6]
+
+
+def test_ondemand_update_or_insert(manager):
+    rt = _table_rt(manager)
+    rt.query("from T select 'brandnew' as nk, 7 as nv "
+             "update or insert into T set T.k = nk, T.v = nv "
+             "on T.k == nk")
+    rows = rt.query("from T on k == 'brandnew' select v")
+    assert rows and rows[0].data == [7]
+
+
+# -- triggers and playback ---------------------------------------------------
+
+def test_start_trigger_fires_once(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define trigger Boot at 'start';
+    @info(name='q') from Boot select triggered_time insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(cur or []))
+    rt.start()
+    import time as _t
+    deadline = _t.monotonic() + 3
+    while not got and _t.monotonic() < deadline:
+        _t.sleep(0.02)
+    assert len(got) == 1
+
+
+def test_playback_clock_follows_event_time(manager):
+    got = _run(manager, """
+    @app:playback
+    define stream S (v int);
+    @info(name='q') from S select currentTimeMillis() as now, v
+    insert into Out;
+    """, [(([1]), 5000)])
+    assert got[0][0] == 5000
+
+
+def test_fault_stream_routes_errors(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @OnError(action='STREAM')
+    define stream S (v int);
+    @info(name='q') from S select math:ln(v) as l insert into Out;
+    @info(name='f') from !S select v insert into FOut;
+    """)
+    rt.start()                       # wiring compiles; no crash on use
+    rt.get_input_handler("S").send([1])
+    rt.flush()
+
+
+# -- debugger / utilities -----------------------------------------------------
+
+def test_debugger_breakpoint_next_play(manager):
+    import threading
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @info(name='q') from S select v * 2 as w insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(
+        e.data[0] for e in (cur or [])))
+    dbg = rt.debug()
+    hits = []
+
+    def on_break(events, qname, terminal, debugger):
+        hits.append((qname, terminal))
+        debugger.play()
+    dbg.set_debugger_callback(on_break)
+    dbg.acquire_break_point("q", "IN")
+    rt.get_input_handler("S").send([4])
+    rt.flush()
+    assert ("q", "IN") in hits
+    assert got == [8]
+
+
+def test_event_printer_formats(capsys, manager):
+    from siddhi_tpu.utils.testing import EventPrinter
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @info(name='q') from S select v insert into Out;
+    """)
+    p = EventPrinter()
+    rt.add_callback("q", p)
+    rt.start()
+    rt.get_input_handler("S").send([5])
+    rt.flush()
+    assert "5" in capsys.readouterr().out and p.count == 1
+
+
+def test_wait_and_assert_helper(manager):
+    from siddhi_tpu.utils.testing import wait_for_events
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @info(name='q') from S select v insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(cur or []))
+    rt.start()
+    rt.get_input_handler("S").send([1])
+    rt.flush()
+    assert wait_for_events(lambda: len(got), 1, timeout_s=2)
+
+
+def test_env_var_substitution(manager, monkeypatch):
+    monkeypatch.setenv("R4_STREAM_NAME", "EnvStream")
+    rt = manager.create_siddhi_app_runtime("""
+    define stream ${R4_STREAM_NAME} (v int);
+    @info(name='q') from EnvStream select v insert into Out;
+    """)
+    rt.start()
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(
+        e.data[0] for e in (cur or [])))
+    rt.get_input_handler("EnvStream").send([3])
+    rt.flush()
+    assert got == [3]
+
+
+def test_statistics_report_has_memory_and_throughput(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @app:statistics(reporter='none')
+    define stream S (v int);
+    @info(name='q') from S select v insert into Out;
+    """)
+    rt.start()
+    rt.get_input_handler("S").send([1])
+    rt.flush()
+    rep = rt.statistics()
+    text = str(rep)
+    assert "throughput" in text or "Throughput" in text or rep
+
+
+def test_null_in_script_function(manager):
+    # a null argument reaches the python script as None
+    got = _run(manager, """
+    define function tag[python] return string {
+        return "none" if data[0] is None else "val"
+    };
+    define stream S (v int);
+    @info(name='q') from S select tag(v) as t insert into Out;
+    """, [[None], [1]])
+    assert got == [("none",), ("val",)]
+
+
+def test_script_returning_none_is_null(manager):
+    got = _run(manager, """
+    define function pick[python] return long {
+        return None if data[0] < 0 else data[0]
+    };
+    define stream S (v long);
+    @info(name='q') from S select pick(v) as p insert into Out;
+    """, [[-5], [7]])
+    assert got == [(None,), (7,)]
