@@ -584,6 +584,10 @@ def _compile_script_function(fd, expr: AttributeFunction,
     rtype = (fd.return_type or "OBJECT").upper()
     out_dtype = ev.dtype_of(rtype)
     interner = scope.interner
+    # every schema of an app shares one ObjectRegistry; OBJECT-typed script
+    # arguments decode through it (None only for real nulls)
+    objects = next((s.objects for s in scope._sources.values()
+                    if getattr(s, "objects", None) is not None), None)
     arg_types = [a.type for a in args]
 
     def host(*arrs):
@@ -596,7 +600,7 @@ def _compile_script_function(fd, expr: AttributeFunction,
         for i in range(n):
             # reference scripts receive real nulls: the shared scalar
             # decode maps in-band null values to None at this boundary
-            data = [ev.decode_scalar(t, a[i], interner)
+            data = [ev.decode_scalar(t, a[i], interner, objects)
                     for a, t in zip(flat, arg_types)]
             r = pyfn(data)
             if rtype == "STRING":

@@ -148,6 +148,9 @@ class PlannedPatternQuery:
     selector_exec: Any = None
     # UUID() appears in this query: emission materializes sentinels once
     emits_uuid: bool = False
+    # per-key emission row cap the steps compiled with (adaptive growth
+    # doubles it after an implicit-cap overflow)
+    compact_rows: int = 8
 
 
 def plan_pattern_query(
@@ -162,14 +165,18 @@ def plan_pattern_query(
     partition_key_fns: Optional[Dict[str, Callable]] = None,
     mesh=None,
     script_functions=None,
+    compact_rows_override: Optional[int] = None,
 ) -> PlannedPatternQuery:
     sis = query.input_stream
     assert isinstance(sis, StateInputStream)
     # per-key emission row cap (device output compaction); overflow counted
     # in the out[1] scalar.  Tune with @emit(rows='N') on the query.  Only
     # partitioned queries compact by default: for K=1 a per-key cap would
-    # cap the whole batch.
-    compact_rows = 8 if partition_positions else (1 << 30)
+    # cap the whole batch.  compact_rows_override carries the runtime's
+    # adaptive growth after an implicit-cap overflow (state shapes do not
+    # depend on the cap, so only the step functions rebuild).
+    compact_rows = compact_rows_override or (
+        8 if partition_positions else (1 << 30))
     emit_explicit = False
     for ann in query.annotations:
         if ann.name.lower() == "emit":
@@ -329,7 +336,8 @@ def plan_pattern_query(
         partition_positions=partition_positions,
         partition_key_fns=partition_key_fns,
         raw_steps=raw_steps, mesh=mesh, emit_explicit=emit_explicit,
-        selector_exec=sel, emits_uuid=pexec.scope.uses_uuid)
+        selector_exec=sel, emits_uuid=pexec.scope.uses_uuid,
+        compact_rows=compact_rows)
 
 
 def _first_schema(spec: PatternSpec, schemas) -> ev.Schema:

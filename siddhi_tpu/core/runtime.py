@@ -334,10 +334,40 @@ class PatternQueryRuntime:
             if planned.partition_positions else None
         # set by _PartitionPurger: fn(slots, now) recording key liveness
         self._touch = None
+        # set at wiring time: fn(new_cap) -> PlannedPatternQuery re-planned
+        # with a larger emission cap (adaptive overflow growth)
+        self._replan = None
 
     @property
     def name(self):
         return self.planned.name
+
+    _EMIT_CAP_MAX = 512
+
+    def _grow_emission_cap(self, n_dropped: int, n_valid: int = 0) -> bool:
+        """Adaptive degradation for implicit-cap overflow (reference emits
+        unbounded): size the per-key emission cap to the OBSERVED demand
+        (delivered + dropped, next power of two) in one jump — each regrow
+        is a full step rebuild/recompile, so doubling blindly would pay
+        that minutes-long cost repeatedly on a large fan-out.  State shapes
+        are cap-independent, so the live NFA slab carries over.  The
+        overflowing batch already lost `n_dropped` rows (logged);
+        subsequent batches get headroom.  Returns False once the growth
+        budget is exhausted, surfacing the normal overflow error."""
+        if self._replan is None:
+            return False
+        cap = getattr(self.planned, "compact_rows", 8)
+        need = max(n_valid + n_dropped, cap * 2)
+        new_cap = min(1 << (need - 1).bit_length(), self._EMIT_CAP_MAX)
+        if new_cap <= cap:
+            return False
+        import logging
+        logging.getLogger("siddhi_tpu").warning(
+            "%s: %d pattern match rows dropped at emission capacity %d; "
+            "growing the cap to %d (set @emit(rows='N') to pre-size and "
+            "silence this)", self.name, n_dropped, cap, new_cap)
+        self.planned = self._replan(new_cap)
+        return True
 
     def _in_tabs(self):
         """Table snapshots for `x in Table` probes inside NFA filters
@@ -689,16 +719,20 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
         if nd:
             if not getattr(qr.planned, "emit_explicit", True):
                 # the cap was an implicit default: losing matches silently
-                # is a correctness hole.  Deliver the in-capacity rows
-                # first, THEN surface the loss as a processing error (fault
-                # stream / exception listener via the junction) — raised in
-                # the finally below so the error reports partial loss, not
-                # total loss.
-                overflow_exc = MatchOverflowError(
-                    f"{qr.name}: {nd} pattern match rows exceeded the "
-                    f"implicit per-key emission capacity this batch; set "
-                    f"@emit(rows='N') on the query to raise the cap or "
-                    f"accept capped delivery")
+                # is a correctness hole.  First try ADAPTIVE GROWTH — the
+                # runtime rebuilds its steps with a doubled cap (state
+                # shapes don't depend on it) so subsequent batches have
+                # headroom; only when growth is exhausted does the loss
+                # surface as a processing error (fault stream / exception
+                # listener), raised in the finally below so the error
+                # reports partial loss, not total loss.
+                grow = getattr(qr, "_grow_emission_cap", None)
+                if grow is None or not grow(nd, nv):
+                    overflow_exc = MatchOverflowError(
+                        f"{qr.name}: {nd} pattern match rows exceeded the "
+                        f"per-key emission capacity this batch; set "
+                        f"@emit(rows='N') on the query to raise the cap or "
+                        f"accept capped delivery")
             else:
                 import logging
                 logging.getLogger("siddhi_tpu").warning(
@@ -1815,12 +1849,18 @@ class SiddhiAppRuntime:
             return
         if isinstance(q.input_stream, StateInputStream):
             from .pattern_planner import plan_pattern_query
-            planned = plan_pattern_query(
-                q, name, self.schemas, self.interner,
+            import functools
+            plan = functools.partial(
+                plan_pattern_query, q, name, self.schemas, self.interner,
                 script_functions=self.app.function_definition_map)
+            planned = plan()
             self._validate_in_deps(
                 getattr(planned.exec, "in_deps", ()), name)
             runtime = PatternQueryRuntime(planned, self)
+            # the SAME partial replans on emission-cap growth: initial plan
+            # and regrow can never drift apart
+            runtime._replan = lambda cap, _p=plan: _p(
+                compact_rows_override=cap)
             runtime.async_emit = self._async_enabled(q)
             runtime.pipeline_emit = self._pipeline_enabled(q)
             self.query_runtimes[name] = runtime
@@ -2121,16 +2161,21 @@ class SiddhiAppRuntime:
                     ppos[sid] = positions[sid]
                     if sid in key_fns:
                         pfns[sid] = key_fns[sid]
-                planned = plan_pattern_query(
-                    q, qname, self.schemas, self.interner,
-                    key_capacity=keys_cap, slots=nfa_slots,
+                import functools
+                plan = functools.partial(
+                    plan_pattern_query, q, qname, self.schemas,
+                    self.interner, key_capacity=keys_cap, slots=nfa_slots,
                     partition_positions=ppos,
                     partition_key_fns=pfns or None, mesh=self.mesh,
                     script_functions=self.app.function_definition_map)
+                planned = plan()
                 self._validate_in_deps(
                     getattr(planned.exec, "in_deps", ()), qname)
                 runtime = PatternQueryRuntime(planned, self,
                                               slot_allocator=shared_allocator)
+                # same partial => initial plan and regrow cannot drift
+                runtime._replan = lambda cap, _p=plan: _p(
+                    compact_rows_override=cap)
                 runtime.async_emit = self._async_enabled(q)
                 runtime.pipeline_emit = self._pipeline_enabled(q)
                 self.query_runtimes[qname] = runtime

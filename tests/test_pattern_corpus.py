@@ -210,3 +210,41 @@ def test_count_capture_indexed_access():
     rt.flush()
     assert got == [(1, 2, 99)]
     m.shutdown()
+
+
+def test_emission_cap_adaptive_growth(caplog):
+    """Implicit per-key emission cap overflow grows the cap instead of
+    killing the query (the reference emits unbounded); the overflowing
+    batch reports its loss in the log, subsequent batches have headroom."""
+    import logging
+
+    from siddhi_tpu import SiddhiManager
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (k long, v int, p float);
+    partition with (k of S) begin
+    @capacity(keys='16', slots='16') @info(name='q')
+    from every e1=S[v == 1] -> e2=S[v == 2]
+    select e1.k as k, e1.p as p1 insert into Out;
+    end;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, i, o: got.extend(
+        tuple(e.data) for e in (i or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    with caplog.at_level(logging.WARNING, logger="siddhi_tpu"):
+        # 12 pendings on one key, completed in ONE batch -> 12 > cap 8
+        h.send([[5, 1, float(i)] for i in range(12)], timestamp=1000)
+        h.send([[5, 2, 0.0]], timestamp=1001)
+        rt.flush()
+        first = len(got)
+        assert first >= 8                      # capped delivery, no crash
+        assert any("growing the cap" in r.message for r in caplog.records)
+        # same fan-out again: the grown cap (16) now fits all 12
+        h.send([[7, 1, float(i)] for i in range(12)], timestamp=2000)
+        h.send([[7, 2, 0.0]], timestamp=2001)
+        rt.flush()
+    assert len([g for g in got if g[0] == 7]) == 12
+    m.shutdown()
