@@ -321,18 +321,23 @@ class _Output:
     values (reference: IncrementalAttributeAggregator SPI)."""
 
     def __init__(self, name: str, attr_type: str, kind: str,
-                 base_idx: Tuple[int, ...], group_pos: int = -1):
+                 base_idx: Tuple[int, ...], group_pos: int = -1,
+                 custom_fn=None):
         self.name = name
         self.type = attr_type
-        self.kind = kind          # 'group' | 'sum' | 'count' | 'min' | 'max' | 'avg'
+        self.kind = kind  # 'group'|'sum'|'count'|'min'|'max'|'avg'|'custom'
         self.base_idx = base_idx
         self.group_pos = group_pos  # index into group key tuple for 'group'
+        self.custom_fn = custom_fn  # custom SPI: fn([cols]) -> col
 
     def finalize(self, base: np.ndarray) -> np.ndarray:
         """base: [n_rows, n_base] -> [n_rows] output column."""
         if self.kind == "avg":
             s, c = base[:, self.base_idx[0]], base[:, self.base_idx[1]]
             return np.where(c > 0, s / np.maximum(c, 1), 0.0)
+        if self.kind == "custom":
+            return np.asarray(self.custom_fn(
+                [base[:, i] for i in self.base_idx]))
         return base[:, self.base_idx[0]]
 
 
@@ -514,10 +519,33 @@ class AggregationRuntime:
                 self.outputs.append(_Output(
                     name, self.group_types[gpos], "group", (), gpos))
                 continue
-            if not isinstance(e, Function) or e.namespace:
+            if not isinstance(e, Function):
                 raise CompileError(
                     "aggregation selections must be group attrs or "
                     "sum/count/min/max/avg aggregates")
+            if e.namespace:
+                # custom incremental aggregator (reference:
+                # IncrementalAttributeAggregator SPI resolved through
+                # IncrementalAttributeAggregatorExtensionHolder): it
+                # DECLARES base sum/count/min/max accumulators and a
+                # finalize over their running values — same decomposition
+                # contract the built-in avg uses
+                from .extension import incremental_aggregator_registry
+                full = f"{e.namespace}:{e.name}"
+                ext_cls = incremental_aggregator_registry().get(full)
+                if ext_cls is None:
+                    raise CompileError(
+                        f"unknown incremental aggregator {full!r}; "
+                        f"registered: "
+                        f"{sorted(incremental_aggregator_registry())}")
+                args_c = [compile_expression(p, scope)
+                          for p in e.parameters]
+                inst = ext_cls()
+                idxs, fin = inst.decompose(args_c, self._add_base)
+                self.outputs.append(_Output(
+                    name, inst.return_type.upper(), "custom",
+                    tuple(idxs), custom_fn=fin))
+                continue
             fn = e.name
             if fn == "count":
                 i = self._add_base("count", None, None)
@@ -547,6 +575,12 @@ class AggregationRuntime:
                 self.outputs.append(_Output(name, "DOUBLE", "avg", (si, ci)))
 
     def _add_base(self, kind: str, value_fn, value_type) -> int:
+        # also the custom IncrementalAttributeAggregator SPI's entry: an
+        # unknown kind would silently fall through to the additive merge
+        if kind not in ("sum", "count", "min", "max"):
+            raise CompileError(
+                f"incremental base accumulator kind {kind!r} is not one of "
+                f"sum/count/min/max")
         # reuse identical base aggs (avg+sum of same expr share the sum)
         key = (kind, id(value_fn) if value_fn else None)
         for i, b in enumerate(self.base):

@@ -135,6 +135,88 @@ def window_extension(name: str, description: str = "",
     return deco
 
 
+class IncrementalAttributeAggregator:
+    """Custom incremental aggregator for `define aggregation` (reference:
+    IncrementalAttributeAggregator SPI + its ExtensionHolder; the built-in
+    avg is the canonical instance — AvgIncrementalAttributeAggregator
+    decomposes into sum+count base attributes, :57-95).
+
+    Subclass and implement `decompose(args, add_base)`:
+      - args: list[CompiledExpr] (the compiled call arguments)
+      - add_base(kind, value_fn, value_type) -> base index, with kind one
+        of 'sum'|'count'|'min'|'max' and value_fn(env) -> [B] values
+        (None for count)
+      - return (base_indices, finalize) where finalize(cols) maps the
+        running base columns (numpy, bucket-major) to the output column.
+    Base accumulators merge across duration rollups and shards exactly
+    like the built-ins (device slabs, out-of-order, @store rebuild)."""
+
+    return_type: str = "DOUBLE"
+
+    def decompose(self, args, add_base):
+        raise NotImplementedError
+
+
+_INCREMENTAL_AGGREGATORS: Dict[str, type] = {}
+
+
+def incremental_attribute_aggregator(name: str, return_type: str = "",
+                                     description: str = "",
+                                     replace: bool = False):
+    """Register a custom incremental aggregator usable from
+    `define aggregation ... select namespace:name(x) as y ...`."""
+    def deco(cls):
+        if not (isinstance(cls, type) and
+                issubclass(cls, IncrementalAttributeAggregator)):
+            raise CompileError(
+                f"{name!r}: incremental aggregators subclass "
+                f"IncrementalAttributeAggregator")
+        if ":" not in name:
+            # the aggregation compiler resolves ONLY namespaced calls
+            # (bare names are the built-in sum/count/avg/min/max); a bare
+            # registration would be permanently unreachable
+            raise CompileError(
+                f"incremental aggregator {name!r} needs a 'namespace:name' "
+                f"form")
+        _validate(name, "incremental_aggregator", replace)
+        if return_type:
+            cls.return_type = return_type.upper()
+        _INCREMENTAL_AGGREGATORS[name] = cls
+        _METADATA[f"incremental_aggregator:{name}"] = ExtensionMeta(
+            name, "incremental_aggregator",
+            description or (cls.__doc__ or "").strip().split("\n")[0],
+            [], cls.return_type)
+        return cls
+    return deco
+
+
+def incremental_aggregator_registry() -> Dict[str, type]:
+    return _INCREMENTAL_AGGREGATORS
+
+
+def distribution_strategy(name: str, description: str = "",
+                          replace: bool = False):
+    """Register a custom @distribution(strategy='<name>') router
+    (reference: DistributionStrategy SPI via its ExtensionHolder)."""
+    def deco(cls):
+        from ..io.sink import DIST_STRATEGIES, DistributionStrategy as _Base
+        if not (isinstance(cls, type) and issubclass(cls, _Base)):
+            raise CompileError(
+                f"{name!r}: distribution strategies subclass "
+                f"io.sink.DistributionStrategy")
+        _validate(name, "distribution_strategy", replace)
+        if not replace and name.lower() in DIST_STRATEGIES:
+            raise CompileError(
+                f"distribution strategy {name!r} is already registered; "
+                f"pass replace=True to override")
+        DIST_STRATEGIES[name.lower()] = cls
+        _METADATA[f"distribution_strategy:{name}"] = ExtensionMeta(
+            name, "distribution_strategy",
+            description or (cls.__doc__ or "").strip().split("\n")[0])
+        return cls
+    return deco
+
+
 def attribute_aggregator(name: str, return_type: str = "",
                          description: str = "",
                          parameters: Optional[List[str]] = None,

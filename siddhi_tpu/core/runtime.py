@@ -2847,9 +2847,12 @@ class SiddhiManager:
         Sink subclasses as transports, callables as scalar functions
         (returning a CompiledExpr from a list of compiled args)."""
         from ..io.mappers import SinkMapper, SourceMapper
-        from ..io.sink import Sink, register_sink_type
+        from ..io.sink import DistributionStrategy, Sink, register_sink_type
         from ..io.source import Source, register_source_type
-        from .extension import (AttributeAggregator, attribute_aggregator,
+        from .extension import (AttributeAggregator,
+                                IncrementalAttributeAggregator,
+                                attribute_aggregator, distribution_strategy,
+                                incremental_attribute_aggregator,
                                 scalar_function, sink_mapper, source_mapper,
                                 window_extension)
         from .window import WindowProcessor
@@ -2857,6 +2860,11 @@ class SiddhiManager:
             window_extension(name, replace=True)(impl)
         elif isinstance(impl, type) and issubclass(impl, AttributeAggregator):
             attribute_aggregator(name, replace=True)(impl)
+        elif isinstance(impl, type) and issubclass(
+                impl, IncrementalAttributeAggregator):
+            incremental_attribute_aggregator(name, replace=True)(impl)
+        elif isinstance(impl, type) and issubclass(impl, DistributionStrategy):
+            distribution_strategy(name, replace=True)(impl)
         elif isinstance(impl, type) and issubclass(impl, SourceMapper):
             source_mapper(name, replace=True)(impl)
         elif isinstance(impl, type) and issubclass(impl, SinkMapper):

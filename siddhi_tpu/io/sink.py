@@ -5,7 +5,7 @@ InMemorySink.java:115, distributed/RoundRobin:99 + Partitioned:111).
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, List
+from typing import Any, Dict, List, Optional
 
 from ..core import event as ev
 from .broker import InMemoryBroker
@@ -66,6 +66,61 @@ def register_sink_type(name: str, cls: type) -> None:
     SINK_TYPES[name] = cls
 
 
+class DistributionStrategy:
+    """@distribution strategy SPI (reference: distributed/
+    DistributionStrategy.java — RoundRobin:99 / Partitioned:111 in core;
+    custom strategies register with @distribution_strategy or
+    setExtension).  One instance per distributed sink."""
+
+    def init(self, schema, dist_ann, n_destinations: int) -> None:
+        self.schema = schema
+        self.ann = dist_ann
+        self.n = n_destinations
+
+    def destination(self, event, payload) -> int:
+        """Destination index in [0, n) for one event/payload."""
+        raise NotImplementedError
+
+
+class RoundRobinStrategy(DistributionStrategy):
+    """Cycles destinations (reference: RoundRobinStrategy.java:99)."""
+
+    def init(self, schema, dist_ann, n_destinations):
+        super().init(schema, dist_ann, n_destinations)
+        self._rr = 0
+
+    def destination(self, event, payload):
+        i = self._rr % self.n
+        self._rr += 1
+        return i
+
+
+class PartitionedStrategy(DistributionStrategy):
+    """Stable-hash routing on partitionKey (reference:
+    PartitionedStrategy.java:111)."""
+
+    def init(self, schema, dist_ann, n_destinations):
+        super().init(schema, dist_ann, n_destinations)
+        key = dist_ann.element("partitionKey")
+        if key is None:
+            raise ValueError("partitioned distribution needs partitionKey=")
+        self._pos = schema.position(key)
+
+    def destination(self, event, payload):
+        if event is None:
+            raise ValueError(
+                "partitioned distribution needs a 1:1 sink mapper (the "
+                "mapper emitted a different payload count, so payloads "
+                "cannot be matched to their events' partition keys)")
+        return _stable_hash(event.data[self._pos]) % self.n
+
+
+DIST_STRATEGIES: Dict[str, type] = {
+    "roundrobin": RoundRobinStrategy,
+    "partitioned": PartitionedStrategy,
+}
+
+
 class SinkRuntime:
     """Wires one @sink annotation: stream events -> mapper -> transport(s).
 
@@ -100,18 +155,15 @@ class SinkRuntime:
         self.mapper: SinkMapper = SINK_MAPPERS[mtype](schema, map_ann)
 
         self.sinks: List[Sink] = []
-        self.strategy = None
-        self.partition_positions = None
-        self._rr = 0
+        self.strategy: Optional[DistributionStrategy] = None
         if dist_ann is not None:
-            self.strategy = (dist_ann.element("strategy") or
-                             "roundRobin")
-            key = dist_ann.element("partitionKey")
-            if self.strategy == "partitioned":
-                if key is None:
-                    raise ValueError(
-                        "partitioned distribution needs partitionKey=")
-                self.partition_positions = schema.position(key)
+            sname = str(dist_ann.element("strategy") or "roundRobin")
+            scls = DIST_STRATEGIES.get(sname.lower())
+            if scls is None:
+                raise ValueError(
+                    f"unknown distribution strategy {sname!r}; registered: "
+                    f"{sorted(DIST_STRATEGIES)}")
+            self.strategy = scls()
             for dest in dist_ann.annotations:
                 if dest.name.lower() == "destination":
                     opts = dict(self.options)
@@ -124,6 +176,7 @@ class SinkRuntime:
                     self.sinks.append(s)
             if not self.sinks:
                 raise ValueError("@distribution needs @destination(...)s")
+            self.strategy.init(schema, dist_ann, len(self.sinks))
         else:
             s = SINK_TYPES[stype]()
             s.config_reader = app.config_manager.generate_config_reader(
@@ -146,11 +199,13 @@ class SinkRuntime:
             for p in payloads:
                 self.sinks[0].publish(p)
             return
-        if self.strategy == "roundRobin":
-            for p in payloads:
-                self.sinks[self._rr % len(self.sinks)].publish(p)
-                self._rr += 1
-        else:  # partitioned
-            for e, p in zip(events, payloads):
-                v = e.data[self.partition_positions]
-                self.sinks[_stable_hash(v) % len(self.sinks)].publish(p)
+        if len(payloads) == len(events):
+            pairs = zip(events, payloads)
+        else:
+            # a custom mapper emitted N payloads per event: every payload
+            # still publishes; event-based strategies (partitioned) get
+            # event=None and must reject it rather than drop data
+            pairs = ((None, p) for p in payloads)
+        for e, p in pairs:
+            self.sinks[self.strategy.destination(e, p)
+                       % len(self.sinks)].publish(p)

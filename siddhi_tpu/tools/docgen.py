@@ -37,8 +37,10 @@ def collect() -> Dict[str, List[Tuple[str, str]]]:
             getattr(fn, "__doc__", "") or type(fn).__doc__))
         for name, fn in STREAM_FUNCTIONS.items())
     from ..core.extension import (attribute_aggregator_registry,
+                                  incremental_aggregator_registry,
                                   script_engine_registry)
     from ..io.mappers import SINK_MAPPERS, SOURCE_MAPPERS
+    from ..io.sink import DIST_STRATEGIES
     out["aggregators"] = sorted(
         [(n, "") for n in AGGREGATOR_NAMES] +
         [(n, _first_paragraph(cls.__doc__))
@@ -52,6 +54,12 @@ def collect() -> Dict[str, List[Tuple[str, str]]]:
     out["script-engines"] = sorted(
         (name, _first_paragraph(fn.__doc__))
         for name, fn in script_engine_registry().items())
+    out["incremental-aggregators"] = sorted(
+        (name, _first_paragraph(cls.__doc__))
+        for name, cls in incremental_aggregator_registry().items())
+    out["distribution-strategies"] = sorted(
+        (name, _first_paragraph(cls.__doc__))
+        for name, cls in DIST_STRATEGIES.items())
     def _scalar_summary(name, fn):
         m = meta.get(f"scalar_function:{name}")
         return (m.description if m else "") or \

@@ -293,3 +293,117 @@ def test_docgen_covers_new_kinds():
     assert any(n == "csv" for n, _ in got["source-mappers"])
     assert any(n == "csv" for n, _ in got["sink-mappers"])
     assert any(n == "python" for n, _ in got["script-engines"])
+
+
+# ---------------------------------------------------------------------------
+# custom incremental aggregators + distribution strategies (the last two of
+# the reference's 13 extension holder kinds)
+# ---------------------------------------------------------------------------
+
+def test_custom_incremental_aggregator(manager):
+    import numpy as np
+    from siddhi_tpu.core.extension import (
+        IncrementalAttributeAggregator,
+        incremental_attribute_aggregator,
+    )
+
+    @incremental_attribute_aggregator("stats:range", return_type="DOUBLE",
+                                      replace=True)
+    class _RangeIncr(IncrementalAttributeAggregator):
+        """max - min per bucket."""
+
+        def decompose(self, args, add_base):
+            (a,) = args
+            i_mx = add_base("max", a.fn, a.type)
+            i_mn = add_base("min", a.fn, a.type)
+            return (i_mx, i_mn), lambda cols: cols[0] - cols[1]
+
+    rt = manager.create_siddhi_app_runtime("""
+    define stream P (sym string, price double, ts long);
+    define aggregation Agg
+    from P select sym, stats:range(price) as spread, avg(price) as ap
+    group by sym aggregate by ts every sec ... min;
+    """)
+    rt.start()
+    h = rt.get_input_handler("P")
+    h.send(["a", 10.0, 1_000])
+    h.send(["a", 4.0, 1_200])
+    h.send(["a", 7.0, 1_800])
+    rt.flush()
+    rows = rt.query(
+        "from Agg within 0L, 10000L per 'sec' select sym, spread, ap")
+    assert rows and rows[0].data[1] == 6.0          # 10 - 4
+    assert abs(rows[0].data[2] - 7.0) < 1e-9
+
+
+def test_custom_distribution_strategy(manager):
+    from siddhi_tpu.io.broker import subscribe_fn
+    from siddhi_tpu.io.sink import DistributionStrategy
+    from siddhi_tpu.core.extension import distribution_strategy
+
+    @distribution_strategy("evenOdd", replace=True)
+    class _EvenOdd(DistributionStrategy):
+        """Routes even values to destination 0, odd to 1."""
+
+        def destination(self, event, payload):
+            return int(event.data[0]) % 2
+
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @sink(type='inMemory', @map(type='passThrough'),
+          @distribution(strategy='evenOdd',
+                        @destination(topic='even'),
+                        @destination(topic='odd')))
+    define stream Out (v int);
+    @info(name='q') from S select v insert into Out;
+    """)
+    rt.start()
+    evens, odds = [], []
+    s1 = subscribe_fn("even", lambda p: evens.append(p))
+    s2 = subscribe_fn("odd", lambda p: odds.append(p))
+    h = rt.get_input_handler("S")
+    for v in (1, 2, 3, 4):
+        h.send([v])
+    rt.flush()
+    import time as _t
+    deadline = _t.monotonic() + 3
+    while len(evens) + len(odds) < 4 and _t.monotonic() < deadline:
+        _t.sleep(0.02)
+    assert sorted(e.data[0] for e in evens) == [2, 4]
+    assert sorted(e.data[0] for e in odds) == [1, 3]
+    InMemoryBroker.unsubscribe(s1)
+    InMemoryBroker.unsubscribe(s2)
+
+
+def test_set_extension_infers_new_kinds(manager):
+    from siddhi_tpu.core.extension import (
+        IncrementalAttributeAggregator,
+        incremental_aggregator_registry,
+    )
+    from siddhi_tpu.io.sink import DIST_STRATEGIES, DistributionStrategy
+
+    class _Incr(IncrementalAttributeAggregator):
+        def decompose(self, args, add_base):
+            i = add_base("count", None, None)
+            return (i,), lambda cols: cols[0]
+
+    class _Strat(DistributionStrategy):
+        def destination(self, event, payload):
+            return 0
+
+    manager.set_extension("xk:cnt", _Incr)
+    manager.set_extension("firstOnly", _Strat)
+    # bare incremental-aggregator names are unreachable -> rejected
+    import pytest as _pytest
+    from siddhi_tpu.exceptions import CompileError as _CE
+    with _pytest.raises(_CE, match="namespace:name"):
+        manager.set_extension("bareIncr", _Incr)
+    assert "xk:cnt" in incremental_aggregator_registry()
+    assert DIST_STRATEGIES["firstonly"] is _Strat
+
+
+def test_docgen_covers_last_kinds():
+    from siddhi_tpu.tools.docgen import collect
+    got = collect()
+    assert any(n == "roundrobin" for n, _ in got["distribution-strategies"])
+    assert "incremental-aggregators" in got
