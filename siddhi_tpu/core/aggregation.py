@@ -316,6 +316,13 @@ class _DurationStore:
             key_axis=1)
 
 
+def _null_of(attr_type: str) -> float:
+    """The output type's in-band null as float64 (int sentinels are exact
+    in f64 for the reserved minima)."""
+    v = ev.null_value(attr_type)
+    return float(v)
+
+
 class _Output:
     """One declared output attribute and how to finalize it from base
     values (reference: IncrementalAttributeAggregator SPI)."""
@@ -331,14 +338,23 @@ class _Output:
         self.custom_fn = custom_fn  # custom SPI: fn([cols]) -> col
 
     def finalize(self, base: np.ndarray) -> np.ndarray:
-        """base: [n_rows, n_base] -> [n_rows] output column."""
+        """base: [n_rows, n_base] -> [n_rows] output column.  A bucket
+        whose inputs were ALL null yields null (the in-band value of the
+        output type — NaN would crash int decode for LONG sums)."""
+        nullv = float(_null_of(self.type))
         if self.kind == "avg":
             s, c = base[:, self.base_idx[0]], base[:, self.base_idx[1]]
-            return np.where(c > 0, s / np.maximum(c, 1), 0.0)
+            return np.where(c > 0, s / np.maximum(c, 1), nullv)
         if self.kind == "custom":
             return np.asarray(self.custom_fn(
                 [base[:, i] for i in self.base_idx]))
-        return base[:, self.base_idx[0]]
+        col = base[:, self.base_idx[0]]
+        if self.kind in ("sum", "min", "max") and len(self.base_idx) > 1:
+            # the paired non-null count decides emptiness — sniffing the
+            # accumulator for its identity would misread legitimate ±inf
+            # data as an empty bucket
+            return np.where(base[:, self.base_idx[1]] > 0, col, nullv)
+        return col
 
 
 class AggregationRuntime:
@@ -470,8 +486,16 @@ class AggregationRuntime:
             for b in base:
                 if b.value_fn is None:
                     vals.append(jnp.ones(ts.shape, jnp.float64))
-                else:
-                    vals.append(jnp.asarray(b.value_fn(env), jnp.float64))
+                    continue
+                raw = b.value_fn(env)
+                v = jnp.asarray(raw, jnp.float64)
+                if b.dtype is not None:
+                    # null inputs contribute the accumulator identity —
+                    # one NaN would otherwise poison its bucket FOREVER
+                    # (reference: incremental aggregators skip nulls)
+                    v = jnp.where(ev.null_mask(raw, b.dtype),
+                                  jnp.asarray(b.identity(), jnp.float64), v)
+                vals.append(v)
             return keep, jnp.stack(vals) if vals else jnp.zeros((0,) + ts.shape)
 
         self._step = jax.jit(step)
@@ -558,21 +582,48 @@ class AggregationRuntime:
                     f"sum/count/avg/min/max/distinctCount)")
             if len(e.parameters) != 1:
                 raise CompileError(f"{fn}() takes one argument")
-            c = compile_expression(e.parameters[0], scope)
+            # ONE CompiledExpr per distinct argument expression: this is
+            # what lets _add_base's identity dedup and _count_nonnull's
+            # memo actually share slab rows across sum/avg/min/max of the
+            # same expr
+            from .selector import _expr_fingerprint
+            if not hasattr(self, "_arg_cache"):
+                self._arg_cache = {}
+            akey = _expr_fingerprint(e.parameters[0])
+            c = self._arg_cache.get(akey)
+            if c is None:
+                c = compile_expression(e.parameters[0], scope)
+                self._arg_cache[akey] = c
             if c.type not in ("INT", "LONG", "FLOAT", "DOUBLE"):
                 raise CompileError(f"{fn}() needs a numeric argument")
             is_int = c.type in ("INT", "LONG")
             if fn == "sum":
                 i = self._add_base("sum", c.fn, c.type)
+                ci = self._add_base("count", self._count_nonnull(c), None)
                 self.outputs.append(_Output(
-                    name, "LONG" if is_int else "DOUBLE", "sum", (i,)))
+                    name, "LONG" if is_int else "DOUBLE", "sum", (i, ci)))
             elif fn in ("min", "max"):
                 i = self._add_base(fn, c.fn, c.type)
-                self.outputs.append(_Output(name, c.type, fn, (i,)))
+                ci = self._add_base("count", self._count_nonnull(c), None)
+                self.outputs.append(_Output(name, c.type, fn, (i, ci)))
             else:  # avg -> sum + count (reference: Avg...Aggregator :57-95)
                 si = self._add_base("sum", c.fn, c.type)
-                ci = self._add_base("count", None, None)
+                # nulls count for neither the sum nor the divisor
+                ci = self._add_base("count", self._count_nonnull(c), None)
                 self.outputs.append(_Output(name, "DOUBLE", "avg", (si, ci)))
+
+    def _count_nonnull(self, c):
+        """Shared per-argument non-null counter base fn (sum+avg of one
+        expr share a single scatter row)."""
+        if not hasattr(self, "_cnt_fns"):
+            self._cnt_fns = {}
+        fn = self._cnt_fns.get(id(c))
+        if fn is None:
+            def fn(env, _c=c):
+                v = _c.fn(env)
+                return jnp.where(ev.null_mask(v, _c.type), 0.0, 1.0)
+            self._cnt_fns[id(c)] = fn
+        return fn
 
     def _add_base(self, kind: str, value_fn, value_type) -> int:
         # also the custom IncrementalAttributeAggregator SPI's entry: an

@@ -233,3 +233,28 @@ def test_uuid_sentinel_is_not_null(manager):
     """
     got = _run(manager, ql, [[1], [2]])
     assert got == [[False, 1], [False, 2]]
+
+
+def test_incremental_aggregation_skips_nulls(manager):
+    # a single NaN must not poison a duration bucket forever, and an
+    # all-null bucket yields null outputs (reference: incremental
+    # aggregators skip null inputs)
+    rt = manager.create_siddhi_app_runtime("""
+    define stream P (sym string, price double, ts long);
+    define aggregation A
+    from P select sym, sum(price) as total, avg(price) as ap,
+                  min(price) as mn, max(price) as mx, count() as c
+    group by sym aggregate by ts every sec ... min;
+    """)
+    rt.start()
+    h = rt.get_input_handler("P")
+    h.send(["a", 2.0, 1000])
+    h.send(["a", None, 1200])
+    h.send(["a", 3.0, 1800])
+    h.send(["b", None, 1500])
+    rt.flush()
+    rows = {r.data[0]: r.data[1:] for r in rt.query(
+        "from A within 0L, 10000L per 'sec' "
+        "select sym, total, ap, mn, mx, c")}
+    assert rows["a"] == [5.0, 2.5, 2.0, 3.0, 3]   # count() counts rows
+    assert rows["b"] == [None, None, None, None, 1]
