@@ -42,6 +42,7 @@ import numpy as np
 from ..query_api.expression import Constant, Expression, Variable
 from . import event as ev
 from .executor import CompileError, Scope, compile_expression
+from .steputil import jit_step
 
 DURATION_MS = {
     "SECONDS": 1000,
@@ -498,7 +499,7 @@ class AggregationRuntime:
                 vals.append(v)
             return keep, jnp.stack(vals) if vals else jnp.zeros((0,) + ts.shape)
 
-        self._step = jax.jit(step)
+        self._step = jit_step(step)
 
         # device merge: one scatter per base row into the duration slab
         kinds = tuple(b.kind for b in self.base)
@@ -519,7 +520,7 @@ class AggregationRuntime:
                 rows.append(r)
             return jnp.stack(rows)
 
-        self._merge = jax.jit(merge, donate_argnums=(0,))
+        self._merge = jit_step(merge, donate_argnums=(0,))
 
     # -- construction ---------------------------------------------------------
     def _decompose(self, selector, scope: Scope) -> None:

@@ -24,6 +24,7 @@ from ..query_api.query import JoinInputStream, Query, SingleInputStream, Window
 from . import event as ev
 from .executor import CompileError, CompiledExpr, Scope, compile_expression
 from .selector import SelectorExec
+from .steputil import jit_step
 from .window import (
     NO_WAKEUP,
     Buffer,
@@ -358,7 +359,7 @@ def plan_join_query(
                 (nstate[0], nstate[1], sel_state), mesh)
             return new_state, out, wout.next_wakeup
 
-        return jax.jit(step, donate_argnums=(0,))
+        return jit_step(step, donate_argnums=(0,))
 
     step_left = None
     step_right = None
@@ -421,4 +422,4 @@ def _make_feed_only(side: JoinSide, is_left: bool, mesh=None):
         return _constrain_state(new_state, mesh), out_empty, \
             wout.next_wakeup
 
-    return jax.jit(step, donate_argnums=(0,))
+    return jit_step(step, donate_argnums=(0,))

@@ -23,6 +23,7 @@ from .executor import CompileError, Scope
 from .pattern import PatternExec, PatternSpec, linearize, oh_take
 from .selector import SelectorExec
 from .window import NO_WAKEUP, Rows
+from .steputil import jit_step
 
 
 class StatePacker:
@@ -278,9 +279,9 @@ def plan_pattern_query(
     raw_steps = {sid: make_step(sid) for sid in spec.stream_ids}
     dense_steps = None
     if mesh is None:
-        steps = {sid: jax.jit(body, donate_argnums=(0, 1))
+        steps = {sid: jit_step(body, donate_argnums=(0, 1))
                  for sid, body in raw_steps.items()}
-        dense_steps = {sid: jax.jit(make_step(sid, dense=True),
+        dense_steps = {sid: jit_step(make_step(sid, dense=True),
                                     donate_argnums=(0, 1))
                        for sid in spec.stream_ids}
     else:
@@ -316,7 +317,7 @@ def plan_pattern_query(
                 jnp.any(nb64 != b64, axis=0)
             return (nb32, nb64, nscalars), sel_state, out, wake, changed
 
-        timer_step = jax.jit(tstep, donate_argnums=(0, 1))
+        timer_step = jit_step(tstep, donate_argnums=(0, 1))
 
     def init_state(K: int):
         return packer.pack(pexec.init_state(K)), sel.init_state()
@@ -426,7 +427,7 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
         local, mesh=mesh,
         in_specs=(pspec, sspec, rspec, rspec, bspec, bspec, P(), P()),
         out_specs=(pspec, sspec, (P(), P(), bspec, bspec, bspec, bspec), P()))
-    return jax.jit(sharded, donate_argnums=(0, 1))
+    return jit_step(sharded, donate_argnums=(0, 1))
 
 
 def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,

@@ -26,6 +26,7 @@ from ..query_api.query import (
 )
 from . import event as ev
 from .executor import CompileError, Scope, compile_expression
+from .steputil import jit_step
 from .keyslots import SlotAllocator
 from .selector import SelectorExec
 from .window import (
@@ -180,7 +181,7 @@ def _shard_plain_step(step, mesh, sel, wproc, group_slots: int):
         in_specs=((wspec, sspec), rspec, rspec, rspec, rspec, rspec, P(),
                   rspec, rspec),
         out_specs=((wspec, sspec), (P(), P(), P(), P()), P()))
-    return jax.jit(sharded, donate_argnums=(0,))
+    return jit_step(sharded, donate_argnums=(0,))
 
 
 def _shard_keyed_step(kstep, mesh, K: int):
@@ -249,7 +250,7 @@ def _shard_keyed_step(kstep, mesh, K: int):
         in_specs=((wspec, rspec), rspec, rspec, rspec, rspec, rspec, rspec,
                   rspec, P(), rspec),
         out_specs=((wspec, rspec), (P(), P(), P(), P()), P()))
-    return jax.jit(sharded, donate_argnums=(0,))
+    return jit_step(sharded, donate_argnums=(0,))
 
 
 def plan_single_query(
@@ -521,10 +522,10 @@ def plan_single_query(
             # the replicated-state delta merge; they stay single-device
             and not wproc.emits_reset)
         if kshardable:
-            jit_step = _shard_keyed_step(kstep, mesh, K)
+            step_fn = _shard_keyed_step(kstep, mesh, K)
             keyed_mesh = mesh
         else:
-            jit_step = jax.jit(kstep, donate_argnums=(0,))
+            step_fn = jit_step(kstep, donate_argnums=(0,))
             keyed_mesh = None
 
         def init_state():
@@ -545,11 +546,11 @@ def plan_single_query(
             # keep outputs row-aligned so the sharded psum merge preserves
             # single-device delivery order
             wproc.compact = False
-            jit_step = _shard_plain_step(step, mesh, sel, wproc,
+            step_fn = _shard_plain_step(step, mesh, sel, wproc,
                                          allocator.capacity)
             plain_mesh = mesh
         else:
-            jit_step = jax.jit(step, donate_argnums=(0,))
+            step_fn = jit_step(step, donate_argnums=(0,))
             plain_mesh = None
 
         def init_state():
@@ -565,7 +566,7 @@ def plan_single_query(
         window=wproc,
         group_by_positions=gpos,
         selector_exec=sel,
-        step=jit_step,
+        step=step_fn,
         init_state=init_state,
         slot_allocator=allocator,
         batch_capacity=batch_capacity,

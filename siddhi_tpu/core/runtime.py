@@ -31,6 +31,7 @@ from .executor import CompileError
 from .keyslots import SlotAllocator
 from .planner import PlannedQuery, plan_single_query
 from .window import NO_WAKEUP
+from .steputil import jit_step
 
 _NO_WAKEUP_INT = int(NO_WAKEUP)
 
@@ -1048,7 +1049,7 @@ class NamedWindowRuntime:
         # (_other_table) without holding _qlock through their own step —
         # donation would let a concurrent ingest delete the buffers a
         # join just captured
-        self._step = jax.jit(step)
+        self._step = jit_step(step)
         self.state = jax.tree.map(
             lambda x: jax.numpy.array(x, copy=True), wproc.init_state())
 

@@ -18,7 +18,6 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -29,6 +28,7 @@ from . import event as ev
 from .executor import CompileError, CompiledExpr, Scope, compile_expression
 from .keyslots import SlotAllocator
 from .table_index import AttributeIndex, IndexPlan, split_index_condition
+from .steputil import jit_step
 
 
 class TableCondition:
@@ -96,10 +96,9 @@ class TableRuntime:
         self._append_ptr = 0  # non-keyed append position (host-tracked)
         self._free_rows: List[int] = []
 
-        self._jit_write = jax.jit(self._write_impl, donate_argnums=(0, 1, 2))
-        self._jit_masked_delete = jax.jit(self._masked_delete_impl,
+        self._jit_write = jit_step(self._write_impl, donate_argnums=(0, 1, 2))
+        self._jit_masked_delete = jit_step(self._masked_delete_impl,
                                           donate_argnums=(0,))
-        self._jit_masked_update = None  # built per update-set signature
 
     # -- row-slot resolution ---------------------------------------------------
     def _slots_for_batch(self, staged_cols: Sequence[np.ndarray],
