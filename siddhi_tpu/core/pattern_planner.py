@@ -21,9 +21,14 @@ from ..query_api.query import Query, StateInputStream
 from . import event as ev
 from .executor import CompileError, Scope
 from .pattern import PatternExec, PatternSpec, linearize, oh_take
+from .pattern_block import block_eligible, make_block_step
 from .selector import SelectorExec
 from .window import NO_WAKEUP, Rows
 from .steputil import jit_step
+
+# test hook: force the sequential scan path even for block-eligible specs
+# (golden cross-checks compare the two implementations on the same input)
+_FORCE_SCAN = False
 
 
 class StatePacker:
@@ -278,11 +283,20 @@ def plan_pattern_query(
 
     raw_steps = {sid: make_step(sid) for sid in spec.stream_ids}
     dense_steps = None
-    if mesh is None:
+    if mesh is None and partition_positions is None and \
+            block_eligible(spec) and not _FORCE_SCAN:
+        # single-key simple chain: the sequential E-tick scan degrades to
+        # interpreter speed (round-4: 776 ev/s); the block path advances a
+        # whole chunk in S-1 vectorized stages — see pattern_block.py
+        steps = {sid: jit_step(
+            make_block_step(spec, pexec, sel, schemas, packer, sid,
+                            compact_rows),
+            donate_argnums=(0, 1)) for sid in spec.stream_ids}
+    elif mesh is None:
         steps = {sid: jit_step(body, donate_argnums=(0, 1))
                  for sid, body in raw_steps.items()}
         dense_steps = {sid: jit_step(make_step(sid, dense=True),
-                                    donate_argnums=(0, 1))
+                                     donate_argnums=(0, 1))
                        for sid in spec.stream_ids}
     else:
         steps = {sid: _shard_step(body, mesh, packer, pexec, sel)

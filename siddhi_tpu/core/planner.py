@@ -547,7 +547,7 @@ def plan_single_query(
             # single-device delivery order
             wproc.compact = False
             step_fn = _shard_plain_step(step, mesh, sel, wproc,
-                                         allocator.capacity)
+                                        allocator.capacity)
             plain_mesh = mesh
         else:
             step_fn = jit_step(step, donate_argnums=(0,))

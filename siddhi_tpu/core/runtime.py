@@ -1851,8 +1851,16 @@ class SiddhiAppRuntime:
         if isinstance(q.input_stream, StateInputStream):
             from .pattern_planner import plan_pattern_query
             import functools
+            # @capacity(slots='N') bounds the pending-state slab for
+            # non-partitioned patterns too (the reference's pending list is
+            # unbounded, StreamPreStateProcessor.java:80; P is our bound)
+            nfa_slots = 8
+            cap_ann = q.get_annotation("capacity")
+            if cap_ann is not None:
+                nfa_slots = int(cap_ann.element("slots", nfa_slots))
             plan = functools.partial(
                 plan_pattern_query, q, name, self.schemas, self.interner,
+                slots=nfa_slots,
                 script_functions=self.app.function_definition_map)
             planned = plan()
             self._validate_in_deps(

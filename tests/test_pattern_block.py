@@ -1,0 +1,214 @@
+"""Golden cross-check: the block-parallel single-key NFA (pattern_block.py)
+must emit exactly what the sequential scan path (pattern.py tick) emits —
+same rows, same order — on randomized workloads.  The scan path is the
+semantic reference (itself verified against the reference's
+PatternTestCase/SequenceTestCase behaviors in test_pattern*.py)."""
+import numpy as np
+import pytest
+
+import siddhi_tpu.core.pattern_planner as pp
+from siddhi_tpu import SiddhiManager
+
+
+def _run(ql, sends, force_scan):
+    prev = pp._FORCE_SCAN
+    pp._FORCE_SCAN = force_scan
+    try:
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(ql)
+        got = []
+        rt.add_callback("q", lambda ts, cur, exp: got.extend(
+            (e.timestamp, tuple(e.data)) for e in (cur or [])))
+        rt.start()
+        for stream, cols, ts in sends:
+            rt.get_input_handler(stream).send_columns(cols, timestamps=ts)
+        rt.flush()
+        m.shutdown()
+        return got
+    finally:
+        pp._FORCE_SCAN = prev
+
+
+def _cross(ql, sends):
+    """Both paths must emit the same (timestamp, row) multiset in
+    timestamp order.  The relative order of DIFFERENT-timestamp rows is
+    asserted exactly; ties (one event completing several pending states at
+    once) are unordered — the scan path orders them by slab-slot index
+    (allocation order) and the block path by state age, and the reference
+    itself uses pending-list insertion order, so no order is canonical."""
+    blk = _run(ql, sends, force_scan=False)
+    ref = _run(ql, sends, force_scan=True)
+    for name, rows in (("block", blk), ("scan", ref)):
+        ts = [t for t, _ in rows]
+        assert ts == sorted(ts), f"{name} path emitted out of ts order"
+    assert sorted(blk) == sorted(ref), (
+        f"block path diverges from scan path: "
+        f"block={blk[:6]}... ({len(blk)} rows) vs "
+        f"scan={ref[:6]}... ({len(ref)} rows)")
+    return [d for _, d in blk]
+
+
+def _mk_sends(n_sends, B, seed, n_vols=4, stream="S"):
+    rng = np.random.default_rng(seed)
+    sends = []
+    t = 1000
+    for i in range(n_sends):
+        vols = rng.integers(1, n_vols + 1, B).astype(np.int32)
+        prices = (rng.integers(0, 50, B) / 4.0).astype(np.float32)
+        ts = t + np.arange(B, dtype=np.int64) * 7
+        t = int(ts[-1]) + 13
+        sends.append((stream, [np.zeros(B, np.int64), prices, vols], ts))
+    return sends
+
+
+QL2 = """
+@app:playback
+define stream S (k long, price float, volume int);
+@capacity(slots='256')
+@info(name='q')
+from every e1=S[volume == 1] {sep} e2=S[volume == 2 and price >= e1.price]
+select e1.price as p1, e2.price as p2 insert into M;
+"""
+
+QL3 = """
+@app:playback
+define stream S (k long, price float, volume int);
+@capacity(slots='256')
+@info(name='q')
+from every e1=S[volume == 1] -> e2=S[volume == 2 and price >= e1.price]
+     -> e3=S[volume == 3 and price >= e2.price]
+select e1.price as p1, e2.price as p2, e3.price as p3 insert into M;
+"""
+
+
+@pytest.mark.parametrize("sep", ["->", ","])
+def test_two_stage_random(sep):
+    rows = _cross(QL2.format(sep=sep), _mk_sends(4, 200, seed=1))
+    assert rows  # non-degenerate
+
+
+@pytest.mark.parametrize("sep", ["->", ","])
+def test_two_stage_within(sep):
+    ql = QL2.format(sep=sep).replace(
+        "select", "within 100 millisec\nselect" if sep == "," else
+        "within 100 millisec\nselect")
+    rows = _cross(ql, _mk_sends(4, 200, seed=2))
+    assert rows
+
+
+def test_three_stage_pattern_random():
+    rows = _cross(QL3, _mk_sends(3, 150, seed=3))
+    assert rows
+
+
+def test_non_every_first_match_only():
+    ql = """
+    @app:playback
+    define stream S (k long, price float, volume int);
+    @info(name='q')
+    from e1=S[volume == 1] -> e2=S[volume == 2]
+    select e1.price as p1, e2.price as p2 insert into M;
+    """
+    rows = _cross(ql, _mk_sends(3, 64, seed=4))
+    assert len(rows) == 1  # non-every: exactly one match ever
+
+
+def test_cross_send_pending_state():
+    """A pending e1 from send N must complete on an e2 in send N+1."""
+    ql = QL2.format(sep="->")
+    sends = [
+        ("S", [np.zeros(2, np.int64),
+               np.array([5.0, 4.0], np.float32),
+               np.array([1, 3], np.int32)],
+         np.array([1000, 1001], np.int64)),
+        ("S", [np.zeros(2, np.int64),
+               np.array([6.0, 9.0], np.float32),
+               np.array([2, 2], np.int32)],
+         np.array([2000, 2001], np.int64)),
+    ]
+    rows = _cross(ql, sends)
+    assert (5.0, 6.0) in rows
+
+
+def test_sequence_strict_continuity_across_sends():
+    """SEQUENCE pending at a send boundary: the first event of the next
+    send must match or the state dies."""
+    ql = QL2.format(sep=",")
+    sends = [
+        ("S", [np.zeros(3, np.int64),
+               np.array([5.0, 7.0, 1.0], np.float32),
+               np.array([3, 1, 3], np.int32)],
+         np.array([1000, 1001, 1002], np.int64)),
+    ]
+    rows = _cross(ql, sends)
+    assert rows == []  # e1 at 7.0 killed by the volume-3 event right after
+
+
+def test_multi_stream_chain():
+    ql = """
+    @app:playback
+    define stream A (x int);
+    define stream B (y int);
+    @capacity(slots='256')
+    @info(name='q')
+    from every e1=A[x > 0] -> e2=B[y >= e1.x]
+    select e1.x as x, e2.y as y insert into M;
+    """
+    rng = np.random.default_rng(7)
+    sends = []
+    t = 1000
+    for i in range(6):
+        stream = "A" if i % 2 == 0 else "B"
+        B = 32
+        v = rng.integers(-3, 10, B).astype(np.int32)
+        ts = t + np.arange(B, dtype=np.int64)
+        t = int(ts[-1]) + 5
+        sends.append((stream, [v], ts))
+    rows = _cross(ql, sends)
+    assert rows
+
+
+def test_emit_cap_respected():
+    ql = """
+    @app:playback
+    define stream S (k long, price float, volume int);
+    @emit(rows='4')
+    @info(name='q')
+    from every e1=S[volume == 1] -> e2=S[volume == 2]
+    select e1.price as p1, e2.price as p2 insert into M;
+    """
+    # 8 seeds then one e2: 8 completions at once, cap keeps first 4
+    B = 9
+    vols = np.array([1] * 8 + [2], np.int32)
+    prices = np.arange(B, dtype=np.float32)
+    sends = [("S", [np.zeros(B, np.int64), prices, vols],
+              1000 + np.arange(B, dtype=np.int64))]
+    rows = [d for _, d in _run(ql, sends, force_scan=False)]
+    assert len(rows) == 4
+    assert rows == [(float(i), 8.0) for i in range(4)]
+
+
+def test_single_atom_every():
+    ql = """
+    @app:playback
+    define stream S (k long, price float, volume int);
+    @info(name='q')
+    from every e1=S[volume == 2]
+    select e1.price as p insert into M;
+    """
+    rows = _cross(ql, _mk_sends(2, 100, seed=8))
+    assert rows
+
+
+def test_every_seed_also_completes_earlier_state():
+    """An event can complete one pending state AND seed a new one."""
+    ql = """
+    @app:playback
+    define stream S (k long, price float, volume int);
+    @capacity(slots='256')
+    @info(name='q')
+    from every e1=S[volume <= 2] -> e2=S[volume >= 2]
+    select e1.price as p1, e2.price as p2 insert into M;
+    """
+    rows = _cross(ql, _mk_sends(3, 80, seed=9, n_vols=3))
+    assert rows
