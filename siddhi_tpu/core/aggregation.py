@@ -220,8 +220,9 @@ _DEFAULT_RETENTION_MS = {
 }
 
 _TIME_UNITS_MS = {
-    "ms": 1, "millisecond": 1, "milliseconds": 1,
+    "ms": 1, "millisec": 1, "millisecond": 1, "milliseconds": 1,
     "sec": 1000, "second": 1000, "seconds": 1000,
+    "week": 7 * 86_400_000, "weeks": 7 * 86_400_000,
     "min": 60_000, "minute": 60_000, "minutes": 60_000,
     "hour": 3_600_000, "hours": 3_600_000,
     "day": 86_400_000, "days": 86_400_000,

@@ -53,6 +53,62 @@ def _store_rows(rt, store_id: str, within, per):
 _AGG_FNS = ("sum", "count", "avg", "min", "max", "distinctCount")
 
 
+class OnDemandPlanMemo:
+    """Per-query compile cache so a repeated on-demand query does zero
+    re-planning (reference: SiddhiAppRuntimeImpl.java:304-367 keeps up to
+    50 compiled OnDemandQueryRuntimes keyed by query string).
+
+    Keys are id(expr) of AST nodes: valid because the memo lives in the
+    same LRU entry as the parsed AST, so the nodes stay alive and their
+    ids stable for the memo's whole lifetime.  `plans` counts actual
+    compile/plan events (tests assert it stops growing on a cache hit)."""
+
+    def __init__(self):
+        self.exprs = {}
+        self.table_plans = {}
+        self.selections = {}
+        self.plans = 0
+
+    def split_selection(self, selector, schema):
+        # cached so `select *`'s synthesized Variables keep stable ids
+        k = id(selector)
+        if k not in self.selections:
+            self.selections[k] = _split_selection(selector, schema)
+        return self.selections[k]
+
+    def compile(self, expr, scope):
+        c = self.exprs.get(id(expr))
+        if c is None:
+            c = compile_expression(expr, scope)
+            self.exprs[id(expr)] = c
+            self.plans += 1
+        return c
+
+    def plan_condition(self, table, cond_expr, scope, key):
+        k = id(cond_expr)
+        if k not in self.table_plans:
+            self.table_plans[k] = table.plan_condition(
+                cond_expr, scope, table_id=key, unqualified_is_table=True)
+            self.plans += 1
+        return self.table_plans[k]
+
+
+class _NoMemo:
+    """Uncached fallback for direct OnDemandQuery-object invocations."""
+
+    plans = 0
+
+    def split_selection(self, selector, schema):
+        return _split_selection(selector, schema)
+
+    def compile(self, expr, scope):
+        return compile_expression(expr, scope)
+
+    def plan_condition(self, table, cond_expr, scope, key):
+        return table.plan_condition(cond_expr, scope, table_id=key,
+                                    unqualified_is_table=True)
+
+
 def _split_selection(selector, schema) -> Tuple[list, bool]:
     """[(name, expr, agg_fn_or_None)] for each output."""
     out = []
@@ -73,8 +129,10 @@ def _split_selection(selector, schema) -> Tuple[list, bool]:
     return out, has_agg
 
 
-def execute_on_demand(rt, oq) -> List[ev.Event]:
+def execute_on_demand(rt, oq, memo=None) -> List[ev.Event]:
     """Entry point used by SiddhiAppRuntime.query()."""
+    if memo is None:
+        memo = _NoMemo()
     if oq.type == "INSERT" and oq.input_store is None:
         return _insert_constant(rt, oq)
     store = oq.input_store
@@ -91,12 +149,12 @@ def execute_on_demand(rt, oq) -> List[ev.Event]:
            "__now__": np.int64(rt.timestamp_millis())}
     mask = valid.copy()
     if store.on_condition is not None:
-        c = compile_expression(store.on_condition, scope)
+        c = memo.compile(store.on_condition, scope)
         if c.type != "BOOL":
             raise CompileError("on-condition must be boolean")
         table = rt.tables.get(store.store_id)
         sel = (_indexed_row_mask(table, store.on_condition, key, schema,
-                                 scope, env, mask, c)
+                                 scope, env, mask, c, memo)
                if table is not None else None)
         if sel is not None:
             mask &= sel
@@ -106,10 +164,10 @@ def execute_on_demand(rt, oq) -> List[ev.Event]:
             mask &= np.asarray(c.fn(env)).astype(bool)
 
     if oq.type == "FIND":
-        return _find(rt, oq, scope, schema, env, mask, key)
+        return _find(rt, oq, scope, schema, env, mask, key, memo)
 
     # write ops route the found rows through the table-op machinery
-    sel_events = _find(rt, oq, scope, schema, env, mask, key)
+    sel_events = _find(rt, oq, scope, schema, env, mask, key, memo)
     tgt = oq.output_stream.target_id
     if tgt not in rt.tables:
         if oq.type == "INSERT":
@@ -120,7 +178,7 @@ def execute_on_demand(rt, oq) -> List[ev.Event]:
 
 
 def _indexed_row_mask(table, cond_expr, key, schema, scope, env, valid,
-                      compiled_full):
+                      compiled_full, memo):
     """Index-aware on-demand condition (reference: the store-query path of
     CollectionExpressionParser + IndexOperator.find). Returns a row mask, or
     None when the condition has no usable indexed conjunct.
@@ -128,12 +186,11 @@ def _indexed_row_mask(table, cond_expr, key, schema, scope, env, valid,
     The probe only NARROWS: the full compiled condition re-evaluates on the
     candidate rows, keeping exact dense semantics under dtype casts and
     probe-structure staleness (same contract as TableRuntime._match)."""
-    tc = table.plan_condition(cond_expr, scope, table_id=key,
-                              unqualified_is_table=True)
+    tc = memo.plan_condition(table, cond_expr, scope, key)
     plan = tc.plan
     if plan is None:
         return None
-    rv = np.asarray(compile_expression(plan.rhs, scope).fn(env))
+    rv = np.asarray(memo.compile(plan.rhs, scope).fn(env))
     val = rv.reshape(-1)[0]
     if plan.kind == "eq":
         cand, ok = table._probe_candidates(
@@ -165,9 +222,9 @@ def _result_schema(names, types, interner):
     return ev.Schema(sdef, interner)
 
 
-def _find(rt, oq, scope, schema, env, mask, key) -> List[ev.Event]:
+def _find(rt, oq, scope, schema, env, mask, key, memo) -> List[ev.Event]:
     sel = oq.selector
-    items, has_agg = _split_selection(sel, schema)
+    items, has_agg = memo.split_selection(sel, schema)
     n_rows = int(mask.sum())
 
     # group-by columns
@@ -191,7 +248,7 @@ def _find(rt, oq, scope, schema, env, mask, key) -> List[ev.Event]:
     for name, expr, agg in items:
         out_names.append(name)
         if agg is None:
-            c = compile_expression(expr, scope)
+            c = memo.compile(expr, scope)
             raw = np.asarray(c.fn(env))
             if raw.ndim == 0:
                 raw = np.broadcast_to(raw, mask.shape)
@@ -220,7 +277,7 @@ def _find(rt, oq, scope, schema, env, mask, key) -> List[ev.Event]:
             nul = np.zeros((idx.size,), bool)
             out_types.append("LONG")
         else:
-            c = compile_expression(expr.parameters[0], scope)
+            c = memo.compile(expr.parameters[0], scope)
             raw_t = np.asarray(c.fn(env))
             if raw_t.ndim == 0:
                 raw_t = np.broadcast_to(raw_t, mask.shape)
@@ -271,7 +328,7 @@ def _find(rt, oq, scope, schema, env, mask, key) -> List[ev.Event]:
         hscope = Scope()
         hscope.interner = rt.interner
         hscope.add_source("#out", res_schema)
-        hc = compile_expression(sel.having_expression, hscope)
+        hc = memo.compile(sel.having_expression, hscope)
         keep &= np.asarray(hc.fn(henv)).astype(bool)[:n_out]
     sel_idx = np.nonzero(keep)[0]
     if sel.order_by_list:

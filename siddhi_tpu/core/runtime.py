@@ -10,6 +10,7 @@ for time-based windows.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import heapq
 import logging
@@ -1671,12 +1672,32 @@ class SiddhiAppRuntime:
         self._ingress_gate.set()
         self._scheduler = _Scheduler(self)
         self._drainer = _EmissionDrainer()
+        # on-demand plan LRU: query string -> (parsed AST, OnDemandPlanMemo)
+        self._ondemand_cache: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._ondemand_cache_lock = threading.Lock()
         self._started = False
         # playback: event-driven time (reference: @app:playback,
         # CORE/util/timestamp/TimestampGeneratorImpl.java:118)
         pb = app.get_annotation("app:playback")
         self.playback = pb is not None
         self._playback_time = 0
+        # @app:playback(idle.time='...', increment='...'): when the input
+        # goes quiet for idle.time (wall clock), advance the event clock by
+        # increment and fire the timers it passes, so time windows/patterns
+        # still flush (reference: TimestampGeneratorImpl.java:118-140).
+        self._playback_idle_ms: Optional[int] = None
+        self._playback_increment_ms = 1000
+        self._playback_last_wall = current_millis()
+        self._idle_stop: Optional[threading.Event] = None
+        self._idle_thread: Optional[threading.Thread] = None
+        if pb is not None:
+            from .aggregation import parse_time_ms
+            it = pb.element("idle.time")
+            if it is not None:
+                self._playback_idle_ms = parse_time_ms(str(it))
+                inc = pb.element("increment", "1 sec")
+                self._playback_increment_ms = parse_time_ms(str(inc)) or 1000
 
         # statistics (reference: @app:statistics levels OFF/BASIC/DETAIL)
         from ..utils.statistics import OFF, StatisticsManager
@@ -2350,6 +2371,27 @@ class SiddhiAppRuntime:
                 self._scheduler.notify_at(now + lim.interval, lim)
             if self._stats_reporter is not None:
                 self._stats_reporter.start()
+            if self.playback and self._playback_idle_ms:
+                self._playback_last_wall = current_millis()
+                self._idle_stop = threading.Event()
+                self._idle_thread = threading.Thread(
+                    target=self._run_playback_idle, daemon=True,
+                    name="siddhi-playback-idle")
+                self._idle_thread._siddhi_internal = True
+                self._idle_thread.start()
+
+    def _run_playback_idle(self) -> None:
+        """Quiet-input clock advance for @app:playback(idle.time, increment)
+        (reference: TimestampGeneratorImpl.java:118-140: a periodic task
+        checks wall-clock idleness and bumps the event clock)."""
+        idle_s = self._playback_idle_ms / 1000.0
+        while not self._idle_stop.wait(idle_s):
+            if current_millis() - self._playback_last_wall \
+                    < self._playback_idle_ms:
+                continue
+            with self._lock:
+                self._playback_time += self._playback_increment_ms
+                self._scheduler.drain_playback(self._playback_time)
 
     def shutdown(self) -> None:
         if self._started:
@@ -2357,6 +2399,10 @@ class SiddhiAppRuntime:
                 src.stop()
             if self._stats_reporter is not None:
                 self._stats_reporter.stop()
+            if self._idle_stop is not None:
+                self._idle_stop.set()
+                if self._idle_thread is not None:
+                    self._idle_thread.join(timeout=2.0)
             for j in self.junctions.values():
                 j.stop_async()       # drain accepted sends, stop workers
             for qr in self.query_runtimes.values():
@@ -2523,7 +2569,10 @@ class SiddhiAppRuntime:
             padded.append(a)
         staged = ev.StagedBatch(ts, kind, valid, padded, n)
         if self.playback and n:
-            self._playback_time = max(self._playback_time, int(ts[:n].max()))
+            with self._lock:   # vs the idle-advance thread's bump
+                self._playback_time = max(self._playback_time,
+                                          int(ts[:n].max()))
+                self._playback_last_wall = current_millis()
         now = self.timestamp_millis()
         if self.playback:
             with self._lock:
@@ -2537,8 +2586,11 @@ class SiddhiAppRuntime:
         if stream_id in self.named_windows:
             nw = self.named_windows[stream_id]
             if self.playback and events:
-                self._playback_time = max(self._playback_time,
-                                          max(e.timestamp for e in events))
+                with self._lock:
+                    self._playback_time = max(
+                        self._playback_time,
+                        max(e.timestamp for e in events))
+                    self._playback_last_wall = current_millis()
             now = self.timestamp_millis()
             if self.playback:
                 with self._lock:
@@ -2550,8 +2602,10 @@ class SiddhiAppRuntime:
         if junction is None:
             raise DefinitionNotExistError(f"undefined stream {stream_id!r}")
         if self.playback and events:
-            self._playback_time = max(self._playback_time,
-                                      max(e.timestamp for e in events))
+            with self._lock:
+                self._playback_time = max(self._playback_time,
+                                          max(e.timestamp for e in events))
+                self._playback_last_wall = current_millis()
         now = self.timestamp_millis()
         if self.playback:
             # in playback, fire timers the event clock has passed first
@@ -2583,17 +2637,34 @@ class SiddhiAppRuntime:
         return self._debugger
 
     # -- on-demand (store) queries --------------------------------------------
+    _ONDEMAND_CACHE_MAX = 50   # reference: SiddhiAppRuntimeImpl.java:304-367
+
     def query(self, q) -> List[ev.Event]:
         """Execute a one-shot store query against tables/windows/aggregations
-        (reference: SiddhiAppRuntimeImpl.query :304-367)."""
+        (reference: SiddhiAppRuntimeImpl.query :304-367).  String queries
+        hit an LRU (≤50) of parsed+compiled plans, so a repeated store query
+        re-plans nothing — only the data pass runs."""
         from ..query_api.query import OnDemandQuery
-        from .ondemand import execute_on_demand
+        from .ondemand import OnDemandPlanMemo, execute_on_demand
+        memo = None
         if isinstance(q, str):
-            from ..compiler import SiddhiCompiler
-            q = SiddhiCompiler.parse_on_demand_query(q)
+            with self._ondemand_cache_lock:
+                ent = self._ondemand_cache.get(q)
+                if ent is not None:
+                    self._ondemand_cache.move_to_end(q)
+            if ent is None:
+                from ..compiler import SiddhiCompiler
+                parsed = SiddhiCompiler.parse_on_demand_query(q)
+                ent = (parsed, OnDemandPlanMemo())
+                with self._ondemand_cache_lock:
+                    self._ondemand_cache[q] = ent
+                    while len(self._ondemand_cache) > \
+                            self._ONDEMAND_CACHE_MAX:
+                        self._ondemand_cache.popitem(last=False)
+            q, memo = ent
         assert isinstance(q, OnDemandQuery)
         with self._quiesce():
-            return execute_on_demand(self, q)
+            return execute_on_demand(self, q, memo)
 
     # -- snapshot/restore ------------------------------------------------------
     def snapshot(self) -> bytes:

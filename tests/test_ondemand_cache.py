@@ -1,0 +1,112 @@
+"""On-demand query plan LRU cache (reference: SiddhiAppRuntimeImpl.java
+:304-367 keeps up to 50 compiled OnDemandQueryRuntimes keyed by query
+string; a repeated store query must not re-parse or re-plan)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def _table_rt(extra=""):
+    ql = """
+    define stream In (symbol string, price double, volume long);
+    define table StockTable (symbol string, price double, volume long);
+    from In select symbol, price, volume insert into StockTable;
+    """ + extra
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(ql)
+    rt.start()
+    h = rt.get_input_handler("In")
+    h.send(["IBM", 75.5, 100])
+    h.send(["WSO2", 57.6, 200])
+    rt.flush()
+    return m, rt
+
+
+def test_second_invocation_does_zero_replanning():
+    m, rt = _table_rt()
+    q = "from StockTable on volume > 80 select symbol, price"
+    r1 = rt.query(q)
+    assert len(rt._ondemand_cache) == 1
+    _, memo = rt._ondemand_cache[q]
+    plans_after_first = memo.plans
+    assert plans_after_first > 0
+    r2 = rt.query(q)
+    assert memo.plans == plans_after_first, \
+        "second invocation re-planned expressions"
+    assert sorted(e.data for e in r1) == sorted(e.data for e in r2)
+    m.shutdown()
+
+
+def test_cached_plan_sees_fresh_data():
+    # the cache holds the PLAN, not results: new rows must appear
+    m, rt = _table_rt()
+    q = "from StockTable select symbol, volume"
+    assert len(rt.query(q)) == 2
+    rt.get_input_handler("In").send(["GOOG", 120.0, 50])
+    rt.flush()
+    got = rt.query(q)
+    assert sorted(e.data for e in got) == [
+        ["GOOG", 50], ["IBM", 100], ["WSO2", 200]]
+    m.shutdown()
+
+
+def test_cache_distinguishes_query_strings():
+    m, rt = _table_rt()
+    a = rt.query("from StockTable on volume > 80 select symbol")
+    b = rt.query("from StockTable on volume > 150 select symbol")
+    assert sorted(e.data[0] for e in a) == ["IBM", "WSO2"]
+    assert [e.data[0] for e in b] == ["WSO2"]
+    assert len(rt._ondemand_cache) == 2
+    m.shutdown()
+
+
+def test_lru_eviction_caps_at_50():
+    m, rt = _table_rt()
+    for i in range(55):
+        rt.query(f"from StockTable on volume > {i} select symbol")
+    assert len(rt._ondemand_cache) == 50
+    # least-recent entries (volume > 0..4) evicted; re-running re-plans
+    assert "from StockTable on volume > 0 select symbol" \
+        not in rt._ondemand_cache
+    assert "from StockTable on volume > 54 select symbol" \
+        in rt._ondemand_cache
+    m.shutdown()
+
+
+def test_cached_aggregate_and_having():
+    m, rt = _table_rt()
+    q = ("from StockTable select symbol, sum(volume) as total "
+         "group by symbol having total > 150")
+    r1 = rt.query(q)
+    _, memo = rt._ondemand_cache[q]
+    p = memo.plans
+    r2 = rt.query(q)
+    assert memo.plans == p
+    assert [e.data for e in r1] == [["WSO2", 200]]
+    assert [e.data for e in r2] == [["WSO2", 200]]
+    m.shutdown()
+
+
+def test_write_ops_also_cached():
+    m, rt = _table_rt()
+    q = "from StockTable delete StockTable on StockTable.volume < 150"
+    rt.query(q)
+    assert [e.data[0] for e in rt.query("from StockTable select symbol")] \
+        == ["WSO2"]
+    _, memo = rt._ondemand_cache[q]
+    p = memo.plans
+    rt.query(q)   # no-op delete, but must not re-plan
+    assert memo.plans == p
+    m.shutdown()
+
+
+def test_object_query_still_works_uncached():
+    # direct OnDemandQuery AST invocations bypass the string cache
+    from siddhi_tpu.compiler import SiddhiCompiler
+    m, rt = _table_rt()
+    oq = SiddhiCompiler.parse_on_demand_query(
+        "from StockTable select symbol")
+    got = rt.query(oq)
+    assert len(got) == 2
+    assert len(rt._ondemand_cache) == 0
+    m.shutdown()

@@ -1,0 +1,119 @@
+"""@app:playback(idle.time, increment) — quiet-input clock advance.
+
+Reference behavior: TimestampGeneratorImpl.java:118-140 — when no event
+arrives for idle.time (wall clock), the event-time clock advances by
+increment and pending timers fire, so time windows / absent patterns still
+flush even though the input went silent (reference test: PlaybackTestCase).
+"""
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def _wait_for(pred, timeout=5.0):
+    end = time.time() + timeout
+    while time.time() < end:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return pred()
+
+
+def test_idle_advance_flushes_time_window():
+    # a 1-sec time window's expiry fires with NO further input events
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback(idle.time = '50 millisec', increment = '400 millisec')
+    define stream S (sym string, price float);
+    @info(name='q') from S#window.time(1 sec)
+    select sym, price insert all events into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.append(
+        (list(ins or []), list(outs or []))))
+    rt.start()
+    try:
+        rt.get_input_handler("S").send(["WSO2", 55.6], timestamp=1000)
+        # input goes silent; idle advancer must walk the clock past
+        # 1000+1000ms in 400ms increments and flush the expired event
+        assert _wait_for(lambda: any(outs for _, outs in got)), \
+            f"window never expired; got={got}"
+    finally:
+        m.shutdown()
+    expired = [e for _, outs in got for e in outs]
+    assert len(expired) == 1
+    assert expired[0].data[0] == "WSO2"
+    assert expired[0].data[1] == pytest.approx(55.6)
+
+
+def test_idle_advance_fires_absent_pattern():
+    # `A -> not B for 1 sec` fires on idle advance without a clock-tick event
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback(idle.time = '50 millisec', increment = '300 millisec')
+    define stream S1 (sym string, price float);
+    define stream S2 (sym string, price float);
+    @info(name='q') from e1=S1[price > 20.0] -> not S2 for 1 sec
+    select e1.sym as a insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(
+        [tuple(e.data) for e in (ins or [])]))
+    rt.start()
+    try:
+        rt.get_input_handler("S1").send(["WSO2", 55.6], timestamp=1000)
+        assert _wait_for(lambda: len(got) > 0), "absent pattern never fired"
+    finally:
+        m.shutdown()
+    assert got == [("WSO2",)]
+
+
+def test_idle_advance_respects_activity():
+    # while events keep arriving the idle advancer must NOT jump the clock
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback(idle.time = '200 millisec', increment = '10 sec')
+    define stream S (sym string, price float);
+    @info(name='q') from S#window.time(30 sec)
+    select sym, price insert all events into Out;
+    """)
+    expired = []
+    rt.add_callback("q", lambda ts, ins, outs: expired.extend(outs or []))
+    rt.start()
+    try:
+        h = rt.get_input_handler("S")
+        h.send(["warm", 0.0], timestamp=1000)   # jit-compile stall here is
+        time.sleep(0.01)                        # legitimate wall idleness
+        base = rt.timestamp_millis()
+        for i in range(4):
+            h.send([f"s{i}", float(i)], timestamp=base + 1 + i)
+            time.sleep(0.03)           # << idle.time: clock must not jump
+        assert rt.timestamp_millis() == base + 4
+        # the warmup event may legitimately expire during its jit-compile
+        # stall (wall idleness); the active-phase events must not
+        assert all(e.data[0] == "warm" for e in expired)
+    finally:
+        m.shutdown()
+
+
+def test_playback_without_idle_time_never_advances():
+    # plain @app:playback keeps pure event-driven time (round-4 behavior)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (sym string, price float);
+    @info(name='q') from S#window.time(1 sec)
+    select sym, price insert all events into Out;
+    """)
+    expired = []
+    rt.add_callback("q", lambda ts, ins, outs: expired.extend(outs or []))
+    rt.start()
+    try:
+        rt.get_input_handler("S").send(["WSO2", 55.6], timestamp=1000)
+        time.sleep(0.4)
+        assert rt.timestamp_millis() == 1000
+        assert expired == []
+    finally:
+        m.shutdown()
