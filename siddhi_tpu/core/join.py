@@ -77,6 +77,15 @@ class PlannedJoinQuery:
     gr_pos: List[int] = dataclasses.field(default_factory=list)
     # UUID() appears in this query: emission materializes sentinels once
     emits_uuid: bool = False
+    # device-side emission compaction: the [R*C] join grid is squeezed to
+    # `compact_rows` valid-first rows before the host fetch (None = the
+    # per-trace default max(2R, 1024)).  emit_explicit marks a user
+    # @emit(rows='N') — overflow then warns instead of growing.
+    compact_rows: Optional[int] = None
+    emit_explicit: bool = False
+    # join emissions carry CURRENT and EXPIRED rows; the runtime must not
+    # assume all-current when deriving batch counts from the header
+    mixed_kinds: bool = True
 
 
 def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
@@ -159,6 +168,7 @@ def plan_join_query(
     aggregations=None,
     named_windows=None,
     mesh=None,
+    emit_rows_override: Optional[int] = None,
 ) -> PlannedJoinQuery:
     jis = query.input_stream
     assert isinstance(jis, JoinInputStream)
@@ -248,6 +258,17 @@ def plan_join_query(
 
     jt = jis.type
     trigger = jis.trigger
+
+    # emission compaction cap: @emit(rows='N') = total delivered rows per
+    # batch (pattern queries use per-key rows; joins have no key axis).
+    # Without it the per-trace default max(2R, 1024) covers ~1 match per
+    # window row and adaptive growth (JoinQueryRuntime._grow_emission_cap)
+    # handles denser fan-outs.
+    emit_ann = query.get_annotation("emit")
+    emit_explicit = emit_ann is not None and emit_rows_override is None
+    emit_rows = emit_rows_override
+    if emit_explicit:
+        emit_rows = int(emit_ann.element("rows", 0)) or None
 
     def make_step(this: JoinSide, other: JoinSide, this_is_left: bool):
         """Step for a batch arriving on `this` side."""
@@ -353,6 +374,31 @@ def plan_join_query(
                 cols=(),
             )
             sel_state, out = sel.process(sel_state, jrows, sel_env)
+            # device-side compaction: the [N] grid (N = R*C(+R)) would cost
+            # N-row host fetches per send — megabytes over a tunneled
+            # device for kilobytes of matches.  Stable valid-first argsort
+            # keeps delivery order; rows beyond the cap are counted as
+            # dropped and the runtime grows the cap (a planned recompile)
+            # when the cap was implicit.
+            o_ts, o_kind, o_valid, o_cols = out
+            N = o_ts.shape[0]
+            cap = min(N, emit_rows if emit_rows is not None
+                      else max(2 * R, 1024))
+            n_tot = jnp.sum(o_valid).astype(jnp.int32)
+            if cap < N:
+                order = jnp.argsort(jnp.logical_not(o_valid),
+                                    stable=True)[:cap]
+                o_ts, o_kind, o_valid = \
+                    o_ts[order], o_kind[order], o_valid[order]
+                o_cols = tuple(c[order] for c in o_cols)
+            n_del = jnp.minimum(n_tot, jnp.int32(cap))
+            # header ships [n_valid, n_current] so count-only consumers
+            # (the common bench/monitoring shape) cost ZERO bulk fetches;
+            # n_expired derives as n_valid - n_current host-side
+            n_cur = jnp.sum(jnp.logical_and(
+                o_valid, o_kind == ev.CURRENT)).astype(jnp.int32)
+            out = (jnp.stack([n_del, n_cur]), n_tot - n_del,
+                   o_ts, o_kind, o_valid, o_cols)
             nstate = ((this_state, other_state) if this_is_left
                       else (other_state, this_state))
             new_state = _constrain_state(
@@ -398,7 +444,8 @@ def plan_join_query(
         gl_pos=gl_pos, gr_pos=gr_pos,
         needs_timer=(left.window is not None and left.window.needs_timer) or
                     (right.window is not None and right.window.needs_timer),
-        emits_uuid=scope.uses_uuid)
+        emits_uuid=scope.uses_uuid,
+        compact_rows=emit_rows, emit_explicit=emit_explicit)
 
 
 def _make_feed_only(side: JoinSide, is_left: bool, mesh=None):

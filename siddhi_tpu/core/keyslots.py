@@ -110,6 +110,9 @@ class SlotAllocator:
         self._meta = np.array([0, capacity, 0, 0, 0, jcap], np.int64)
         self._w8 = 0                    # key width in u64 words (fixed)
         self._arena = None              # [capacity, w8*8] u8
+        # bumped whenever key->slot bindings change (insert/purge/restore):
+        # callers memoizing resolved slot blocks key their cache on this
+        self.version = 0
         # L2-resident direct-mapped probe cache (h1, h2, slot); cleared on
         # any unbinding mutation (purge/rebuild/restore)
         self._pcache = np.zeros((1 << 14, 3), np.uint64)
@@ -167,6 +170,7 @@ class SlotAllocator:
         out = np.empty(n, np.int32)
         grouped = None
         with self._lock:
+            count_before = int(self._meta[0])
             if self._arena is not None and words.shape[1] < self._w8:
                 # narrower key than the arena width: zero-pad to match
                 words = np.ascontiguousarray(np.concatenate(
@@ -219,6 +223,8 @@ class SlotAllocator:
                         _group_scratch_lock.release()
             else:
                 self._py_slots_for(words, live, lookup_only, out)
+            if int(self._meta[0]) != count_before:
+                self.version += 1
         if live is not None:
             out[live == 0] = -1
         return out, grouped
@@ -326,6 +332,7 @@ class SlotAllocator:
     # -- lifecycle ------------------------------------------------------------
     def purge(self, slots: Sequence[int]) -> None:
         with self._lock:
+            self.version += 1
             self._pcache[:] = 0
             for s in slots:
                 s = int(s)
@@ -423,6 +430,7 @@ class SlotAllocator:
 
     def restore(self, mapping: Dict[bytes, int]) -> None:
         with self._lock:
+            self.version += 1
             self._used[:] = 0
             self._cell_by_slot[:] = -1
             self._cells[:] = 0

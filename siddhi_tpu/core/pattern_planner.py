@@ -142,6 +142,10 @@ class PlannedPatternQuery:
     # gather/scatter on TPU is row-serialized (~0.3us/row; 131k-key batch =
     # ~90ms), a contiguous slice is DMA-speed
     dense_steps: Optional[Dict[str, Callable]] = None
+    # ts-delta wire variants (base i64 scalar + delta i32 [B] instead of a
+    # fresh i64 [B] ts column); None when unavailable (sharded path)
+    steps_w: Optional[Dict[str, Callable]] = None
+    dense_steps_w: Optional[Dict[str, Callable]] = None
     # False when the per-key emission cap is an implicit default: overflow
     # then raises instead of dropping rows (@emit(rows=N) opts into capping)
     emit_explicit: bool = True
@@ -282,22 +286,48 @@ def plan_pattern_query(
         return step
 
     raw_steps = {sid: make_step(sid) for sid in spec.stream_ids}
+
+    def wire_ts(body):
+        """ts-delta wire variant: the host ships (base i64 scalar,
+        delta i32 [B]) instead of a fresh 8-byte-per-event timestamp
+        column — fresh H2D bytes halve on a tunneled device where
+        transfer of NEW buffers is the measured flagship bottleneck
+        (PERF.md lever 1).  The i64 column reconstructs on device inside
+        the same jit."""
+        def wrapped(packed, sel_state, raw_cols, ts_base, ts_delta,
+                    sel_idx, key_ref, now, in_tabs=()):
+            raw_ts = jnp.asarray(ts_base, jnp.int64) + \
+                ts_delta.astype(jnp.int64)
+            return body(packed, sel_state, raw_cols, raw_ts, sel_idx,
+                        key_ref, now, in_tabs)
+        return wrapped
+
     dense_steps = None
+    steps_w = None
+    dense_steps_w = None
     if mesh is None and partition_positions is None and \
             block_eligible(spec) and not _FORCE_SCAN:
         # single-key simple chain: the sequential E-tick scan degrades to
         # interpreter speed (round-4: 776 ev/s); the block path advances a
         # whole chunk in S-1 vectorized stages — see pattern_block.py
-        steps = {sid: jit_step(
-            make_block_step(spec, pexec, sel, schemas, packer, sid,
-                            compact_rows),
-            donate_argnums=(0, 1)) for sid in spec.stream_ids}
+        block_bodies = {sid: make_block_step(
+            spec, pexec, sel, schemas, packer, sid, compact_rows)
+            for sid in spec.stream_ids}
+        steps = {sid: jit_step(b, donate_argnums=(0, 1))
+                 for sid, b in block_bodies.items()}
+        steps_w = {sid: jit_step(wire_ts(b), donate_argnums=(0, 1))
+                   for sid, b in block_bodies.items()}
     elif mesh is None:
         steps = {sid: jit_step(body, donate_argnums=(0, 1))
                  for sid, body in raw_steps.items()}
+        steps_w = {sid: jit_step(wire_ts(body), donate_argnums=(0, 1))
+                   for sid, body in raw_steps.items()}
         dense_steps = {sid: jit_step(make_step(sid, dense=True),
                                      donate_argnums=(0, 1))
                        for sid in spec.stream_ids}
+        dense_steps_w = {sid: jit_step(wire_ts(make_step(sid, dense=True)),
+                                       donate_argnums=(0, 1))
+                         for sid in spec.stream_ids}
     else:
         steps = {sid: _shard_step(body, mesh, packer, pexec, sel)
                  for sid, body in raw_steps.items()}
@@ -346,6 +376,7 @@ def plan_pattern_query(
                            query.output_stream.output_event_type
                            else "CURRENT_EVENTS"),
         steps=steps, dense_steps=dense_steps,
+        steps_w=steps_w, dense_steps_w=dense_steps_w,
         timer_step=timer_step, init_state=init_state,
         key_capacity=key_capacity, slots=slots,
         partition_positions=partition_positions,

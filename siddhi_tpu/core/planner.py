@@ -376,9 +376,30 @@ def plan_single_query(
             "supported")
     keyed_window = bool(
         (partition_positions or partition_key_fn) and seen_window)
+    window_key_positions = list(partition_positions or [])
+    skey_pos = getattr(window_proc, "session_key_pos", None)
+    if skey_pos is not None:
+        # session(gap, key): standalone keyed window — the session key
+        # scopes the window slab exactly like a partition key would
+        # (reference: SessionWindowProcessor.java sessionKey overload)
+        if partition_positions or partition_key_fn:
+            raise CompileError(
+                "session(gap, key) inside `partition with` is redundant: "
+                "the partition key already scopes the session window")
+        if skey_pos >= len(in_schema.names):
+            # key slots resolve on raw staged columns; appended attributes
+            # don't exist there (same bound as the group-by guard above)
+            raise CompileError(
+                "session key on stream-function-appended attributes is "
+                "not yet supported")
+        keyed_window = True
+        window_key_positions = [skey_pos]
     if keyed_window and (window_key_allocator is None or key_capacity <= 0):
         raise CompileError(
-            "windows inside partitions need the partition's key allocator")
+            "windows inside partitions (and session(gap, key) queries) "
+            "need a key allocator" if skey_pos is None else
+            "internal: session-key query planned without its key "
+            "allocator (runtime wiring bug)")
     if partition_positions:
         if sel.has_aggregation or gpos:
             gpos = [p for p in partition_positions if p not in gpos] + gpos
@@ -575,7 +596,7 @@ def plan_single_query(
         partition_key_fn=partition_key_fn,
         keyed_window=keyed_window,
         window_key_allocator=window_key_allocator,
-        window_key_positions=list(partition_positions or []),
+        window_key_positions=window_key_positions,
         key_capacity=key_capacity,
         pair_allocs=pair_allocs,
         mesh=plain_mesh,

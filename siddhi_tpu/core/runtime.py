@@ -161,7 +161,15 @@ class InputHandler:
     def send_columns(self, cols: Sequence, timestamps=None) -> None:
         """Columnar high-throughput ingestion: `cols` is a sequence of numpy
         arrays (one per attribute, equal length; strings pre-encoded as
-        interner ids).  Bypasses per-event Python staging."""
+        interner ids).  Bypasses per-event Python staging.
+
+        OWNERSHIP: arrays whose length exactly fills the staging bucket
+        (a power of two >= 8) are ADOPTED, not copied — the caller must
+        not mutate them after send (re-sending the same unchanged buffer
+        is fine, and fast: repeated identical buffers dedupe on the
+        device link).  This matches the reference's InputHandler.send
+        (Object[] ownership transfers, InputHandler.java:70); pass a copy
+        if you need to keep writing into the array."""
         self._runtime._gate_wait()     # entry valve, see _gate_wait
         self._runtime._route_columns(self.stream_id, cols, timestamps)
 
@@ -339,6 +347,9 @@ class PatternQueryRuntime:
         # set at wiring time: fn(new_cap) -> PlannedPatternQuery re-planned
         # with a larger emission cap (adaptive overflow growth)
         self._replan = None
+        # steady-state block memo for _grouped_slots: (k0, n) ->
+        # (allocator version, key_idx, sel, keys copy)
+        self._block_cache: Dict = {}
 
     @property
     def name(self):
@@ -377,6 +388,33 @@ class PatternQueryRuntime:
         return self.app.in_probe_tables(
             getattr(self.planned.exec, "in_deps", None) or ())
 
+    def _grouped_slots(self, key_cols, valid, p):
+        """Slot resolution + [Kb, E] grouping with a steady-state block
+        memo.  Keyed workloads re-send the same key blocks sweep after
+        sweep (the bench's 1M-key stream cycles 8 contiguous blocks); when
+        the allocator's bindings are unchanged since the block was last
+        resolved (`version`) and the keys compare equal, the C pass and
+        group fill are pure functions of the block and replay from cache
+        (~30ms -> ~0.2ms per 131k-key send: 16% of flagship wall time)."""
+        alloc = self.slot_allocator
+        keys = key_cols[0] if len(key_cols) == 1 else None
+        cacheable = (keys is not None and keys.dtype.kind in "iu" and
+                     keys.shape[0] >= 1024 and bool(valid.all()))
+        if cacheable:
+            blk = (int(keys[0]), keys.shape[0])
+            ent = self._block_cache.get(blk)
+            if ent is not None and ent[0] == alloc.version and \
+                    np.array_equal(keys, ent[3]):
+                return ent[1], ent[2]
+        _, key_idx, sel = alloc.slots_and_group(key_cols, valid,
+                                                pad=p.key_capacity)
+        if cacheable:
+            if len(self._block_cache) >= 64:
+                self._block_cache.clear()
+            self._block_cache[blk] = (alloc.version, key_idx, sel,
+                                      keys.copy())
+        return key_idx, sel
+
     def process_staged(self, stream_id: str, staged: ev.StagedBatch,
                        now: int) -> None:
         p = self.planned
@@ -385,7 +423,25 @@ class PatternQueryRuntime:
             self._process_sharded(stream_id, staged, now)
             return
         raw_cols = tuple(jax.numpy.asarray(c) for c in staged.cols)
-        raw_ts = jax.numpy.asarray(staged.ts)
+        # ts-delta wire: ship (base scalar, i32 delta) instead of a fresh
+        # i64 column when the batch's span fits i32 (PERF.md lever 1);
+        # falls back to the plain i64 step otherwise
+        ts_wire = None
+        if p.steps_w is not None and staged.n:
+            # fit-check over the REAL rows only: a partial bucket's zero
+            # padding vs an epoch base would always fail it.  Padding
+            # rows (valid=False) reconstruct to `base` on device — their
+            # values are never read through a valid selection.
+            tsn = staged.ts[:staged.n]
+            base = tsn[0]
+            dmax = int(tsn.max()) - int(base)
+            dmin = int(tsn.min()) - int(base)
+            if dmax < 2**31 and dmin >= -(2**31):
+                delta32 = np.zeros(staged.ts.shape, np.int32)
+                delta32[:staged.n] = tsn - base
+                ts_wire = (jax.numpy.asarray(base, jax.numpy.int64),
+                           jax.numpy.asarray(delta32))
+        raw_ts = jax.numpy.asarray(staged.ts) if ts_wire is None else None
         if p.partition_positions:
             kf = (p.partition_key_fns or {}).get(stream_id)
             if kf is not None:
@@ -395,8 +451,7 @@ class PatternQueryRuntime:
                 pos = p.partition_positions[stream_id]
                 key_cols = [staged.cols[i] for i in pos]
                 valid = staged.valid
-            _, key_idx_np, sel = self.slot_allocator.slots_and_group(
-                key_cols, valid, pad=p.key_capacity)
+            key_idx_np, sel = self._grouped_slots(key_cols, valid, p)
             if self._touch is not None:
                 self._touch(key_idx_np, now)
             sel_d = jax.numpy.asarray(sel)
@@ -418,24 +473,44 @@ class PatternQueryRuntime:
                     self._dirty[int(key_idx_np[0]):
                                 int(key_idx_np[0]) + Kb] = True
                 pstate, sel_state = self.state
-                pstate, sel_state, out, wake = p.dense_steps[stream_id](
-                    pstate, sel_state, raw_cols, raw_ts, sel_d,
-                    jax.numpy.asarray(int(key_idx_np[0]), jax.numpy.int32),
-                    jax.numpy.asarray(now, jax.numpy.int64),
-                    self._in_tabs())
+                key_lo = jax.numpy.asarray(int(key_idx_np[0]),
+                                           jax.numpy.int32)
+                now_d = jax.numpy.asarray(now, jax.numpy.int64)
+                if ts_wire is not None:
+                    pstate, sel_state, out, wake = \
+                        p.dense_steps_w[stream_id](
+                            pstate, sel_state, raw_cols, ts_wire[0],
+                            ts_wire[1], sel_d, key_lo, now_d,
+                            self._in_tabs())
+                else:
+                    pstate, sel_state, out, wake = p.dense_steps[stream_id](
+                        pstate, sel_state, raw_cols, raw_ts, sel_d,
+                        key_lo, now_d, self._in_tabs())
                 self.state = (pstate, sel_state)
                 _emit_output(self, out, now, wake=self._wake_arg(wake))
                 return
             key_idx = jax.numpy.asarray(key_idx_np)
         else:
-            sel_np = np.where(staged.valid, np.arange(B, dtype=np.int32),
-                              -1)[None, :]
+            if staged.valid.all():
+                # full bucket: the identity selection is a constant per
+                # capacity — cached read-only so repeat sends dedupe
+                sel_np = _identity_sel(B)
+            else:
+                sel_np = np.where(staged.valid,
+                                  np.arange(B, dtype=np.int32),
+                                  -1)[None, :]
             sel_d = jax.numpy.asarray(sel_np)
             key_idx = jax.numpy.asarray(np.zeros((1,), np.int32))
         pstate, sel_state = self.state
-        pstate, sel_state, out, wake = p.steps[stream_id](
-            pstate, sel_state, raw_cols, raw_ts, sel_d, key_idx,
-            jax.numpy.asarray(now, jax.numpy.int64), self._in_tabs())
+        now_d = jax.numpy.asarray(now, jax.numpy.int64)
+        if ts_wire is not None:
+            pstate, sel_state, out, wake = p.steps_w[stream_id](
+                pstate, sel_state, raw_cols, ts_wire[0], ts_wire[1],
+                sel_d, key_idx, now_d, self._in_tabs())
+        else:
+            pstate, sel_state, out, wake = p.steps[stream_id](
+                pstate, sel_state, raw_cols, raw_ts, sel_d, key_idx,
+                now_d, self._in_tabs())
         self.state = (pstate, sel_state)
         _emit_output(self, out, now, wake=self._wake_arg(wake))
 
@@ -557,16 +632,28 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
     if getattr(qr, "async_emit", False) and qr.app._drainer is not None:
         qr.app._drainer.enqueue(qr, out, now, wake)
         return
-    if getattr(qr, "pipeline_emit", False) and wake is None and \
+    depth = int(getattr(qr, "pipeline_emit", 0) or 0)
+    if depth and wake is None and \
             not getattr(qr.planned, "needs_timer", False):
         # timer-bearing queries never pipeline: a device wake scalar would
         # stall time-driven expiry if deferred, and host-scheduled (cron)
         # windows pass wake=None yet their flush emissions must not slip a
         # period — needs_timer covers both
-        pending = getattr(qr, "_pending_emit", None)
-        qr._pending_emit = (out, now, None)
-        if pending is not None:
-            _deliver_output(qr, *pending)
+        dq = getattr(qr, "_pending_emit", None)
+        if dq is None:
+            dq = qr._pending_emit = collections.deque()
+        dq.append((out, now, None))
+        if len(dq) > depth:
+            if depth == 1:
+                # exactly-one-deep contract: each send delivers its
+                # predecessor (the original @pipeline behavior)
+                _deliver_output(qr, *dq.popleft())
+            else:
+                # depth-k: drain to half depth in ONE batched roundtrip —
+                # the per-fetch tunnel latency amortizes over ~k/2 sends
+                # instead of serializing one RTT per send
+                take = len(dq) - depth // 2
+                _deliver_many(qr, [dq.popleft() for _ in range(take)])
         return
     _deliver_output(qr, out, now, wake)
 
@@ -583,20 +670,37 @@ def _deliver_output(qr, out, now: int, wake) -> None:
     _emit_output_sync(qr, out, now, header=header)
 
 
+def _deliver_many(qr, items) -> None:
+    """Deliver several deferred emissions with ONE batched device_get for
+    all their headers (same amortization as _EmissionDrainer._run)."""
+    if len(items) == 1:
+        _deliver_output(qr, *items[0])
+        return
+    fetched = jax.device_get([
+        (out[0], out[1]) if len(out) == 6 else out
+        for out, _, _ in items])
+    for (out, now, _), fetch_h in zip(items, fetched):
+        if len(out) == 6:
+            _emit_output_sync(qr, out, now, header=fetch_h)
+        else:
+            _emit_output_sync(qr, fetch_h, now)
+
+
 def _drain_pending_emit(qr) -> None:
-    """Deliver a @pipeline runtime's held emission (flush/quiesce/shutdown).
-    Swap + delivery run under the query lock — the producer's pipeline
-    branch in _emit_output also runs under it (junction dispatch), so a
-    concurrent flush can never double-deliver the same emission."""
-    if getattr(qr, "_pending_emit", None) is None:
+    """Deliver a @pipeline runtime's held emissions (flush/quiesce/
+    shutdown).  Swap + delivery run under the query lock — the producer's
+    pipeline branch in _emit_output also runs under it (junction dispatch),
+    so a concurrent flush can never double-deliver the same emission."""
+    if not getattr(qr, "_pending_emit", None):
         return
     lk = getattr(qr, "_qlock", None) or contextlib.nullcontext()
     with lk:
-        pending = getattr(qr, "_pending_emit", None)
-        if pending is None:
+        dq = getattr(qr, "_pending_emit", None)
+        if not dq:
             return
-        qr._pending_emit = None
-        _deliver_output(qr, *pending)
+        items = list(dq)
+        dq.clear()
+        _deliver_many(qr, items)
 
 
 class _LazyBatchPayload(dict):
@@ -717,7 +821,13 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
         n_valid, n_dropped, ots, okind, ovalid, ocols = out
         if header is None:
             header = jax.device_get((n_valid, n_dropped))
-        nv, nd = int(header[0]), int(header[1])
+        h0 = np.asarray(header[0])
+        nd = int(header[1])
+        if h0.ndim:
+            # join header vector [n_valid, n_current] (see join.py)
+            nv, ncur = int(h0[0]), int(h0[1])
+        else:
+            nv, ncur = int(h0), None
         if nd:
             if not getattr(qr.planned, "emit_explicit", True):
                 # the cap was an implicit default: losing matches silently
@@ -730,20 +840,31 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
                 # reports partial loss, not total loss.
                 grow = getattr(qr, "_grow_emission_cap", None)
                 if grow is None or not grow(nd, nv):
+                    what = ("join result rows exceeded the emission"
+                            if getattr(qr.planned, "mixed_kinds", False)
+                            else "pattern match rows exceeded the per-key "
+                                 "emission")
                     overflow_exc = MatchOverflowError(
-                        f"{qr.name}: {nd} pattern match rows exceeded the "
-                        f"per-key emission capacity this batch; set "
+                        f"{qr.name}: {nd} {what} capacity this batch; set "
                         f"@emit(rows='N') on the query to raise the cap or "
                         f"accept capped delivery")
             else:
                 import logging
                 logging.getLogger("siddhi_tpu").warning(
-                    "%s: %d pattern match rows exceeded the per-key "
-                    "emission capacity this batch and were dropped",
-                    qr.name, nd)
-        # pattern matches are always CURRENT-kind rows
-        counts = {"n_valid": nv, "n_current": nv, "n_expired": 0,
-                  "n_dropped": nd}
+                    "%s: %d %s capacity this batch and were dropped",
+                    qr.name, nd,
+                    "join result rows exceeded the emission"
+                    if getattr(qr.planned, "mixed_kinds", False) else
+                    "pattern match rows exceeded the per-key emission")
+        if ncur is not None:
+            # join emissions mix CURRENT and EXPIRED rows; both counts
+            # rode the prefetched header — no bulk fetch for counting
+            counts = {"n_valid": nv, "n_current": ncur,
+                      "n_expired": nv - ncur, "n_dropped": nd}
+        else:
+            # pattern matches are always CURRENT-kind rows
+            counts = {"n_valid": nv, "n_current": nv, "n_expired": 0,
+                      "n_dropped": nd}
     try:
         if len(out) == 6:
             if nv == 0:
@@ -891,10 +1012,47 @@ class JoinQueryRuntime:
         self.next_wakeup: int = _NO_WAKEUP_INT
         self._qlock = threading.RLock()
         self.table_op = None
+        # set at wiring time: fn(new_rows) -> PlannedJoinQuery replanned
+        # with a larger emission compaction cap
+        self._replan = None
 
     @property
     def name(self):
         return self.planned.name
+
+    _EMIT_CAP_MAX = 1 << 21   # 2M emitted rows per batch
+
+    def _grow_emission_cap(self, n_dropped: int, n_valid: int = 0) -> bool:
+        """Adaptive growth for the implicit join emission cap (same contract
+        as PatternQueryRuntime._grow_emission_cap: size to observed demand
+        in one jump; each regrow recompiles the side steps).  Join state
+        shapes are cap-independent, so the live window/selector state
+        carries over, as do the host group-slot allocators."""
+        if self._replan is None:
+            return False
+        need = max(n_valid + n_dropped, 1024)
+        cur = self.planned.compact_rows
+        if cur is not None and need <= cur:
+            # an earlier growth (possibly racing this one) already covers
+            # the demand: the overflowing batch was compiled pre-growth —
+            # not an error, the next batch delivers in full
+            return True
+        new_rows = min(1 << (need - 1).bit_length(), self._EMIT_CAP_MAX)
+        if cur is not None and new_rows <= cur:
+            return False
+        logging.getLogger("siddhi_tpu").warning(
+            "%s: %d join result rows dropped at emission capacity; growing "
+            "the cap to %d (set @emit(rows='N') to pre-size and silence "
+            "this)", self.name, n_dropped, new_rows)
+        old = self.planned
+        newp = self._replan(new_rows)
+        # group allocators hold live host slot maps — carry them over,
+        # then publish the fully-formed plan in ONE assignment (workers
+        # read self.planned once; they must never observe empty allocators)
+        newp.slot_allocator = old.slot_allocator
+        newp.slot_allocator2 = old.slot_allocator2
+        self.planned = newp
+        return True
 
     def place_state(self, state):
         """GSPMD scale-out: shard window buffers / selector slabs on axis 0
@@ -1028,6 +1186,13 @@ class NamedWindowRuntime:
         self.wproc = create_window(
             (w.namespace + ":" if w.namespace else "") + w.name,
             schema, w.parameters, batch_capacity=512)
+        if getattr(self.wproc, "session_key_pos", None) is not None:
+            # the keyed-window slab is a query-planner construct; a shared
+            # named window has no key axis — running the key-less processor
+            # would silently merge every key into ONE session
+            raise CompileError(
+                "session(gap, key) is not supported on a `define window` "
+                "shared instance; use it on a query's input stream")
         self.needs_timer = self.wproc.needs_timer
         self.output_event_type = wdef.output_event_type or "ALL_EVENTS"
         self.subscribers: List = []      # QueryRuntime-likes (process_staged)
@@ -1471,6 +1636,34 @@ class _PartitionPurger:
         qr.state = (wslab, astate)
 
 
+_BUCKET_PLANES: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+_IDENTITY_SEL: Dict[int, np.ndarray] = {}
+
+
+def _identity_sel(cap: int) -> np.ndarray:
+    """[1, cap] arange selection for a full single-key bucket, cached
+    read-only so repeat sends ship the identical (deduped) buffer."""
+    s = _IDENTITY_SEL.get(cap)
+    if s is None:
+        s = np.arange(cap, dtype=np.int32)[None, :]
+        s.setflags(write=False)
+        _IDENTITY_SEL[cap] = s
+    return s
+
+
+def _full_bucket_planes(cap: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(all-true valid, all-zero kind) for a full bucket, cached read-only
+    so repeat sends ship the identical (tunnel-deduped) buffers."""
+    ent = _BUCKET_PLANES.get(cap)
+    if ent is None:
+        valid = np.ones((cap,), np.bool_)
+        valid.setflags(write=False)
+        kind = np.zeros((cap,), np.int32)
+        kind.setflags(write=False)
+        ent = _BUCKET_PLANES[cap] = (valid, kind)
+    return ent
+
+
 class _EmissionDrainer:
     """Background thread pulling device outputs and delivering callbacks.
     Bounded queue gives backpressure (reference: Disruptor ring buffer
@@ -1909,16 +2102,38 @@ class SiddhiAppRuntime:
         in_sid = q.input_stream.unique_stream_id
         from_window = in_sid in self.named_windows
         # @capacity(window='N') bounds the window state slab for this query
-        wch = 2048
+        wch, wch_set = 2048, False
         cap_ann = q.get_annotation("capacity")
         if cap_ann is not None and cap_ann.element("window"):
-            wch = int(cap_ann.element("window"))
+            wch, wch_set = int(cap_ann.element("window")), True
+        # session(gap, key) runs the keyed-window slab outside partitions:
+        # per-key batch slices are small (E rows), so the per-key window
+        # capacity and the batch capacity shrink like the partition path's
+        from ..query_api.query import Window as _Win
+        skeyed = any(
+            isinstance(h, _Win) and h.name == "session" and
+            len(h.parameters) >= 2
+            for h in getattr(q.input_stream, "stream_handlers", []))
+        kw = dict(window_capacity_hint=wch)
+        if skeyed:
+            kcap = 4096
+            if cap_ann is not None and cap_ann.element("keys"):
+                kcap = int(cap_ann.element("keys"))
+            if self.mesh is not None:
+                n = self.mesh.devices.size
+                kcap = ((kcap + n - 1) // n) * n
+            kw = dict(
+                batch_capacity=64,
+                window_capacity_hint=wch if wch_set else 128,
+                window_key_allocator=SlotAllocator(
+                    kcap, name=f"{name}:sessionkey"),
+                key_capacity=kcap, mesh=self.mesh)
         planned = plan_single_query(
             q, name, self.app.stream_definition_map, self.schemas,
             self.interner, named_window_input=from_window,
-            window_capacity_hint=wch,
             config_manager=self.config_manager,
-            script_functions=self.app.function_definition_map)
+            script_functions=self.app.function_definition_map,
+            **kw)
         self._validate_in_deps(planned.in_deps, name)
         runtime = QueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
@@ -2039,13 +2254,16 @@ class SiddhiAppRuntime:
         self._define_output_for(planned, name)
 
     def _add_join_query(self, q: Query, name: str):
+        import functools
         from .join import plan_join_query
-        planned = plan_join_query(q, name, self.schemas, self.tables,
-                                  self.interner,
-                                  aggregations=self.aggregations,
-                                  named_windows=self.named_windows,
-                                  mesh=self.mesh)
+        plan = functools.partial(
+            plan_join_query, q, name, self.schemas, self.tables,
+            self.interner, aggregations=self.aggregations,
+            named_windows=self.named_windows, mesh=self.mesh)
+        planned = plan()
         runtime = JoinQueryRuntime(planned, self)
+        # the SAME partial replans on emission-cap growth
+        runtime._replan = lambda rows, _p=plan: _p(emit_rows_override=rows)
         runtime.async_emit = self._async_enabled(q)
         runtime.pipeline_emit = self._pipeline_enabled(q)
         self.query_runtimes[name] = runtime
@@ -2086,18 +2304,26 @@ class SiddhiAppRuntime:
                 return True
         return False
 
-    def _pipeline_enabled(self, q) -> bool:
-        """@pipeline on the app or the query: one-deep deferred emission so
-        host staging of batch N+1 overlaps the device step of batch N (no
-        extra thread).  The WHOLE delivery lags one send until flush():
+    def _pipeline_enabled(self, q) -> int:
+        """@pipeline(depth='k') on the app or the query: deferred emission
+        so host staging of batch N+1 overlaps the device step of batch N
+        (no extra thread).  depth=1 (default) delivers each send's
+        predecessor; depth>1 lets emissions lag up to k sends and drains
+        them in batched device_gets, amortizing the per-fetch tunnel
+        latency over ~k/2 sends.  The WHOLE delivery lags until flush():
         callbacks, table writes, and downstream stream/window inserts — a
-        reader query in the same app observes this query's effects one
-        batch behind (same relaxation @async makes, minus the thread).
+        reader query in the same app observes this query's effects up to k
+        batches behind (same relaxation @async makes, minus the thread).
         Timer-bearing (time/cron-window, absent-pattern) queries are
-        excluded in _emit_output."""
-        if self.app.get_annotation("app:pipeline") is not None:
-            return True
-        return q.get_annotation("pipeline") is not None
+        excluded in _emit_output.  Returns the depth (0 = off)."""
+        # the query's own annotation wins (it may carry a depth the
+        # app-level blanket annotation lacks)
+        ann = q.get_annotation("pipeline")
+        if ann is None:
+            ann = self.app.get_annotation("app:pipeline")
+        if ann is None:
+            return 0
+        return max(1, int(ann.element("depth", 1) or 1))
 
     def _add_partition(self, part: Partition, qi: int) -> int:
         """Partitions: key-scoped state clones (reference:
@@ -2556,15 +2782,34 @@ class SiddhiAppRuntime:
         if timestamps is None:
             ts0 = self.timestamp_millis()
             ts = np.full((cap,), ts0, np.int64)
+        elif n == cap and isinstance(timestamps, np.ndarray) and \
+                timestamps.dtype == np.int64 and timestamps.flags.c_contiguous:
+            # zero-copy staging: a full-bucket send adopts the caller's
+            # buffers (send_columns transfers ownership — callers must not
+            # mutate after send).  Beyond skipping the memcpy, re-sent
+            # buffers stay IDENTICAL objects, which the tunneled device
+            # client dedupes — steady-state H2D ships only genuinely new
+            # bytes (PERF.md: fresh-H2D is the flagship bottleneck)
+            ts = timestamps
         else:
             ts = np.zeros((cap,), np.int64)
             ts[:n] = timestamps
-        valid = np.zeros((cap,), np.bool_)
-        valid[:n] = True
-        kind = np.zeros((cap,), np.int32)
+        if n == cap:
+            # full buckets share immutable all-true/all-zero planes: the
+            # tunnel client dedupes repeated identical buffers
+            valid, kind = _full_bucket_planes(cap)
+        else:
+            valid = np.zeros((cap,), np.bool_)
+            valid[:n] = True
+            kind = np.zeros((cap,), np.int32)
         padded = []
         for c, t in zip(cols, schema.types):
-            a = np.zeros((cap,), ev.np_dtype(t))
+            d = ev.np_dtype(t)
+            if n == cap and isinstance(c, np.ndarray) and c.dtype == d \
+                    and c.flags.c_contiguous:
+                padded.append(c)
+                continue
+            a = np.zeros((cap,), d)
             a[:n] = c
             padded.append(a)
         staged = ev.StagedBatch(ts, kind, valid, padded, n)

@@ -639,10 +639,18 @@ class SessionWindow(WindowProcessor):
     def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
         super().__init__(schema, params, batch_capacity)
         self.gap_ms = _param_int(params, 0)
-        if len(params) > 1:
+        # session(gap, key): per-key sessions ride the keyed-window slab —
+        # the planner detects session_key_pos and vmaps this processor
+        # over a [K, ...] state slab (reference: SessionWindowProcessor
+        # sessionKey overload, SessionWindowProcessor.java:74-88)
+        self.session_key_pos = None
+        if len(params) >= 2:
+            self.session_key_pos = _param_var_position(
+                params, 1, schema, "session")
+        if len(params) > 2:
             raise ValueError(
-                "session(gap, key) per-key sessions land with the "
-                "partitioned window phase; use `partition with` for now")
+                "session(gap, key, allowed.latency) late-arrival grace "
+                "lands in a later phase")
         self.capacity = max(capacity_hint, 2 * batch_capacity)
 
     @property

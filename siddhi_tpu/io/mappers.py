@@ -15,6 +15,7 @@ import re
 from typing import Any, Dict, List, Optional
 
 from ..core import event as ev
+from ..exceptions import CompileError
 
 
 class SourceMapper:
@@ -137,26 +138,76 @@ class TextSourceMapper(SourceMapper):
 # ---------------------------------------------------------------------------
 
 
+class NoSuchAttributeError(CompileError):
+    """@payload names an attribute the stream does not define
+    (reference: NoSuchAttributeException from TemplateBuilder.parse)."""
+
+
+class TemplateBuilder:
+    """Sink payload template (reference behavior:
+    CORE/util/transport/TemplateBuilder.java:39-150):
+
+    - a template that IS exactly one attribute name emits the raw TYPED
+      value ("object message"), not a string;
+    - a backtick-wrapped no-whitespace template has the backticks stripped
+      (lets a template that collides with an attribute name stay textual);
+    - {{attr}} segments resolve by position, mixed freely with static
+      text; an unknown attribute fails at CREATION time, not per event."""
+
+    _DYN = re.compile(r"\{\{([^{}]*)\}\}")
+
+    def __init__(self, schema: ev.Schema, template: str):
+        t = str(template)
+        self.obj_pos: Optional[int] = None
+        stripped = t.strip()
+        if stripped in schema.names:
+            self.obj_pos = list(schema.names).index(stripped)
+            self.parts: List = []
+            return
+        if re.match(r"^`[^\s]*`$", stripped):
+            t = stripped[1:-1]
+        names = list(schema.names)
+        parts: List = []          # str literals and int positions
+        last = 0
+        for m in self._DYN.finditer(t):
+            if m.start() > last:
+                parts.append(t[last:m.start()])
+            name = m.group(1)
+            if name not in names:
+                raise NoSuchAttributeError(
+                    f"@payload attribute {name!r} does not exist in "
+                    f"stream ({', '.join(names)})")
+            parts.append(names.index(name))
+            last = m.end()
+        if last < len(t):
+            parts.append(t[last:])
+        self.parts = parts
+
+    def build(self, e: ev.Event):
+        if self.obj_pos is not None:
+            return e.data[self.obj_pos]
+        return "".join(p if isinstance(p, str) else str(e.data[p])
+                       for p in self.parts)
+
+
 class SinkMapper:
     def __init__(self, schema: ev.Schema, map_annotation):
         self.schema = schema
         self.ann = map_annotation
-        self.payload_template: Optional[str] = None
+        self.payload_template: Optional[TemplateBuilder] = None
         if map_annotation is not None:
             for sub in map_annotation.annotations:
                 if sub.name.lower() == "payload":
                     vals = list(sub.elements.values())
                     if vals:
-                        self.payload_template = str(vals[0])
+                        self.payload_template = TemplateBuilder(
+                            schema, str(vals[0]))
 
     def map(self, events: List[ev.Event]) -> List[Any]:
         raise NotImplementedError
 
-    def _fill(self, template: str, e: ev.Event) -> str:
-        out = template
-        for name, v in zip(self.schema.names, e.data):
-            out = out.replace("{{" + name + "}}", str(v))
-        return out
+    def _fill(self, template: "TemplateBuilder", e: ev.Event):
+        return template.build(e)
 
 
 class PassThroughSinkMapper(SinkMapper):

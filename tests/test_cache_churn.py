@@ -1,0 +1,126 @@
+"""Cache churn under load for @store tables (reference shape:
+TEST/query/table/cache/{CacheFIFOTestCase, CacheLRUTestCase,
+CacheLFUTestCase, CacheMissTestCase, DeleteFromTableWithCacheTestCase,
+UpdateOrInsertTableWithCacheTestCase} — correctness must hold while the
+bounded cache continuously evicts)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def _app(policy, size=4):
+    return f"""
+    define stream In (symbol string, price float);
+    define stream Del (symbol string);
+    define stream Upd (symbol string, price float);
+    @store(type='memory', @cache(size='{size}', policy='{policy}'))
+    @PrimaryKey('symbol')
+    define table T (symbol string, price float);
+    @info(name='ins') from In select symbol, price insert into T;
+    @info(name='del') from Del delete T on T.symbol == symbol;
+    @info(name='upd') from Upd update T set T.price = price
+        on T.symbol == symbol;
+    """
+
+
+def _rows(rt):
+    return sorted((e.data[0], e.data[1])
+                  for e in rt.query("from T select symbol, price"))
+
+
+@pytest.mark.parametrize("policy", ["FIFO", "LRU", "LFU"])
+def test_insert_churn_past_capacity_keeps_table_exact(manager, policy):
+    # 40 rows through a 4-row cache: eviction must never lose table rows
+    rt = manager.create_siddhi_app_runtime(_app(policy))
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(40):
+        h.send([f"s{i:02d}", float(i)])
+    rt.flush()
+    got = _rows(rt)
+    assert len(got) == 40
+    assert got[0] == ("s00", 0.0) and got[-1] == ("s39", 39.0)
+
+
+@pytest.mark.parametrize("policy", ["FIFO", "LRU", "LFU"])
+def test_update_after_eviction_serves_fresh_value(manager, policy):
+    # update a row certainly evicted from the cache; repeated on-demand
+    # reads (cache-warming) must never serve the stale pre-update value
+    rt = manager.create_siddhi_app_runtime(_app(policy))
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(12):
+        h.send([f"s{i:02d}", float(i)])
+    rt.flush()
+    for _ in range(3):        # warm the cache with reads
+        _rows(rt)
+    rt.get_input_handler("Upd").send(["s00", 999.0])
+    rt.flush()
+    assert ("s00", 999.0) in _rows(rt)
+    assert ("s00", 0.0) not in _rows(rt)
+
+
+@pytest.mark.parametrize("policy", ["FIFO", "LRU", "LFU"])
+def test_delete_churn_with_cache(manager, policy):
+    # interleaved insert/delete churn: deleted rows must not resurrect
+    # from the cache (reference: DeleteFromTableWithCacheTestCase)
+    rt = manager.create_siddhi_app_runtime(_app(policy))
+    rt.start()
+    hi = rt.get_input_handler("In")
+    hd = rt.get_input_handler("Del")
+    for i in range(20):
+        hi.send([f"s{i:02d}", float(i)])
+        if i % 2 == 0:
+            hd.send([f"s{i:02d}"])
+    rt.flush()
+    got = _rows(rt)
+    assert [s for s, _ in got] == [f"s{i:02d}" for i in range(1, 20, 2)]
+
+
+def test_join_against_cached_store_under_churn(manager):
+    # stream-table join keeps exact semantics while the cache evicts
+    rt = manager.create_siddhi_app_runtime("""
+    define stream In (symbol string, price float);
+    define stream Probe (symbol string);
+    @store(type='memory', @cache(size='2', policy='LRU'))
+    @PrimaryKey('symbol')
+    define table T (symbol string, price float);
+    @info(name='ins') from In select symbol, price insert into T;
+    @info(name='j') from Probe join T on Probe.symbol == T.symbol
+    select Probe.symbol as s, T.price as p insert into Out;
+    """)
+    got = []
+    rt.add_callback("j", lambda ts, cur, exp: got.extend(
+        (e.data[0], e.data[1]) for e in (cur or [])))
+    rt.start()
+    hi = rt.get_input_handler("In")
+    hp = rt.get_input_handler("Probe")
+    for i in range(8):
+        hi.send([f"s{i}", float(i * 10)])
+    rt.flush()
+    for i in (0, 7, 3, 0, 5):    # probe pattern crossing cache capacity
+        hp.send([f"s{i}"])
+    rt.flush()
+    assert got == [("s0", 0.0), ("s7", 70.0), ("s3", 30.0),
+                   ("s0", 0.0), ("s5", 50.0)]
+
+
+def test_cache_stats_reflect_churn(manager):
+    # the cache object observes adds/evictions; size never exceeds bound
+    rt = manager.create_siddhi_app_runtime(_app("LRU", size=3))
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(10):
+        h.send([f"s{i}", float(i)])
+    rt.flush()
+    cache = rt.tables["T"].cache
+    assert cache is not None
+    assert len(cache.cache) <= 3

@@ -1,0 +1,143 @@
+"""Filter type-coercion matrix (reference shape: TEST/query/FilterTestCase1
+.java's 82 + FilterTestCase2.java's 41 cases — every compare operator
+crossed with every numeric attribute/constant type pairing, plus BOOL and
+STRING compares from BooleanCompareTestCase/StringCompareTestCase).
+
+Each case routes real events through a compiled filter and checks the
+surviving symbol set against a numpy-computed oracle under the same
+promotion rules (executor.promote: any FLOAT/DOUBLE operand -> f32 compare,
+else widest int)."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+NUM_TYPES = ("int", "long", "float", "double")
+OPS = ("<", "<=", ">", ">=", "==", "!=")
+
+# row data: symbol, i (int), l (long), f (float), d (double)
+ROWS = [
+    ("a", 10, 10, 10.0, 10.0),
+    ("b", -5, -5, -5.0, -5.0),
+    ("c", 0, 0, 0.0, 0.0),
+    ("d", 42, 9_000_000_000, 42.5, 42.5),
+    ("e", 7, 7, 7.25, 7.25),
+    ("f", -100, -100, -99.75, -99.75),
+]
+
+_NPOP = {"<": np.less, "<=": np.less_equal, ">": np.greater,
+         ">=": np.greater_equal, "==": np.equal, "!=": np.not_equal}
+
+
+def _np_col(t):
+    idx = {"int": 1, "long": 2, "float": 3, "double": 4}[t]
+    dt = {"int": np.int32, "long": np.int64,
+          "float": np.float32, "double": np.float32}[t]
+    return np.array([r[idx] for r in ROWS], dt)
+
+
+def _promote(a, b):
+    if a.dtype.kind == "f" or b.dtype.kind == "f":
+        return a.astype(np.float32), b.astype(np.float32)
+    w = np.int64 if np.int64 in (a.dtype.type, b.dtype.type) else np.int32
+    return a.astype(w), b.astype(w)
+
+
+def _drive(cond):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    define stream S (symbol string, i int, l long, f float, d double);
+    @info(name='q') from S[{cond}] select symbol insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(
+        e.data[0] for e in (cur or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for r in ROWS:
+        h.send(list(r))
+    rt.flush()
+    m.shutdown()
+    return sorted(got)
+
+
+@pytest.mark.parametrize("op", OPS)
+@pytest.mark.parametrize("lt", NUM_TYPES)
+@pytest.mark.parametrize("rt_", NUM_TYPES)
+def test_attr_vs_attr(op, lt, rt_):
+    # reference: FilterTestCase1 testFilterQuery33..81 compare each
+    # attribute type against each other attribute type per operator
+    la, ra = _promote(_np_col(lt), _np_col(rt_))
+    expect = sorted(np.array([r[0] for r in ROWS])[_NPOP[op](la, ra)])
+    lc = {"int": "i", "long": "l", "float": "f", "double": "d"}[lt]
+    rc = {"int": "i", "long": "l", "float": "f", "double": "d"}[rt_]
+    assert _drive(f"{lc} {op} {rc}") == expect, (op, lt, rt_)
+
+
+@pytest.mark.parametrize("op", OPS)
+@pytest.mark.parametrize("lt", NUM_TYPES)
+@pytest.mark.parametrize("const", ["7", "7l", "7.0f", "7.0"])
+def test_attr_vs_constant(op, lt, const):
+    # reference: FilterTestCase1 testFilterQuery1..32 — attribute vs
+    # int/long/float/double literals per operator
+    cv = np.array([7], np.int32 if const == "7" else
+                  np.int64 if const == "7l" else np.float32)
+    la, ra = _promote(_np_col(lt), cv)
+    expect = sorted(np.array([r[0] for r in ROWS])[_NPOP[op](la, ra[0])])
+    lc = {"int": "i", "long": "l", "float": "f", "double": "d"}[lt]
+    assert _drive(f"{lc} {op} {const}") == expect, (op, lt, const)
+
+
+@pytest.mark.parametrize("cond,names", [
+    ("symbol == 'a'", ["a"]),
+    ("symbol != 'a'", ["b", "c", "d", "e", "f"]),
+    ("not (symbol == 'a')", ["b", "c", "d", "e", "f"]),
+    ("symbol == 'zz'", []),
+])
+def test_string_compare(cond, names):
+    # reference: StringCompareTestCase equal/notEqual paths
+    assert _drive(cond) == sorted(names)
+
+
+def test_bool_compare():
+    # reference: BooleanCompareTestCase — BOOL attrs compare to literals
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (symbol string, ok bool);
+    @info(name='t') from S[ok == true] select symbol insert into T1;
+    @info(name='f') from S[ok == false] select symbol insert into T2;
+    @info(name='n') from S[ok != true] select symbol insert into T3;
+    """)
+    got = {k: [] for k in "tfn"}
+    for k in "tfn":
+        rt.add_callback(k, lambda ts, cur, exp, _k=k: got[_k].extend(
+            e.data[0] for e in (cur or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["x", True])
+    h.send(["y", False])
+    rt.flush()
+    m.shutdown()
+    assert got["t"] == ["x"]
+    assert got["f"] == ["y"]
+    assert got["n"] == ["y"]
+
+
+@pytest.mark.parametrize("cond,names", [
+    # compound conditions (FilterTestCase2 and/or/not nesting shapes)
+    ("i > 0 and f < 20.0", ["a", "e"]),
+    ("i > 0 or l < 0", ["a", "b", "d", "e", "f"]),
+    ("not (i > 0) and not (i < 0)", ["c"]),
+    ("(i > 0 and i < 20) or (f < -50.0)", ["a", "e", "f"]),
+    ("i - l == 0 and f * 2.0 > 10.0", ["a", "e"]),
+    ("i + 5 >= 12 and d / 2.0 <= 21.25", ["a", "d", "e"]),
+    ("i % 2 == 0", ["a", "c", "d", "f"]),
+])
+def test_compound_conditions(cond, names):
+    assert _drive(cond) == sorted(names)
+
+
+def test_large_long_beyond_f32_precision():
+    # d row's long is 9e9: compares exactly as i64 against a long constant
+    assert _drive("l == 9000000000l") == ["d"]
+    assert _drive("l > 2147483647l") == ["d"]

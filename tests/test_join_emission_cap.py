@@ -1,0 +1,119 @@
+"""Join emission compaction: the device squeezes the [R*C] join grid to a
+bounded valid-first row block before the host fetch (len-6 header contract
+shared with patterns).  Implicit caps grow adaptively; @emit(rows='N') is a
+hard user cap (reference emits unbounded: JoinProcessor.java:107-190 — the
+cap is a TPU-design artifact that must never lose rows silently)."""
+import logging
+
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+QL = """
+@app:playback
+define stream L (symbol long, price float);
+define stream R (symbol long, qty int);
+@info(name='q')
+from L#window.length(64) join R#window.length(64)
+  on L.symbol == R.symbol
+select L.symbol as s, L.price as p, R.qty as v
+insert into Out;
+"""
+
+
+def _drive(ql, n=64, sends=2):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(ql)
+    counts = []
+    rt.add_batch_callback(
+        "q", lambda ts, b: counts.append(int(b["n_current"])))
+    rt.start()
+    hl = rt.get_input_handler("L")
+    hr = rt.get_input_handler("R")
+    sym = np.zeros(n, np.int64)          # one symbol: worst-case fan-out
+    for i in range(sends):
+        ts = {"timestamps": np.full(n, 1000 + i, np.int64)}
+        hr.send_columns([sym, np.full(n, i + 1, np.int32)], **ts)
+        hl.send_columns([sym, np.full(n, 1.5, np.float32)], **ts)
+    rt.flush()
+    m.shutdown()
+    return counts
+
+
+def test_implicit_cap_grows_and_subsequent_sends_deliver_fully(caplog):
+    # 64 same-symbol rows per side: an L send after R's window holds 64
+    # produces 64*64 = 4096 current matches — above the implicit cap
+    with caplog.at_level(logging.WARNING, logger="siddhi_tpu"):
+        counts = _drive(QL, n=64, sends=2)
+    grow_msgs = [r for r in caplog.records
+                 if "growing the cap" in r.getMessage()]
+    assert grow_msgs, "implicit overflow must grow the cap, not drop rows"
+    # after growth the second L send's 4096 matches deliver in full
+    assert max(counts) == 4096, counts
+
+
+def test_explicit_emit_rows_caps_with_warning(caplog):
+    ql = QL.replace("@info(name='q')",
+                    "@emit(rows='128')\n@info(name='q')")
+    with caplog.at_level(logging.WARNING, logger="siddhi_tpu"):
+        counts = _drive(ql, n=64, sends=2)
+    assert all(c <= 128 for c in counts), counts
+    assert any("join result rows exceeded the emission capacity"
+               in r.getMessage() for r in caplog.records)
+    assert not any("growing the cap" in r.getMessage()
+                   for r in caplog.records)
+
+
+def test_small_join_unaffected_by_compaction():
+    # distinct symbols, tiny fan-out: results identical to the r4 contract
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(QL)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(
+        [tuple(e.data) for e in (ins or [])]))
+    rt.start()
+    hl = rt.get_input_handler("L")
+    hr = rt.get_input_handler("R")
+    hr.send_columns([np.array([1, 2], np.int64),
+                     np.array([10, 20], np.int32)],
+                    timestamps=np.array([1000, 1000], np.int64))
+    hl.send_columns([np.array([1], np.int64),
+                     np.array([9.5], np.float32)],
+                    timestamps=np.array([1001], np.int64))
+    rt.flush()
+    m.shutdown()
+    assert got == [(1, pytest.approx(9.5), 10)]
+
+
+def test_expired_rows_still_join_and_count_lazily():
+    # window.length(2) overflow: expired L rows re-join as EXPIRED kind;
+    # the lazy batch payload derives n_current/n_expired from fetched kind
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream L (symbol long, price float);
+    define stream R (symbol long, qty int);
+    @info(name='q')
+    from L#window.length(2) join R#window.length(8)
+      on L.symbol == R.symbol
+    select L.symbol as s, R.qty as v
+    insert all events into Out;
+    """)
+    payloads = []
+    rt.add_batch_callback(
+        "q", lambda ts, b: payloads.append(
+            (int(b["n_current"]), int(b["n_expired"]))))
+    rt.start()
+    hl = rt.get_input_handler("L")
+    hr = rt.get_input_handler("R")
+    hr.send_columns([np.array([1], np.int64), np.array([10], np.int32)],
+                    timestamps=np.array([1000], np.int64))
+    for i in range(4):   # 4 L rows through a length-2 window: 2 expire
+        hl.send_columns([np.array([1], np.int64),
+                         np.array([float(i)], np.float32)],
+                        timestamps=np.array([1001 + i], np.int64))
+    rt.flush()
+    m.shutdown()
+    assert sum(c for c, _ in payloads) == 4      # each L row joins once
+    assert sum(x for _, x in payloads) == 2      # 2 expired re-joins

@@ -1,0 +1,96 @@
+"""Sink @payload template fixtures (reference:
+CORE/util/transport/TemplateBuilder.java + the sink-mapper TestCases):
+object-message form, backtick escape, mixed static/dynamic segments,
+creation-time validation of unknown attributes."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.core import event as ev
+from siddhi_tpu.io.mappers import NoSuchAttributeError, TemplateBuilder
+
+
+def _schema():
+    from siddhi_tpu.core.event import Schema, StringInterner
+    from siddhi_tpu.query_api.definition import StreamDefinition
+    sdef = StreamDefinition("S").attribute("symbol", "string") \
+        .attribute("price", "float").attribute("volume", "long")
+    return Schema(sdef, StringInterner())
+
+
+def test_mixed_static_dynamic_segments():
+    tb = TemplateBuilder(_schema(), "sym={{symbol}} p={{price}}!")
+    assert tb.build(ev.Event(0, ["WSO2", 55.5, 100])) == "sym=WSO2 p=55.5!"
+
+
+def test_adjacent_placeholders_and_leading_trailing_text():
+    tb = TemplateBuilder(_schema(), "{{symbol}}{{volume}}")
+    assert tb.build(ev.Event(0, ["A", 1.0, 42])) == "A42"
+    tb2 = TemplateBuilder(_schema(), ">>{{volume}}<<")
+    assert tb2.build(ev.Event(0, ["A", 1.0, 7])) == ">>7<<"
+
+
+def test_object_message_returns_typed_value():
+    # a template that IS an attribute name returns the RAW value
+    # (TemplateBuilder.java:92-96 isObjectMessage)
+    tb = TemplateBuilder(_schema(), "volume")
+    v = tb.build(ev.Event(0, ["A", 1.0, 42]))
+    assert v == 42 and isinstance(v, int)
+
+
+def test_backtick_escape_keeps_textual():
+    # `volume` (backticked) is static TEXT, not the object message
+    tb = TemplateBuilder(_schema(), "`volume`")
+    assert tb.build(ev.Event(0, ["A", 1.0, 42])) == "volume"
+
+
+def test_unknown_attribute_fails_at_creation():
+    with pytest.raises(NoSuchAttributeError):
+        TemplateBuilder(_schema(), "x={{nope}}")
+
+
+def test_repeated_placeholder():
+    tb = TemplateBuilder(_schema(), "{{symbol}}/{{symbol}}")
+    assert tb.build(ev.Event(0, ["X", 1.0, 1])) == "X/X"
+
+
+# -- end-to-end through a sink ---------------------------------------------
+
+def _sink_drive(payload_ann, rows):
+    captured = []
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    define stream S (symbol string, price float, volume long);
+    @sink(type='inMemory', topic='t1',
+          @map(type='text', {payload_ann}))
+    define stream Out (symbol string, price float, volume long);
+    @info(name='q') from S select * insert into Out;
+    """)
+    from siddhi_tpu.io.broker import InMemoryBroker, subscribe_fn
+    sub = subscribe_fn("t1", captured.append)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for r in rows:
+        h.send(list(r))
+    rt.flush()
+    m.shutdown()
+    InMemoryBroker.unsubscribe(sub)
+    return captured
+
+
+def test_payload_through_text_sink():
+    got = _sink_drive("@payload('{{symbol}} x{{volume}}')",
+                      [("WSO2", 55.5, 100), ("IBM", 8.0, 7)])
+    assert got == ["WSO2 x100", "IBM x7"]
+
+
+def test_payload_unknown_attr_fails_at_app_creation():
+    m = SiddhiManager()
+    with pytest.raises(NoSuchAttributeError):
+        m.create_siddhi_app_runtime("""
+        define stream S (symbol string);
+        @sink(type='inMemory', topic='t2',
+              @map(type='text', @payload('{{missing}}')))
+        define stream Out (symbol string);
+        from S select * insert into Out;
+        """)
+    m.shutdown()

@@ -179,3 +179,44 @@ def test_pipeline_cron_window_not_deferred(manager):
     while not got and _t.monotonic() < deadline:
         _t.sleep(0.05)
     assert got, "cron flush did not arrive within ~2 periods"
+
+
+def test_pipeline_depth_k_defers_up_to_k(manager):
+    # @pipeline(depth='4'): emissions lag up to 4 sends, then drain to
+    # depth//2 in one batched fetch — order always preserved
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @pipeline(depth='4') @info(name='q')
+    from S select v * 10 as w insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(
+        e.data[0] for e in (cur or [])))
+    rt.start()
+    assert rt.query_runtimes["q"].pipeline_emit == 4
+    h = rt.get_input_handler("S")
+    for v in range(1, 5):
+        h.send([v])
+    assert got == []                 # 4 in flight: nothing delivered yet
+    h.send([5])                      # 5th send exceeds depth: drain to 2
+    assert got == [10, 20, 30]
+    rt.flush()
+    assert got == [10, 20, 30, 40, 50]
+
+
+def test_pipeline_depth_k_shutdown_drains_all(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @pipeline(depth='8') @info(name='q')
+    from S select v as w insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(
+        e.data[0] for e in (cur or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in range(6):
+        h.send([v])
+    assert got == []
+    rt.shutdown()                    # at-least-once: teardown drains held
+    assert got == list(range(6))

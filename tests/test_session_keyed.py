@@ -1,0 +1,123 @@
+"""session(gap, key): per-key sessions outside partitions (reference:
+SessionWindowProcessor.java:74-88 sessionKey overload — each key value owns
+an independent session; one key's gap expiry must not flush another's)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.exceptions import CompileError
+
+
+def _run(sends, ql=None):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(ql or """
+    @app:playback
+    define stream S (user string, score int);
+    @info(name='q') from S#window.session(1 sec, user)
+    select user, score insert all events into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.append(
+        ([tuple(e.data) for e in (cur or [])],
+         [tuple(e.data) for e in (exp or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for data, ts in sends:
+        h.send(list(data), timestamp=ts)
+    rt.flush()
+    m.shutdown()
+    return got
+
+
+def test_per_key_sessions_expire_independently():
+    got = _run([
+        (["alice", 1], 1000),
+        (["bob", 10], 1600),
+        # alice's session (last ts 1000) gaps out at 2000; bob's (1600)
+        # is still alive when this event arrives at 2100
+        (["alice", 2], 2100),
+        # advance past bob's gap (2600) and alice's new gap (3100)
+        (["carol", 99], 4000),
+    ])
+    expired = [e for _, exp in got for e in exp]
+    current = [e for cur, _ in got for e in cur]
+    assert (("alice", 1) in expired), expired
+    assert (("bob", 10) in expired), expired
+    # alice's FIRST session expired alone: bob's row wasn't flushed with it
+    first_flush = next(exp for _, exp in got if exp)
+    assert first_flush == [("alice", 1)]
+    assert (("alice", 2) in current) and (("carol", 99) in current)
+
+
+def test_same_key_accumulates_single_session():
+    got = _run([
+        (["u", 1], 1000),
+        (["u", 2], 1500),    # within gap: same session
+        (["u", 3], 4000),    # gap passed: session [1, 2] expires together
+    ])
+    flushes = [exp for _, exp in got if exp]
+    assert flushes and flushes[0] == [("u", 1), ("u", 2)]
+
+
+def test_aggregation_spans_keys():
+    # no group-by: sum runs across every key's session outputs (the
+    # session key scopes the WINDOW, not the selector)
+    got = _run([
+        (["a", 5], 1000),
+        (["b", 7], 1100),
+    ], ql="""
+    @app:playback
+    define stream S (user string, score int);
+    @info(name='q') from S#window.session(1 sec, user)
+    select sum(score) as total insert into Out;
+    """)
+    totals = [e[0] for cur, _ in got for e in cur]
+    assert totals == [5, 12]
+
+
+def test_group_by_on_session_key():
+    got = _run([
+        (["a", 5], 1000),
+        (["b", 7], 1100),
+        (["a", 3], 1200),
+    ], ql="""
+    @app:playback
+    define stream S (user string, score int);
+    @info(name='q') from S#window.session(1 sec, user)
+    select user, sum(score) as total group by user insert into Out;
+    """)
+    rows = [e for cur, _ in got for e in cur]
+    assert rows == [("a", 5), ("b", 7), ("a", 8)]
+
+
+def test_session_key_inside_partition_rejected():
+    m = SiddhiManager()
+    with pytest.raises(CompileError):
+        m.create_siddhi_app_runtime("""
+        define stream S (user string, score int);
+        partition with (user of S)
+        begin
+          from S#window.session(1 sec, user)
+          select user, score insert into Out;
+        end;
+        """)
+    m.shutdown()
+
+
+def test_wall_clock_session_key_timer_flush():
+    # non-playback: the scheduler's timer flushes an idle key's session
+    import time
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    define stream S (user string, score int);
+    @info(name='q') from S#window.session(300 millisec, user)
+    select user, score insert all events into Out;
+    """)
+    expired = []
+    rt.add_callback("q", lambda ts, cur, exp: expired.extend(exp or []))
+    rt.start()
+    rt.get_input_handler("S").send(["u", 1])
+    end = time.time() + 6
+    while time.time() < end and not expired:
+        time.sleep(0.05)
+    m.shutdown()
+    assert [tuple(e.data) for e in expired] == [("u", 1)]

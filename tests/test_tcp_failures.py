@@ -1,0 +1,169 @@
+"""TCP-leg failure injection (reference shape: the transport test cases'
+connection-drop/retry behaviors — InMemoryTransportTestCase + the sink
+OnErrorTestCase family): receiver dies mid-stream, sender reconnects on the
+next publish; receiver boots late, lazy dial + source connect-retry bridge
+the gap; a sender with no receiver surfaces the failure to the app's error
+path instead of crashing the producer."""
+import socket
+import threading
+import time
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def _receiver_app(port):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    @source(type='tcp', port='{port}', @map(type='json'))
+    define stream In (sym string, v int);
+    @info(name='q') from In select sym, v insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(
+        tuple(e.data) for e in (cur or [])))
+    rt.start()
+    time.sleep(0.15)   # accept loop up
+    return m, got
+
+
+def _sender_app(port):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    define stream S (sym string, v int);
+    @sink(type='tcp', host='127.0.0.1', port='{port}',
+          @map(type='json'))
+    define stream Out (sym string, v int);
+    from S select * insert into Out;
+    """)
+    rt.start()
+    return m, rt.get_input_handler("S")
+
+
+def _wait(pred, timeout=8.0):
+    end = time.time() + timeout
+    while time.time() < end:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def test_receiver_restart_sender_reconnects():
+    port = _free_port()
+    rm, got = _receiver_app(port)
+    sm, h = _sender_app(port)
+    try:
+        h.send(["a", 1])
+        assert _wait(lambda: ("a", 1) in got), got
+        # kill the receiver mid-stream; the sender's next publish hits a
+        # dead socket, drops it, and reconnects to the restarted receiver
+        rm.shutdown()
+        time.sleep(0.2)
+        rm2, got2 = _receiver_app(port)
+        try:
+            delivered = False
+            for i in range(40):    # first sends may race the dead socket
+                try:
+                    h.send(["b", i])
+                except Exception:
+                    pass           # surfaced publish failure: acceptable
+                if got2:
+                    delivered = True
+                    break
+                time.sleep(0.1)
+            assert delivered, "sender never reconnected after restart"
+        finally:
+            rm2.shutdown()
+    finally:
+        sm.shutdown()
+
+
+def test_late_receiver_lazy_dial():
+    # sender starts FIRST (no listener); start must not crash (lazy dial);
+    # publishes before the receiver exists fail to the error path, and
+    # once the receiver is up, delivery resumes
+    port = _free_port()
+    sm, h = _sender_app(port)
+    try:
+        # nothing listening yet: the failure either surfaces to the caller
+        # or routes to the sink's error path — either way, NOT fatal
+        _try_send(h, ["early", 0])
+        rm, got = _receiver_app(port)
+        try:
+            assert _wait(lambda: _try_send(h, ["late", 1]) and
+                         ("late", 1) in got), got
+        finally:
+            rm.shutdown()
+    finally:
+        sm.shutdown()
+
+
+def _try_send(h, data):
+    try:
+        h.send(list(data))
+        return True
+    except Exception:
+        return False
+
+
+def test_sink_failure_routes_to_exception_listener():
+    # @on.error handling shape: a publish failure reaches the app's
+    # exception listener rather than killing the producer thread
+    port = _free_port()   # nothing ever listens here
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    define stream S (sym string);
+    @sink(type='tcp', host='127.0.0.1', port='{port}', on.error='log',
+          @map(type='json'))
+    define stream Out (sym string);
+    from S select * insert into Out;
+    """)
+    rt.start()
+    try:
+        try:
+            rt.get_input_handler("S").send(["x"])
+        except Exception:
+            pass    # sync delivery may surface directly — both paths legal
+        # the app survives: a second send doesn't find a wedged runtime
+        try:
+            rt.get_input_handler("S").send(["y"])
+        except Exception:
+            pass
+    finally:
+        m.shutdown()
+
+
+def test_mid_frame_disconnect_recovers():
+    # a raw socket that connects and dies WITHOUT a full frame must not
+    # wedge the receiver's accept loop
+    port = _free_port()
+    rm, got = _receiver_app(port)
+    try:
+        s = socket.create_connection(("127.0.0.1", port), timeout=2)
+        s.sendall(b"\x00\x00")     # half a length header
+        s.close()
+        sm, h = _sender_app(port)
+        try:
+            h.send(["ok", 7])
+            assert _wait(lambda: ("ok", 7) in got), got
+        finally:
+            sm.shutdown()
+    finally:
+        rm.shutdown()
