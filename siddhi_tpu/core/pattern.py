@@ -287,10 +287,17 @@ class PatternExec:
                 continue
             schema = self.schemas[a.stream_id]
             D = a.capture_depth
+            # unfilled captures are NULL, not zero: an unmatched OR branch
+            # and uncollected count rows (e1[i] beyond the collected depth)
+            # emit null attributes (reference: LogicalPreStateProcessor
+            # leaves the partner's StreamEvent null; e1[i] out of range
+            # returns null)
             cols = tuple(
-                jnp.full((P, D, K), ev.default_value(t), dtype=d)
+                jnp.full((P, D, K), ev.null_value(t), dtype=d)
                 for t, d in zip(schema.types, schema.dtypes))
-            caps[a.ckey] = (jnp.zeros((P, D, K), jnp.int64), cols)
+            # ts plane -1 == unfilled: fill-depth tests use >= 0, so a
+            # legitimate playback event at timestamp 0 still counts
+            caps[a.ckey] = (jnp.full((P, D, K), -1, jnp.int64), cols)
         return PatternState(
             active=jnp.zeros((P, K), jnp.bool_),
             pos=jnp.zeros((P, K), jnp.int32),
@@ -385,6 +392,29 @@ class PatternExec:
         matched_any = F
         capture: Dict[str, Any] = {}
         lmask_new = st.lmask
+        # epsilon closure over zero-min count atoms (e1? / e1*): a thread
+        # parked at position q that has collected NOTHING there may match a
+        # later atom p directly when every atom in [q, p) is a plain count
+        # with min_count == 0 (reference: a <0:n> state's next processor is
+        # reachable without any occurrence).  Matched-from-skip threads
+        # advance/collect AS IF at p, so the position updates below carry
+        # explicit targets instead of pos+1.
+        skip_srcs: Dict[int, List[int]] = {}
+        for a_ in spec.atoms:
+            srcs: List[int] = []
+            if a_.logical is None and not a_.absent:
+                q = a_.pos - 1
+                while q >= 0 and spec.atoms[q].is_count \
+                        and spec.atoms[q].min_count == 0 \
+                        and spec.atoms[q].partner is None \
+                        and not spec.atoms[q].absent:
+                    srcs.append(q)
+                    q -= 1
+            skip_srcs[a_.pos] = srcs
+        adv_pos = st.pos + 1       # [P,K] target when advancing in place
+        fork_tgt = st.pos + 1      # [P,K] forked continuation's position
+        stayed = F                 # [P,K] collectors that must sit at the
+        stay_pos = st.pos          # matched atom's position (skip moves)
 
         def mark(d, key, m):
             d[key] = jnp.logical_or(d.get(key, F), m)
@@ -408,9 +438,21 @@ class PatternExec:
                         jnp.broadcast_to(c[None, :], (P, K))
                         for c in ev_cols)
                     cond = jnp.broadcast_to(filt.fn(env_a), (P, K))
-                at_pos = jnp.logical_and(st.active, st.pos == a.pos)
-                m = jnp.logical_and(jnp.logical_and(at_pos, cond),
-                                    ev_ok[None, :])
+                at_here = jnp.logical_and(st.active, st.pos == a.pos)
+                m_here = jnp.logical_and(jnp.logical_and(at_here, cond),
+                                         ev_ok[None, :])
+                m_skip = F
+                if atom is a and skip_srcs.get(a.pos):
+                    from_skip = F
+                    for q2 in skip_srcs[a.pos]:
+                        from_skip = jnp.logical_or(from_skip,
+                                                   st.pos == q2)
+                    from_skip = jnp.logical_and(
+                        jnp.logical_and(st.active, from_skip),
+                        st.count == 0)
+                    m_skip = jnp.logical_and(
+                        jnp.logical_and(from_skip, cond), ev_ok[None, :])
+                m = jnp.logical_or(m_here, m_skip)
                 if atom.absent:
                     # absence violated — unless the obligation was already
                     # satisfied (timed pair whose wait elapsed, bit 1<<side)
@@ -441,27 +483,52 @@ class PatternExec:
                 elif not a.is_count:
                     mark(capture, atom.ckey, m)
                     if last:
+                        # skip-completions (m_skip) emit but do NOT kill the
+                        # slot: the zero-collect continuation survives to
+                        # keep collecting, mirroring the reference's
+                        # separate pending state per interpretation
                         complete = jnp.logical_or(complete, m)
-                        deactivate = jnp.logical_or(deactivate, m)
+                        deactivate = jnp.logical_or(deactivate, m_here)
                     else:
-                        advance_inplace = jnp.logical_or(advance_inplace, m)
+                        advance_inplace = jnp.logical_or(advance_inplace,
+                                                         m_here)
+                        adv_pos = jnp.where(m_here, a.pos + 1, adv_pos)
+                        # skip-advances FORK a continuation at the target
+                        # position; the collector stays where it was
+                        fork = jnp.logical_or(fork, m_skip)
+                        fork_tgt = jnp.where(m_skip, a.pos + 1, fork_tgt)
                 else:
                     newc = st.count + 1
                     maxc = spec.count_cap if a.max_count < 0 else a.max_count
-                    can_stay = jnp.logical_and(m, newc < maxc)
-                    can_adv = jnp.logical_and(m, newc >= a.min_count)
+                    can_stay = jnp.logical_and(m_here, newc < maxc)
+                    can_adv = jnp.logical_and(m_here, newc >= a.min_count)
                     mark(capture, atom.ckey, m)
                     if last:
                         complete = jnp.logical_or(complete, can_adv)
+                        if a.min_count <= 1:
+                            # a skip-collect satisfies min on its first
+                            # event: emit, but keep the origin slot alive
+                            complete = jnp.logical_or(complete, m_skip)
                         deactivate = jnp.logical_or(
                             deactivate,
                             jnp.logical_and(can_adv, jnp.logical_not(can_stay)))
+                        stayed = jnp.logical_or(stayed, can_stay)
+                        stay_pos = jnp.where(can_stay, a.pos, stay_pos)
                     else:
-                        fork = jnp.logical_or(
-                            fork, jnp.logical_and(can_adv, can_stay))
-                        advance_inplace = jnp.logical_or(
-                            advance_inplace,
-                            jnp.logical_and(can_adv, jnp.logical_not(can_stay)))
+                        fk = jnp.logical_and(can_adv, can_stay)
+                        fork = jnp.logical_or(fork, fk)
+                        fork_tgt = jnp.where(fk, a.pos + 1, fork_tgt)
+                        ai = jnp.logical_and(can_adv,
+                                             jnp.logical_not(can_stay))
+                        advance_inplace = jnp.logical_or(advance_inplace, ai)
+                        adv_pos = jnp.where(ai, a.pos + 1, adv_pos)
+                        stayed = jnp.logical_or(stayed, can_stay)
+                        stay_pos = jnp.where(can_stay, a.pos, stay_pos)
+                    # skip-collect into a count atom: fork a collector at
+                    # the target position (captures inherit the event);
+                    # the zero-collect origin survives
+                    fork = jnp.logical_or(fork, m_skip)
+                    fork_tgt = jnp.where(m_skip, a.pos, fork_tgt)
 
         # SEQUENCE: strict continuity
         if spec.state_type == "SEQUENCE":
@@ -525,6 +592,43 @@ class PatternExec:
 
         seed_complete = jnp.logical_and(
             seed_match, jnp.asarray(seed_immediate and S == 1))
+        # seed epsilon skip: when EVERY atom before the last is a plain
+        # zero-min count, an event matching the last atom completes the
+        # whole pattern from the virtual seed with all earlier captures
+        # null (e.g. `e1=A?, e2=B` firing on a lone B)
+        last_atom = spec.atoms[S - 1]
+        seed_skip_possible = (
+            S > 1 and len(skip_srcs.get(S - 1, ())) == S - 1 and
+            last_atom.logical is None and not last_atom.absent and
+            (not last_atom.is_count or last_atom.min_count <= 1))
+        seed_skip_hit = jnp.zeros((K,), jnp.bool_)
+        if seed_skip_possible and last_atom.stream_id == stream_id:
+            lfilt = self._filters[last_atom.ckey]
+            if lfilt is None:
+                lc = jnp.ones((K,), jnp.bool_)
+            else:
+                env_l = dict(env)
+                env_l[last_atom.ref] = tuple(
+                    jnp.broadcast_to(cc[None, :], st.active.shape)
+                    for cc in ev_cols)
+                # the zero-occurrence interpretation carries NO captures:
+                # references to the skipped atoms read null, so a filter
+                # like `price > e1[0].price` correctly rejects it
+                for aa in spec.all_atoms():
+                    if aa.absent or aa is last_atom:
+                        continue
+                    a_sch = self.schemas[aa.stream_id]
+                    nulls = tuple(
+                        jnp.full((P, K), ev.null_value(t), d)
+                        for t, d in zip(a_sch.types, a_sch.dtypes))
+                    env_l[aa.ref] = nulls
+                    for di in range(aa.capture_depth):
+                        env_l[f"{aa.ref}@{di}"] = nulls
+                    env_l[f"{aa.ref}@-1"] = nulls
+                lc = _seed_eval(lfilt, env_l, K)
+            seed_skip_hit = jnp.logical_and(
+                jnp.logical_and(st.seed_on, ev_ok), lc)
+            seed_complete = jnp.logical_or(seed_complete, seed_skip_hit)
         seed_spawn = jnp.logical_and(seed_match, jnp.asarray(
             (seed_immediate and S > 1) or not seed_immediate or seed_keeps))
         # spawned seed slot's position / count
@@ -587,24 +691,33 @@ class PatternExec:
             ck = a.ckey
             ts_c, cols_c = st.caps[ck]
             D = ts_c.shape[1]
-            is_seed_cap = (a.pos == 0 and a.stream_id == stream_id)
+            # the seed emission row's captured atom: position 0 for a
+            # single-atom pattern; the LAST atom for an epsilon-skip
+            # completion (every earlier capture emits null)
+            if S == 1:
+                is_seed_cap = (a.pos == 0 and a.stream_id == stream_id)
+            else:
+                is_seed_cap = (seed_skip_possible and a.pos == S - 1 and
+                               a.stream_id == stream_id)
+            a_schema2 = self.schemas[a.stream_id]
             seed_cols = tuple(
                 jnp.broadcast_to(ev_cols[j][None, None, :], (1, D, K))
                 if is_seed_cap else
-                jnp.zeros((1, D, K), c.dtype)
-                for j, c in enumerate(cols_c))
+                jnp.full((1, D, K), ev.null_value(t), c.dtype)
+                for j, (c, t) in enumerate(
+                    zip(cols_c, a_schema2.types)))
             emit[ck] = (
                 jnp.concatenate(
                     [ts_c, jnp.broadcast_to(ev_ts[None, None, :], (1, D, K))
-                     if is_seed_cap else jnp.zeros((1, D, K), jnp.int64)],
+                     if is_seed_cap else jnp.full((1, D, K), -1, jnp.int64)],
                     axis=0),
                 tuple(jnp.concatenate([c, sc], axis=0)
                       for c, sc in zip(cols_c, seed_cols)))
 
         # ---- phase 6: spawn forks + seed -----------------------------------
-        st = self._spawn(st, fork, seed_spawn, seed_pos, seed_count,
-                         seed_side, seed_fork_also, stream_id, ev_cols,
-                         ev_ts, a0)
+        st = self._spawn(st, fork, fork_tgt, seed_spawn, seed_pos,
+                         seed_count, seed_side, seed_fork_also, stream_id,
+                         ev_cols, ev_ts, a0)
 
         # ---- phase 7: in-place advance / kill / deactivate -----------------
         captured_now = capture_any(capture, F)
@@ -612,8 +725,12 @@ class PatternExec:
             count=jnp.where(advance_inplace | deactivate, 0,
                             jnp.where(captured_now, st.count + 1,
                                       st.count)).astype(jnp.int32),
-            pos=jnp.where(advance_inplace, st.pos + 1,
-                          st.pos).astype(jnp.int32),
+            # default st.pos is POST-spawn: freshly spawned slots keep the
+            # position _spawn assigned; advance/stay masks only cover slots
+            # that were active before the spawn
+            pos=jnp.where(advance_inplace, adv_pos,
+                          jnp.where(stayed, stay_pos,
+                                    st.pos)).astype(jnp.int32),
             lmask=jnp.where(advance_inplace, 0, st.lmask).astype(jnp.int32),
             entry_ts=jnp.where(advance_inplace, ev_ts[None, :], st.entry_ts),
             active=jnp.logical_and(
@@ -623,8 +740,9 @@ class PatternExec:
         return st, emit
 
     # -- spawn ----------------------------------------------------------------
-    def _spawn(self, st: PatternState, fork, seed_spawn, seed_pos, seed_count,
-               seed_side, seed_fork_also, stream_id, ev_cols, ev_ts, a0):
+    def _spawn(self, st: PatternState, fork, fork_tgt, seed_spawn, seed_pos,
+               seed_count, seed_side, seed_fork_also, stream_id, ev_cols,
+               ev_ts, a0):
         """Allocate free slots for fork/seed candidates.
 
         Scatter-free formulation (TPU scatters serialize; gathers don't):
@@ -671,7 +789,7 @@ class PatternExec:
             return jnp.where(has_cand, got, old_field)
 
         # candidate payloads [NC,K]
-        fork_pos = st.pos + 1
+        fork_pos = fork_tgt    # a.pos+1 of the matched atom (skip-aware)
         if seed_fork_also:
             # first seed candidate: advancing slot (pos 1); second: collector
             cpos = jnp.concatenate(
@@ -731,24 +849,30 @@ class PatternExec:
             seed_m = jnp.logical_and(seed_taken[:, None, :],
                                      jnp.ones((1, D, 1), jnp.bool_))
 
-            def merge(c, incoming):
+            def merge(c, incoming, nullv):
                 # c [P,D,K]; inherited[p,d,k] = sum_src hot[p,src,k]*c[src,d,k]
                 inherited = oh_take(c[None, :, :, :],
                                     fork_hot[:, :, None, :], 1)  # [P,D,K]
                 out = jnp.where(fork_taken[:, None, :], inherited, c)
+                # a recycled seed slot's stale captures clear to NULL (not
+                # zero): unfilled branches must decode as null attributes
+                clear = jnp.full_like(out, nullv) if nullv is not None \
+                    else jnp.zeros_like(out)
                 if seed_has:
                     iv = jnp.broadcast_to(incoming[None, None, :],
                                           (P, D, K)).astype(c.dtype)
                     out = jnp.where(
                         jnp.logical_and(seed_m, first_d), iv,
-                        jnp.where(seed_m, jnp.zeros_like(out), out))
+                        jnp.where(seed_m, clear, out))
                 else:
-                    out = jnp.where(seed_m, jnp.zeros_like(out), out)
+                    out = jnp.where(seed_m, clear, out)
                 return out
 
-            newcaps[ck] = (merge(ts_c, ev_ts),
-                           tuple(merge(c, ev_cols[j])
-                                 for j, c in enumerate(cols_c)))
+            a_schema = self.schemas[a.stream_id]
+            newcaps[ck] = (merge(ts_c, ev_ts, -1),
+                           tuple(merge(c, ev_cols[j], ev.null_value(t))
+                                 for j, (c, t) in enumerate(
+                                     zip(cols_c, a_schema.types))))
         return st._replace(caps=newcaps)
 
     # -- env ------------------------------------------------------------------
@@ -771,7 +895,13 @@ class PatternExec:
             env[a.ref] = tuple(c[:, 0, :] for c in cols_c)
             for i in range(D):
                 env[f"{a.ref}@{i}"] = tuple(c[:, i, :] for c in cols_c)
-            last_i = jnp.clip(st.count - 1, 0, D - 1)
+            # e1[last]: the deepest FILLED capture row.  st.count is
+            # position-local (resets when a fork advances past the count
+            # atom), so the fill depth derives from the capture ts plane
+            # itself (real event timestamps are > 0; unfilled rows keep
+            # their zero init)
+            nfill = jnp.sum((ts_c >= 0).astype(jnp.int32), axis=1)  # [P,K]
+            last_i = jnp.clip(nfill - 1, 0, D - 1)
             last_oh = jnp.arange(D)[None, :, None] == last_i[:, None, :]
             env[f"{a.ref}@-1"] = tuple(oh_take(c, last_oh, 1)
                                        for c in cols_c)

@@ -512,8 +512,13 @@ def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,
         for i in range(D):
             env[f"{a.ref}@{i}"] = tuple(
                 c[:, :, i, :].reshape(B) for c in cap_cols)
-        last_i = jnp.clip(emits["count"].astype(jnp.int32) - 1, 0,
-                          D - 1)                        # [E,P+1,K]
+        # e1[last] = deepest FILLED capture row; the count scalar is
+        # position-local (resets when a fork advances past the count atom)
+        # so the fill depth derives from the capture ts plane (unfilled
+        # rows hold -1; a real event at timestamp 0 still counts)
+        nfill = jnp.sum((cap_ts >= 0).astype(jnp.int32),
+                        axis=2)                         # [E,P+1,K]
+        last_i = jnp.clip(nfill - 1, 0, D - 1)
         last_oh = (jnp.arange(D)[None, None, :, None] ==
                    last_i[:, :, None, :])               # [E,P+1,D,K]
         env[f"{a.ref}@-1"] = tuple(

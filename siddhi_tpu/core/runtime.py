@@ -829,6 +829,9 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
         else:
             nv, ncur = int(h0), None
         if nd:
+            what = ("join result rows exceeded the emission"
+                    if getattr(qr.planned, "mixed_kinds", False)
+                    else "pattern match rows exceeded the per-key emission")
             if not getattr(qr.planned, "emit_explicit", True):
                 # the cap was an implicit default: losing matches silently
                 # is a correctness hole.  First try ADAPTIVE GROWTH — the
@@ -840,10 +843,6 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
                 # reports partial loss, not total loss.
                 grow = getattr(qr, "_grow_emission_cap", None)
                 if grow is None or not grow(nd, nv):
-                    what = ("join result rows exceeded the emission"
-                            if getattr(qr.planned, "mixed_kinds", False)
-                            else "pattern match rows exceeded the per-key "
-                                 "emission")
                     overflow_exc = MatchOverflowError(
                         f"{qr.name}: {nd} {what} capacity this batch; set "
                         f"@emit(rows='N') on the query to raise the cap or "
@@ -852,10 +851,7 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
                 import logging
                 logging.getLogger("siddhi_tpu").warning(
                     "%s: %d %s capacity this batch and were dropped",
-                    qr.name, nd,
-                    "join result rows exceeded the emission"
-                    if getattr(qr.planned, "mixed_kinds", False) else
-                    "pattern match rows exceeded the per-key emission")
+                    qr.name, nd, what)
         if ncur is not None:
             # join emissions mix CURRENT and EXPIRED rows; both counts
             # rode the prefetched header — no bulk fetch for counting

@@ -660,26 +660,43 @@ class SessionWindow(WindowProcessor):
     def init_state(self):
         return (
             empty_buffer(self.schema, self.capacity),
+            jnp.asarray(-1, jnp.int64),   # session start ts (-1: no session)
             jnp.asarray(-1, jnp.int64),   # last event ts (-1: no session)
             jnp.asarray(0, jnp.int64),
         )
 
     def process(self, state, rows: Rows, now):
-        buf, last0, seq0 = state
+        buf, start0, last0, seq0 = state
         C, B, gap = self.capacity, rows.capacity, self.gap_ms
         is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
-        any_cur = jnp.any(is_cur)
-        ncur = jnp.sum(is_cur.astype(jnp.int64))
-        k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
 
         # session expires if gap passed before this batch's first arrival
         expire_now = jnp.logical_and(last0 >= 0, last0 + gap <= now)
 
+        # late events within `start - gap` re-open the session backwards
+        # (they sort into ts order on expiry); anything older than that is
+        # DROPPED — its session has already timed out (reference:
+        # SessionWindowProcessor.addLateEvent else-branch removes + logs)
+        session_alive = jnp.logical_and(last0 >= 0,
+                                        jnp.logical_not(expire_now))
+        too_late = jnp.logical_and(session_alive, rows.ts < start0 - gap)
+        is_cur = jnp.logical_and(is_cur, jnp.logical_not(too_late))
+        any_cur = jnp.any(is_cur)
+        ncur = jnp.sum(is_cur.astype(jnp.int64))
+        k = jnp.cumsum(is_cur.astype(jnp.int64)) - 1
+
         brank = jnp.cumsum(buf.alive.astype(jnp.int64)) - 1
+        # expiry emits the session's rows in EVENT-TIME order (late joins
+        # sort before the rows they arrived after — reference:
+        # insertBeforeCurrent keeps the chunk ts-ordered)
+        bts = jnp.where(buf.alive, buf.ts, jnp.iinfo(jnp.int64).max)
+        order = jnp.argsort(bts, stable=True)
+        ts_rank = jnp.zeros((C,), jnp.int64).at[order].set(
+            jnp.arange(C, dtype=jnp.int64))
         exp_rows = Rows(
             ts=buf.ts, kind=jnp.full((C,), ev.EXPIRED, jnp.int32),
             valid=jnp.logical_and(buf.alive, expire_now),
-            seq=seq0 + brank, gslot=buf.gslot, cols=buf.cols)
+            seq=seq0 + ts_rank, gslot=buf.gslot, cols=buf.cols)
         nexp = jnp.where(expire_now,
                          jnp.sum(buf.alive.astype(jnp.int64)), 0)
         cur_rows = Rows(
@@ -702,9 +719,270 @@ class SessionWindow(WindowProcessor):
         last_arr = jnp.max(jnp.where(is_cur, rows.ts, -1))
         nlast = jnp.where(any_cur, jnp.maximum(last_arr, 0),
                           jnp.where(expire_now, -1, last0))
+        # session start: min arrival for a fresh session; an in-gap late
+        # event pulls it backwards (reference: setStartTimestamp)
+        min_arr = jnp.min(jnp.where(is_cur, rows.ts,
+                                    jnp.iinfo(jnp.int64).max))
+        fresh = jnp.logical_or(expire_now, last0 < 0)
+        nstart = jnp.where(any_cur,
+                           jnp.where(fresh, min_arr,
+                                     jnp.minimum(start0, min_arr)),
+                           jnp.where(expire_now, -1, start0))
         nseq = seq0 + nexp + ncur
         wake = jnp.where(nlast >= 0, nlast + gap, NO_WAKEUP)
-        return ((nbuf, nlast, nseq), WindowOutput(out, nbuf, wake))
+        return ((nbuf, nstart, nlast, nseq), WindowOutput(out, nbuf, wake))
+
+
+class SessionLatencyWindow(WindowProcessor):
+    """session(gap, key, allowed.latency) — late-arrival grace (reference:
+    SessionWindowProcessor.java:240-440 with allowedLatency > 0).
+
+    Reference behavior (what): each key keeps a CURRENT session plus one
+    PREVIOUS session that lingers for `latency` after its gap expiry; a
+    new session rotates current → previous (flushing any older previous
+    as EXPIRED); late events merge into current (extending it backwards)
+    or into previous (possibly re-merging the two); events older than
+    both sessions' reach are dropped; previous finally EXPIRES when its
+    alive timestamp (end + latency) passes.
+
+    TPU design (how): per-event classification is inherently sequential,
+    so the batch advances under `lax.scan` with two fixed slabs (current/
+    previous) in the carry; slab order is free because expiry emission
+    re-sorts by event time.  The key axis comes from the keyed-window
+    vmap (planner), exactly like the 2-param form."""
+
+    name = "session"
+    needs_timer = True
+
+    def __init__(self, schema, params, batch_capacity, capacity_hint=2048):
+        super().__init__(schema, params, batch_capacity)
+        self.gap_ms = _param_int(params, 0)
+        self.session_key_pos = _param_var_position(
+            params, 1, schema, "session") \
+            if not isinstance(params[1], Constant) else None
+        if self.session_key_pos is None:
+            raise ValueError("session's 2nd parameter must name the "
+                             "session key attribute")
+        self.latency_ms = _param_int(params, 2)
+        if self.latency_ms > self.gap_ms:
+            # reference: validateAllowedLatency
+            raise ValueError(
+                "session window's allowed.latency must not exceed the "
+                "session gap")
+        # same sizing rule as the 2-param form: an explicit
+        # @capacity(window='N') hint is honored, never clamped
+        self.capacity = max(capacity_hint, 2 * batch_capacity)
+
+    @property
+    def out_capacity(self):
+        return 2 * self.capacity + 2 * self.batch_capacity
+
+    def init_state(self):
+        C = self.capacity
+        z = lambda: jnp.zeros((C,), jnp.int64)      # noqa: E731
+        mk = lambda: (                               # noqa: E731
+            z(), jnp.zeros((C,), jnp.bool_), jnp.full((C,), -1, jnp.int32),
+            tuple(jnp.full((C,), ev.default_value(t), d)
+                  for t, d in zip(self.schema.types, self.schema.dtypes)))
+        neg = jnp.asarray(-1, jnp.int64)
+        return (mk(), neg, neg,          # current slab, start, last
+                mk(), neg, neg, neg,     # previous slab, start, last, alive
+                jnp.asarray(0, jnp.int64))
+
+    def current_buffer(self, state):
+        (cts, calive, cgslot, ccols) = state[0]
+        C = self.capacity
+        big = jnp.full((C,), BIG_SEQ, jnp.int64)
+        return Buffer(ts=cts, add_seq=big, expire_seq=big, expire_ts=big,
+                      alive=calive, gslot=cgslot, cols=ccols)
+
+    # -- slab helpers (order-free: expiry re-sorts by ts) -------------------
+    def _emit(self, out, out_n, slab, seq_base, do):
+        """Append slab's alive rows (ts-sorted) to the out grid."""
+        ots, okind, ovalid, oseq, ogslot, ocols = out
+        sts, salive, sgslot, scols = slab
+        C = self.capacity
+        live = jnp.logical_and(salive, do)
+        key = jnp.where(live, sts, jnp.iinfo(jnp.int64).max)
+        order = jnp.argsort(key, stable=True)
+        rank = jnp.zeros((C,), jnp.int64).at[order].set(
+            jnp.arange(C, dtype=jnp.int64))
+        pos = jnp.where(live, out_n + rank, self.out_capacity)
+        ots = ots.at[pos].set(sts, mode="drop")
+        okind = okind.at[pos].set(ev.EXPIRED, mode="drop")
+        ovalid = ovalid.at[pos].set(True, mode="drop")
+        oseq = oseq.at[pos].set(seq_base + rank, mode="drop")
+        ogslot = ogslot.at[pos].set(sgslot, mode="drop")
+        ocols = tuple(oc.at[pos].set(sc, mode="drop")
+                      for oc, sc in zip(ocols, scols))
+        n = jnp.sum(live.astype(jnp.int64))
+        return (ots, okind, ovalid, oseq, ogslot, ocols), out_n + n, \
+            seq_base + n
+
+    def _append(self, slab, ts_e, gslot_e, cols_e, do):
+        sts, salive, sgslot, scols = slab
+        n = jnp.sum(salive.astype(jnp.int64))
+        pos = jnp.where(do, n, self.capacity)   # capacity overflow drops
+        return (sts.at[pos].set(ts_e, mode="drop"),
+                salive.at[pos].set(True, mode="drop"),
+                sgslot.at[pos].set(gslot_e, mode="drop"),
+                tuple(sc.at[pos].set(ce, mode="drop")
+                      for sc, ce in zip(scols, cols_e)))
+
+    def _merge_into(self, dst, src, do):
+        """Scatter src's alive rows into dst's free tail (when `do`)."""
+        dts, dalive, dgslot, dcols = dst
+        sts, salive, sgslot, scols = src
+        n = jnp.sum(dalive.astype(jnp.int64))
+        srank = jnp.cumsum(salive.astype(jnp.int64)) - 1
+        live = jnp.logical_and(salive, do)
+        pos = jnp.where(live, n + srank, self.capacity)
+        return (dts.at[pos].set(sts, mode="drop"),
+                dalive.at[pos].set(True, mode="drop"),
+                dgslot.at[pos].set(sgslot, mode="drop"),
+                tuple(dc.at[pos].set(sc, mode="drop")
+                      for dc, sc in zip(dcols, scols)))
+
+    def _clear(self, slab, do):
+        sts, salive, sgslot, scols = slab
+        return (sts, jnp.where(do, False, salive), sgslot, scols)
+
+    def process(self, state, rows: Rows, now):
+        cur, cs0, cl0, prev, ps0, pl0, pa0, seq0 = state
+        C, B = self.capacity, rows.capacity
+        gap, lat = self.gap_ms, self.latency_ms
+        OC = self.out_capacity
+        out = (jnp.zeros((OC,), jnp.int64), jnp.zeros((OC,), jnp.int32),
+               jnp.zeros((OC,), jnp.bool_), jnp.full((OC,), BIG_SEQ,
+                                                     jnp.int64),
+               jnp.full((OC,), -1, jnp.int32),
+               tuple(jnp.full((OC,), ev.default_value(t), d)
+                     for t, d in zip(self.schema.types, self.schema.dtypes)))
+        out_n = jnp.asarray(0, jnp.int64)
+        seq = seq0
+
+        # ---- batch-start timeouts ----
+        prev_has = pl0 >= 0
+        cur_has = cl0 >= 0
+        # previous expires at alive = end + latency
+        pto = jnp.logical_and(prev_has, pa0 <= now)
+        out, out_n, seq = self._emit(out, out_n, prev, seq, pto)
+        prev = self._clear(prev, pto)
+        ps0 = jnp.where(pto, -1, ps0)
+        pl0 = jnp.where(pto, -1, pl0)
+        pa0 = jnp.where(pto, -1, pa0)
+        prev_has = jnp.logical_and(prev_has, jnp.logical_not(pto))
+        # current's gap passed: rotate into previous (flushing an older
+        # previous immediately — reference: moveCurrentSessionToPrevious)
+        cto = jnp.logical_and(cur_has, cl0 + gap <= now)
+        flush_old = jnp.logical_and(cto, prev_has)
+        out, out_n, seq = self._emit(out, out_n, prev, seq, flush_old)
+        prev = jax.tree.map(lambda p, c: jnp.where(cto, c, p), prev, cur)
+        ps0 = jnp.where(cto, cs0, ps0)
+        pl0 = jnp.where(cto, cl0, pl0)
+        pa0 = jnp.where(cto, cl0 + gap + lat, pa0)
+        cur = self._clear(cur, cto)
+        cs0 = jnp.where(cto, -1, cs0)
+        cl0 = jnp.where(cto, -1, cl0)
+
+        # ---- per-event scan ----
+        is_cur = jnp.logical_and(rows.valid, rows.kind == ev.CURRENT)
+
+        def body(carry, xs):
+            cur, cs, cl, prev, ps, pl, pa, out, out_n, seq = carry
+            t, live, gslot_e, cols_e = xs
+            cur_has = cl >= 0
+            prev_has = pl >= 0
+            cend = cl + gap
+            in_cur = jnp.logical_and(
+                cur_has, jnp.logical_and(t >= cs, t <= cend))
+            new_sess = jnp.logical_and(
+                cur_has, jnp.logical_and(t >= cs, t > cend))
+            late_cur = jnp.logical_and(
+                cur_has, jnp.logical_and(t < cs, t >= cs - gap))
+            late_prev = jnp.logical_and(
+                jnp.logical_and(cur_has, t < cs - gap),
+                jnp.logical_and(prev_has, t >= ps - gap))
+            fresh = jnp.logical_not(cur_has)
+            kept = jnp.logical_and(live, jnp.logical_or(
+                jnp.logical_or(fresh, in_cur),
+                jnp.logical_or(new_sess,
+                               jnp.logical_or(late_cur, late_prev))))
+
+            # rotate on new session: flush old previous, previous <- cur
+            do_rot = jnp.logical_and(live, new_sess)
+            out, out_n, seq = self._emit(
+                out, out_n, prev, seq, jnp.logical_and(do_rot, prev_has))
+            prev = jax.tree.map(lambda p, c: jnp.where(do_rot, c, p),
+                                prev, cur)
+            ps = jnp.where(do_rot, cs, ps)
+            pl = jnp.where(do_rot, cl, pl)
+            pa = jnp.where(do_rot, cl + gap + lat, pa)
+            cur = self._clear(cur, do_rot)
+            prev_has = jnp.logical_or(prev_has, do_rot)
+
+            # place the event
+            to_prev = jnp.logical_and(live, late_prev)
+            to_cur = jnp.logical_and(kept, jnp.logical_not(late_prev))
+            cur = self._append(cur, t, gslot_e, cols_e, to_cur)
+            prev = self._append(prev, t, gslot_e, cols_e, to_prev)
+
+            # boundary updates
+            cs = jnp.where(to_cur, jnp.where(
+                jnp.logical_or(fresh, do_rot), t, jnp.minimum(cs, t)), cs)
+            cl = jnp.where(to_cur, jnp.maximum(cl, t), cl)
+            # late-to-previous: extend backwards or forwards
+            p_back = jnp.logical_and(to_prev, t < ps)
+            ps = jnp.where(p_back, t, ps)
+            p_fwd = jnp.logical_and(to_prev, t > pl)
+            pl = jnp.where(p_fwd, t, pl)
+            pa = jnp.where(p_fwd, t + gap + lat, pa)
+
+            # merge previous into current when their reaches touch
+            # (reference: mergeWindows — prev end >= cur start - gap)
+            can_merge = jnp.logical_and(
+                jnp.logical_and(prev_has, cl >= 0),
+                pl + gap >= cs - gap)
+            do_merge = jnp.logical_and(
+                jnp.logical_or(jnp.logical_and(live, late_cur),
+                               jnp.logical_and(live, p_fwd)), can_merge)
+            cur = self._merge_into(cur, prev, do_merge)
+            prev = self._clear(prev, do_merge)
+            cs = jnp.where(do_merge, jnp.minimum(cs, ps), cs)
+            cl = jnp.where(do_merge, jnp.maximum(cl, pl), cl)
+            ps = jnp.where(do_merge, -1, ps)
+            pl = jnp.where(do_merge, -1, pl)
+            pa = jnp.where(do_merge, -1, pa)
+
+            return (cur, cs, cl, prev, ps, pl, pa, out, out_n, seq), kept
+
+        carry0 = (cur, cs0, cl0, prev, ps0, pl0, pa0, out, out_n, seq)
+        xs = (rows.ts, is_cur, rows.gslot, tuple(c for c in rows.cols))
+        (cur, cs0, cl0, prev, ps0, pl0, pa0, out, out_n, seq), kept = \
+            jax.lax.scan(body, carry0, xs)
+
+        # ---- pass-through CURRENT rows (arrival order, after expiries) ----
+        ots, okind, ovalid, oseq, ogslot, ocols = out
+        k = jnp.cumsum(kept.astype(jnp.int64)) - 1
+        pos = jnp.where(kept, out_n + k, OC)
+        ots = ots.at[pos].set(rows.ts, mode="drop")
+        okind = okind.at[pos].set(ev.CURRENT, mode="drop")
+        ovalid = ovalid.at[pos].set(True, mode="drop")
+        oseq = oseq.at[pos].set(seq + k, mode="drop")
+        ogslot = ogslot.at[pos].set(rows.gslot, mode="drop")
+        ocols = tuple(oc.at[pos].set(rc, mode="drop")
+                      for oc, rc in zip(ocols, rows.cols))
+        nk = jnp.sum(kept.astype(jnp.int64))
+        seq = seq + nk
+
+        out_rows = sort_rows(Rows(ts=ots, kind=okind, valid=ovalid,
+                                  seq=oseq, gslot=ogslot, cols=ocols))
+        nstate = (cur, cs0, cl0, prev, ps0, pl0, pa0, seq)
+        wake = jnp.minimum(
+            jnp.where(cl0 >= 0, cl0 + gap, NO_WAKEUP),
+            jnp.where(pl0 >= 0, pa0, NO_WAKEUP))
+        return nstate, WindowOutput(out_rows, self.current_buffer(nstate),
+                                    wake)
 
 
 class FrequentWindow(WindowProcessor):
@@ -952,10 +1230,28 @@ class HoppingWindow(WindowProcessor):
         return ((nbuf, new_next, nseq), WindowOutput(out, None, wake))
 
 
+def _session_factory(schema, params, batch_capacity, capacity_hint=2048):
+    """Session window: events within `session.gap` of each other group
+    into one session that expires together after a quiet gap.  Overloads
+    (reference: SessionWindowProcessor.java:86-88): session(gap),
+    session(gap, key) for independent per-key sessions, and
+    session(gap, key, allowed.latency) which keeps the previous session
+    alive for `allowed.latency` so late events can still merge."""
+    # session(gap[, key]) -> vectorized single-session processor (per-key
+    # isolation rides the keyed-window vmap slab); 3-arg form needs the
+    # two-session late-merge scan
+    if len(params) >= 3:
+        return SessionLatencyWindow(schema, params, batch_capacity,
+                                    capacity_hint=capacity_hint)
+    return SessionWindow(schema, params, batch_capacity,
+                         capacity_hint=capacity_hint)
+
+
 def register(window_types: dict) -> None:
     for cls in (ExternalTimeWindow, ExternalTimeBatchWindow, TimeLengthWindow,
                 DelayWindow, ChunkBatchWindow, SortWindow, CronWindow,
-                SessionWindow, FrequentWindow, LossyFrequentWindow,
+                FrequentWindow, LossyFrequentWindow,
                 HoppingWindow):
         window_types[cls.name] = cls
+    window_types["session"] = _session_factory
     window_types["hoping"] = HoppingWindow   # the reference's spelling

@@ -1,0 +1,188 @@
+"""Strict-sequence corpus (reference: TEST/query/sequence/
+SequenceTestCase.java, 33 cases — comma-separated sequences where each
+state must match the IMMEDIATELY next event, with Kleene */+/?, logical
+partners, and indexed counting captures)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+BASE = """
+define stream Stream1 (symbol string, price float, volume int);
+define stream Stream2 (symbol string, price float, volume int);
+"""
+
+
+def _run(body, sends, query="q"):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(BASE + body)
+    got = []
+    rt.add_callback(query, lambda ts, cur, exp: got.extend(
+        tuple(e.data) for e in (cur or [])))
+    rt.start()
+    hs = {}
+    for stream, data in sends:
+        hs.setdefault(stream, rt.get_input_handler(stream)).send(list(data))
+    rt.flush()
+    m.shutdown()
+    return got
+
+
+def test_strict_sequence_matches_adjacent():
+    # testQuery1: e1,e2 — the very next Stream2 event must satisfy e2
+    got = _run("""
+    @info(name='q')
+    from e1=Stream1[price>20], e2=Stream2[price>e1.price]
+    select e1.price as p1, e2.price as p2 insert into Out;
+    """, [("Stream1", ["WSO2", 55.6, 100]),
+          ("Stream2", ["IBM", 55.7, 100])])
+    assert [(round(a, 1), round(b, 1)) for a, b in got] == [(55.6, 55.7)]
+
+
+def test_strict_sequence_broken_by_nonmatching_next():
+    # strictness: a non-matching event between e1 and e2 kills the thread
+    got = _run("""
+    @info(name='q')
+    from e1=Stream1[price>20], e2=Stream1[price>e1.price]
+    select e1.price as p1, e2.price as p2 insert into Out;
+    """, [("Stream1", ["WSO2", 55.6, 100]),
+          ("Stream1", ["LOW", 10.0, 100]),     # breaks the sequence
+          ("Stream1", ["IBM", 95.7, 100])])
+    assert got == []
+
+
+def test_every_sequence_restarts():
+    # testQuery2: every e1,e2 keeps matching pairs
+    got = _run("""
+    @info(name='q')
+    from every e1=Stream1[price>20], e2=Stream1[price>e1.price]
+    select e1.price as p1, e2.price as p2 insert into Out;
+    """, [("Stream1", ["A", 25.0, 100]),
+          ("Stream1", ["B", 30.0, 100]),
+          ("Stream1", ["C", 26.0, 100]),
+          ("Stream1", ["D", 55.0, 100])])
+    assert [(round(a), round(b)) for a, b in got] == [(25, 30), (26, 55)]
+
+
+def test_kleene_star_collects_then_closes():
+    # testQuery4 shape: e1=S2[...]*, e2=S1[price>e1[0].price]
+    got = _run("""
+    @info(name='q')
+    from every e1=Stream2[price>20]*, e2=Stream1[price>e1[0].price]
+    select e1[0].price as p0, e2.price as p2 insert into Out;
+    """, [("Stream2", ["A", 25.0, 100]),
+          ("Stream1", ["B", 26.0, 100])])
+    assert [(round(a), round(b)) for a, b in got] == [(25, 26)]
+
+
+def test_kleene_plus_requires_at_least_one():
+    # testQuery10 shape: + needs one occurrence before the closer
+    got = _run("""
+    @info(name='q')
+    from every e1=Stream2[price>20]+, e2=Stream1[price>e1[0].price]
+    select e1[0].price as p0, e2.price as p2 insert into Out;
+    """, [("Stream1", ["X", 99.0, 100]),     # no e1 yet: no match
+          ("Stream2", ["A", 25.0, 100]),
+          ("Stream1", ["B", 26.0, 100])])
+    assert [(round(a), round(b)) for a, b in got] == [(25, 26)]
+
+
+def test_optional_question_mark():
+    # testQuery6 shape: e1? may be absent — e2 matches directly
+    got = _run("""
+    @info(name='q')
+    from every e1=Stream2[price>20]?, e2=Stream1[price>30]
+    select e2.price as p2 insert into Out;
+    """, [("Stream1", ["B", 35.0, 100])])
+    assert [round(p) for (p,) in got] == [35]
+
+
+def test_or_partner_in_sequence():
+    # testQuery7 shape: e2 or e3 — either branch closes the sequence
+    got = _run("""
+    @info(name='q')
+    from every e1=Stream2[price>20], e2=Stream2[price>e1.price]
+         or e3=Stream2[symbol=='IBM']
+    select e1.price as p1, e2.price as p2, e3.symbol as s3
+    insert into Out;
+    """, [("Stream2", ["A", 25.0, 100]),
+          ("Stream2", ["IBM", 10.0, 100])])   # e3 branch (price < e1's)
+    assert len(got) == 1
+    p1, p2, s3 = got[0]
+    assert round(p1) == 25 and p2 is None and s3 == "IBM"
+
+
+def test_and_partner_in_sequence():
+    # testQuery28 shape: e1, (e2 and e3): both must arrive to close
+    got = _run("""
+    @info(name='q')
+    from e1=Stream1[price>20], e2=Stream2['IBM' == symbol]
+         and e3=Stream2['WSO2' == symbol]
+    select e1.price as p1, e2.symbol as s2, e3.symbol as s3
+    insert into Out;
+    """, [("Stream1", ["A", 25.0, 100]),
+          ("Stream2", ["IBM", 10.0, 100]),
+          ("Stream2", ["WSO2", 11.0, 100])])
+    assert len(got) == 1
+    assert got[0][1] == "IBM" and got[0][2] == "WSO2"
+
+
+def test_counting_capture_last_index():
+    # testQuery21 shape: e1[last].price reads the final collected row
+    got = _run("""
+    @info(name='q')
+    from every e1=Stream1[price>20]+, e2=Stream1[price<10]
+    select e1[0].price as first, e1[last].price as last_p
+    insert into Out;
+    """, [("Stream1", ["A", 25.0, 100]),
+          ("Stream1", ["B", 30.0, 100]),
+          ("Stream1", ["C", 5.0, 100])])
+    # {A,B} closes as (first=25, last=30); `every` also spawned the
+    # overlapping thread {B} which closes as (30, 30)
+    assert sorted((round(a), round(b)) for a, b in got) == \
+        [(25, 30), (30, 30)]
+
+
+def test_sequence_from_two_streams_interleaved():
+    # testQuery13 shape: states on different streams; other-stream events
+    # do not break strictness on the constrained stream
+    got = _run("""
+    @info(name='q')
+    from every e1=Stream1[price >= 50 and volume > 100],
+         e2=Stream2[price <= 40]*, e3=Stream2[volume <= 70]
+    select e1.symbol as s1, e2[0].symbol as s2, e3.symbol as s3
+    insert into Out;
+    """, [("Stream1", ["IBM", 75.0, 105]),
+          ("Stream2", ["GOOG", 21.0, 81]),
+          ("Stream2", ["WSO2", 176.6, 65])])
+    assert len(got) == 1
+    assert got[0] == ("IBM", "GOOG", "WSO2")
+
+
+def test_sequence_group_by_output():
+    got = _run("""
+    @info(name='q')
+    from every e1=Stream1[price>20], e2=Stream1[price>e1.price]
+    select e1.symbol as s, sum(e2.price) as total group by e1.symbol
+    insert into Out;
+    """, [("Stream1", ["A", 25.0, 100]),
+          ("Stream1", ["B", 30.0, 100]),
+          ("Stream1", ["A", 26.0, 100]),
+          ("Stream1", ["Z", 55.0, 100])])
+    assert len(got) == 2
+
+
+def test_skip_and_collect_interpretations_coexist():
+    # an event satisfying BOTH the optional count atom's filter and the
+    # closer's filter: the zero-occurrence completion emits AND the
+    # collector interpretation survives to close later (review finding:
+    # the skip-completion must not deactivate the collector)
+    got = _run("""
+    @info(name='q')
+    from every e1=Stream1[price > 10]*, e2=Stream1[price > 20]
+    select e1[0].price as p0, e2.price as p2 insert into Out;
+    """, [("Stream1", ["X", 25.0, 100]),    # matches BOTH e1* and e2
+          ("Stream1", ["Y", 30.0, 100])])  # closes the collector {X}
+    rows = [(a if a is None else round(a), round(b)) for a, b in got]
+    # zero-occurrence close on X (e1 null) + collector {X} closed by Y
+    assert (None, 25) in rows, rows
+    assert (25, 30) in rows, rows

@@ -1,0 +1,125 @@
+"""session(gap, key, allowed.latency) — late-arrival grace (reference:
+SessionWindowTestCase.java testSessionWindow14/17-20 shapes over
+SessionWindowProcessor.java's previous-session machinery)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+QL = """
+@app:playback
+define stream S (user string, item int);
+@info(name='q') from S#window.session(2 sec, user, 1 sec)
+select user, item insert all events into Out;
+"""
+
+
+def _run(sends, ql=QL):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(ql)
+    chunks = []
+    rt.add_callback("q", lambda ts, cur, exp: chunks.append(
+        ([tuple(e.data) for e in (cur or [])],
+         [tuple(e.data) for e in (exp or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for data, ts in sends:
+        h.send(list(data), timestamp=ts)
+    rt.flush()
+    m.shutdown()
+    cur = [e for c, _ in chunks for e in c]
+    exps = [x for _, x in chunks if x]
+    return cur, exps
+
+
+def test_two_sessions_expire_after_latency():
+    # testSessionWindow14 shape: [101,102] then a gap, then [103,104];
+    # each session expires as its own chunk, latency-deferred
+    cur, exps = _run([
+        (["u", 101], 1000),
+        (["u", 102], 1010),
+        (["u", 103], 3510),    # > 1010+2000: rotates session 1 to previous
+        (["u", 104], 3515),
+        (["t", 0], 8000),      # past prev alive 1010+3000: flush [101,102]
+        (["t", 0], 20000),     # flush [103,104] (rotated then timed out)
+    ])
+    assert len(cur) >= 4
+    assert exps[0] == [("u", 101), ("u", 102)]
+    assert any(x == [("u", 103), ("u", 104)] for x in exps[1:]), exps
+
+
+def test_late_event_merges_previous_into_current():
+    # a late event that lands in the previous session and extends it
+    # forward re-merges previous into current (reference: mergeWindows)
+    cur, exps = _run([
+        (["u", 101], 1000),
+        (["u", 108], 3500),     # new session; prev = {101}, alive 4000
+        (["u", 105], 2200),     # late into prev; extends end -> merges
+        (["t", 0], 30000),      # everything now ONE session: one flush
+    ])
+    assert ("u", 105) in cur
+    merged = [x for x in exps if len(x) == 3]
+    assert merged and merged[0] == [("u", 101), ("u", 105), ("u", 108)]
+
+
+def test_late_event_into_previous_without_merge():
+    # prev and cur too far apart: a BACKWARDS late event joins prev only
+    # (no end extension, no merge), and prev expires separately with the
+    # late row first (ts order).  The late event rides the same batch as
+    # the rotating event: by reference timer semantics, any later batch
+    # would find previous already expired (alive = end + latency).
+    import numpy as np
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (user long, item int);
+    @info(name='q') from S#window.session(2 sec, user, 1 sec)
+    select user, item insert all events into Out;
+    """)
+    chunks = []
+    rt.add_callback("q", lambda ts, cur, exp: chunks.append(
+        ([tuple(e.data) for e in (cur or [])],
+         [tuple(e.data) for e in (exp or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([7, 101], timestamp=1000)
+    # one batch, clock 3100 (prev alive until 1000+2000+1000=4000): the
+    # session rotates, then late 90 at ts 900 joins previous BACKWARDS
+    # (900 < prev start 1000, still >= start - gap); backwards extension
+    # never re-merges (reference: only end-extension calls mergeWindows)
+    h.send_columns([np.array([7, 7], np.int64),
+                    np.array([200, 90], np.int32)],
+                   timestamps=np.array([3100, 900], np.int64))
+    h.send([8, 0], timestamp=30000)
+    h.send([8, 1], timestamp=60000)
+    rt.flush()
+    m.shutdown()
+    cur = [e for c, _ in chunks for e in c]
+    exps = [x for _, x in chunks if x]
+    assert (7, 90) in cur
+    assert [x for x in exps if (7, 101) in x][0] == [(7, 90), (7, 101)]
+    assert any(x == [(7, 200)] for x in exps), exps
+
+
+def test_too_late_for_both_sessions_dropped():
+    cur, exps = _run([
+        (["u", 101], 10000),
+        (["u", 200], 16000),     # rotates {101} to previous
+        (["u", 1], 2000),        # < prev start - gap: dropped
+        (["t", 0], 40000),
+    ])
+    assert ("u", 1) not in cur
+    assert all(("u", 1) not in x for x in exps)
+
+
+def test_per_key_latency_sessions_independent():
+    cur, exps = _run([
+        (["a", 1], 1000),
+        (["b", 2], 1100),
+        (["a", 3], 4000),        # a rotates; b's session untouched
+        (["t", 0], 30000),
+    ])
+    flat = [e for x in exps for e in x]
+    assert ("a", 1) in flat and ("b", 2) in flat and ("a", 3) in flat
+    # a's first session expired WITHOUT b's row in the same chunk
+    first_a = next(x for x in exps if ("a", 1) in x)
+    assert ("b", 2) not in first_a

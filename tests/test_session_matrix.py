@@ -1,0 +1,140 @@
+"""Session-window gap/overlap/late-event matrix (reference:
+TEST/core/window/SessionWindowTestCase.java testSessionWindow11-16 and the
+696-LoC SessionWindowProcessor's classification rules).  Playback
+timestamps drive the event clock exactly."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def _run(sends, gap="2 sec", extra=""):
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(f"""
+    @app:playback
+    define stream S (user string, item int);
+    @info(name='q') from S#window.session({gap}{extra})
+    select user, item insert all events into Out;
+    """)
+    events = []   # (kind, data) in arrival order
+    rt.add_callback("q", lambda ts, cur, exp: events.append(
+        ([tuple(e.data) for e in (cur or [])],
+         [tuple(e.data) for e in (exp or [])])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for data, ts in sends:
+        h.send(list(data), timestamp=ts)
+    rt.flush()
+    m.shutdown()
+    cur = [e for c, _ in events for e in c]
+    exp = [e for _, x in events for e in x]
+    return cur, exp
+
+
+def test_single_event_session_timeout():
+    # testSessionWindow11: one event, session times out, expires alone
+    cur, exp = _run([(["u", 101], 1000), (["tick", 0], 4000)])
+    assert ("u", 101) in cur
+    assert exp[0] == ("u", 101)
+
+
+def test_two_sessions_same_key_sequential():
+    # testSessionWindow12: two sessions for one key expire separately
+    cur, exp = _run([
+        (["u", 1], 1000), (["u", 2], 1500),      # session A
+        (["u", 3], 5000), (["u", 4], 5200),      # gap > 2s: session B
+        (["end", 0], 9000),
+    ])
+    assert exp == [("u", 1), ("u", 2), ("u", 3), ("u", 4)]
+
+
+def test_overlapping_windows_boundary():
+    # an event exactly at last + gap belongs to a NEW session (gap strictly
+    # bounds the quiet period: last + gap <= now expires)
+    cur, exp = _run([
+        (["u", 1], 1000),
+        (["u", 2], 3000),    # == 1000 + 2000: previous session expired
+        (["end", 0], 6000),
+    ])
+    assert exp == [("u", 1), ("u", 2)]
+
+
+def test_in_gap_late_event_joins_and_sorts_first():
+    # testSessionWindow15: a late event within start-gap joins the live
+    # session; on expiry, rows come out in ts order (late first)
+    cur, exp = _run([
+        (["a", 101], 5000),
+        (["b", 102], 5010),
+        (["late", 103], 4000),   # 4000 >= 5000-2000: joins
+        (["end", 0], 9000),
+    ])
+    assert ("late", 103) in cur
+    assert exp == [("late", 103), ("a", 101), ("b", 102)]
+
+
+def test_too_late_event_dropped():
+    # testSessionWindow16: ts < start - gap: the event's session has
+    # already timed out; it is dropped, not merged
+    cur, exp = _run([
+        (["a", 101], 5000),
+        (["dead", 103], 2500),   # 2500 < 5000-2000: dropped
+        (["end", 0], 9000),
+    ])
+    assert ("dead", 103) not in cur
+    assert exp == [("a", 101)]
+
+
+def test_late_event_extends_session_start_backwards():
+    # after a late join, the session's reach extends from the LATE ts:
+    # an even-later event within late_ts - gap now also joins
+    cur, exp = _run([
+        (["a", 1], 5000),
+        (["late1", 2], 3500),     # joins, start -> 3500
+        (["late2", 3], 1800),     # 1800 >= 3500-2000: joins now
+        (["end", 0], 9000),
+    ])
+    assert exp == [("late2", 3), ("late1", 2), ("a", 1)]
+
+
+def test_gap_measured_from_last_event_not_start():
+    # steady arrivals each < gap apart keep ONE session alive far beyond
+    # start + gap (the gap is quiet-period, not window length)
+    sends = [(["u", i], 1000 + i * 1500) for i in range(6)]  # 1.5s spacing
+    sends.append((["end", 0], 30000))
+    cur, exp = _run(sends)
+    assert exp == [("u", i) for i in range(6)]
+
+
+def test_session_aggregate_per_flush():
+    # aggregation over a session's contents at expiry (common usage shape)
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime("""
+    @app:playback
+    define stream S (user string, item int);
+    @info(name='q') from S#window.session(1 sec)
+    select sum(item) as total insert expired events into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(
+        e.data[0] for e in (cur or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send(["u", 10], timestamp=1000)
+    h.send(["u", 20], timestamp=1500)
+    h.send(["u", 99], timestamp=5000)
+    h.send(["end", 1], timestamp=9000)
+    rt.flush()
+    m.shutdown()
+    # expired-events mode emits the RUNNING sum as each session row leaves
+    assert got[-1] == 0 or got, got
+
+
+def test_latency_greater_than_gap_rejected():
+    # reference: validateAllowedLatency — allowed.latency <= session.gap
+    m = SiddhiManager()
+    with pytest.raises(Exception, match="latency"):
+        m.create_siddhi_app_runtime("""
+        define stream S (user string, item int);
+        from S#window.session(2 sec, user, 3 sec)
+        select user insert into Out;
+        """)
+    m.shutdown()
