@@ -411,10 +411,13 @@ class PatternExec:
                     srcs.append(q)
                     q -= 1
             skip_srcs[a_.pos] = srcs
-        adv_pos = st.pos + 1       # [P,K] target when advancing in place
         fork_tgt = st.pos + 1      # [P,K] forked continuation's position
-        stayed = F                 # [P,K] collectors that must sit at the
-        stay_pos = st.pos          # matched atom's position (skip moves)
+        fork_cnt = jnp.zeros_like(st.count)   # forked slot's start count
+        capture_here = {}          # captures owned by the slot's own
+                                   # position (drives its count); skip
+                                   # captures ride `capture` for forks/
+                                   # emission but must not advance the
+                                   # surviving origin's count
 
         def mark(d, key, m):
             d[key] = jnp.logical_or(d.get(key, F), m)
@@ -475,6 +478,7 @@ class PatternExec:
                         else jnp.logical_and(m, have_other)
                     lmask_new = jnp.where(m, lmask_new | bit, lmask_new)
                     mark(capture, atom.ckey, m)
+                    mark(capture_here, atom.ckey, m)
                     if last:
                         complete = jnp.logical_or(complete, adv)
                         deactivate = jnp.logical_or(deactivate, adv)
@@ -482,6 +486,7 @@ class PatternExec:
                         advance_inplace = jnp.logical_or(advance_inplace, adv)
                 elif not a.is_count:
                     mark(capture, atom.ckey, m)
+                    mark(capture_here, atom.ckey, m_here)
                     if last:
                         # skip-completions (m_skip) emit but do NOT kill the
                         # slot: the zero-collect continuation survives to
@@ -492,7 +497,6 @@ class PatternExec:
                     else:
                         advance_inplace = jnp.logical_or(advance_inplace,
                                                          m_here)
-                        adv_pos = jnp.where(m_here, a.pos + 1, adv_pos)
                         # skip-advances FORK a continuation at the target
                         # position; the collector stays where it was
                         fork = jnp.logical_or(fork, m_skip)
@@ -503,6 +507,7 @@ class PatternExec:
                     can_stay = jnp.logical_and(m_here, newc < maxc)
                     can_adv = jnp.logical_and(m_here, newc >= a.min_count)
                     mark(capture, atom.ckey, m)
+                    mark(capture_here, atom.ckey, m_here)
                     if last:
                         complete = jnp.logical_or(complete, can_adv)
                         if a.min_count <= 1:
@@ -512,8 +517,6 @@ class PatternExec:
                         deactivate = jnp.logical_or(
                             deactivate,
                             jnp.logical_and(can_adv, jnp.logical_not(can_stay)))
-                        stayed = jnp.logical_or(stayed, can_stay)
-                        stay_pos = jnp.where(can_stay, a.pos, stay_pos)
                     else:
                         fk = jnp.logical_and(can_adv, can_stay)
                         fork = jnp.logical_or(fork, fk)
@@ -521,14 +524,16 @@ class PatternExec:
                         ai = jnp.logical_and(can_adv,
                                              jnp.logical_not(can_stay))
                         advance_inplace = jnp.logical_or(advance_inplace, ai)
-                        adv_pos = jnp.where(ai, a.pos + 1, adv_pos)
-                        stayed = jnp.logical_or(stayed, can_stay)
-                        stay_pos = jnp.where(can_stay, a.pos, stay_pos)
                     # skip-collect into a count atom: fork a collector at
-                    # the target position (captures inherit the event);
-                    # the zero-collect origin survives
+                    # the target position that already HOLDS this event
+                    # (captures inherit; count starts at 1); the
+                    # zero-collect origin survives.  Known limitation: a
+                    # slot firing BOTH an own-position count fork and a
+                    # skip fork on one event keeps only the skip fork
+                    # (single fork candidate per slot)
                     fork = jnp.logical_or(fork, m_skip)
                     fork_tgt = jnp.where(m_skip, a.pos, fork_tgt)
+                    fork_cnt = jnp.where(m_skip, 1, fork_cnt)
 
         # SEQUENCE: strict continuity
         if spec.state_type == "SEQUENCE":
@@ -715,22 +720,18 @@ class PatternExec:
                       for c, sc in zip(cols_c, seed_cols)))
 
         # ---- phase 6: spawn forks + seed -----------------------------------
-        st = self._spawn(st, fork, fork_tgt, seed_spawn, seed_pos,
-                         seed_count, seed_side, seed_fork_also, stream_id,
-                         ev_cols, ev_ts, a0)
+        st = self._spawn(st, fork, fork_tgt, fork_cnt, seed_spawn,
+                         seed_pos, seed_count, seed_side, seed_fork_also,
+                         stream_id, ev_cols, ev_ts, a0)
 
         # ---- phase 7: in-place advance / kill / deactivate -----------------
-        captured_now = capture_any(capture, F)
+        captured_now = capture_any(capture_here, F)
         st = st._replace(
             count=jnp.where(advance_inplace | deactivate, 0,
                             jnp.where(captured_now, st.count + 1,
                                       st.count)).astype(jnp.int32),
-            # default st.pos is POST-spawn: freshly spawned slots keep the
-            # position _spawn assigned; advance/stay masks only cover slots
-            # that were active before the spawn
-            pos=jnp.where(advance_inplace, adv_pos,
-                          jnp.where(stayed, stay_pos,
-                                    st.pos)).astype(jnp.int32),
+            pos=jnp.where(advance_inplace, st.pos + 1,
+                          st.pos).astype(jnp.int32),
             lmask=jnp.where(advance_inplace, 0, st.lmask).astype(jnp.int32),
             entry_ts=jnp.where(advance_inplace, ev_ts[None, :], st.entry_ts),
             active=jnp.logical_and(
@@ -740,9 +741,9 @@ class PatternExec:
         return st, emit
 
     # -- spawn ----------------------------------------------------------------
-    def _spawn(self, st: PatternState, fork, fork_tgt, seed_spawn, seed_pos,
-               seed_count, seed_side, seed_fork_also, stream_id, ev_cols,
-               ev_ts, a0):
+    def _spawn(self, st: PatternState, fork, fork_tgt, fork_cnt, seed_spawn,
+               seed_pos, seed_count, seed_side, seed_fork_also, stream_id,
+               ev_cols, ev_ts, a0):
         """Allocate free slots for fork/seed candidates.
 
         Scatter-free formulation (TPU scatters serialize; gathers don't):
@@ -797,14 +798,14 @@ class PatternExec:
                  jnp.full((1, K), 1, jnp.int32),
                  jnp.full((1, K), 0, jnp.int32)], axis=0)
             ccount = jnp.concatenate(
-                [jnp.zeros((P, K), jnp.int32),
+                [fork_cnt.astype(jnp.int32),
                  jnp.zeros((1, K), jnp.int32),
                  jnp.ones((1, K), jnp.int32)], axis=0)
         else:
             cpos = jnp.concatenate(
                 [fork_pos, jnp.full((1, K), seed_pos, jnp.int32)], axis=0)
             ccount = jnp.concatenate(
-                [jnp.zeros((P, K), jnp.int32),
+                [fork_cnt.astype(jnp.int32),
                  jnp.full((1, K), seed_count, jnp.int32)], axis=0)
         # lmask only matters while the seed STAYS at position 0 collecting
         # the other logical side; an immediately-advancing seed (OR, or

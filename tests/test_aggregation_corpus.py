@@ -1,0 +1,124 @@
+"""Incremental-aggregation corpus (reference shapes:
+TEST/aggregation/Aggregation1TestCase + Aggregation2TestCase +
+AggregationFilterTestCase — duration rollups, on-demand within/per reads,
+filtered sources, min/max/count families, multi-group keys)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+T0 = 1590969600000  # 2020-06-01 00:00:00 UTC
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def _agg_rt(manager, select, extra="", group="group by symbol"):
+    rt = manager.create_siddhi_app_runtime(f"""
+    define stream Trades (symbol string, price double, volume long, ts long);
+    define aggregation A
+    from Trades{extra}
+    select symbol, {select}
+    {group}
+    aggregate by ts every seconds...days;
+    """)
+    rt.start()
+    return rt
+
+
+def _q(rt, per, within=None):
+    w = f'within "2020-06-01 00:00:00", "2020-06-02 00:00:00"' \
+        if within is None else within
+    return rt.query(f'from A {w} per "{per}" select *')
+
+
+def test_min_max_count_rollup(manager):
+    rt = _agg_rt(manager, "min(price) as lo, max(price) as hi, "
+                          "count() as n")
+    h = rt.get_input_handler("Trades")
+    h.send(["IBM", 100.0, 1, T0])
+    h.send(["IBM", 50.0, 1, T0 + 100])
+    h.send(["IBM", 300.0, 1, T0 + 61_000])    # next minute
+    rt.flush()
+    rows = {e.data[0]: tuple(e.data[2:5]) for e in _q(rt, "minutes")}
+    # minute bucket 1: lo=50 hi=100 n=2; bucket 2: 300/300/1
+    assert len(_q(rt, "minutes")) == 2
+    days = _q(rt, "days")
+    assert len(days) == 1
+    _, _, lo, hi, n = days[0].data[:5]
+    assert (lo, hi, n) == (50.0, 300.0, 3)
+
+
+def test_filtered_source_feeds_aggregation(manager):
+    # reference: AggregationFilterTestCase — filter before aggregation
+    rt = _agg_rt(manager, "sum(volume) as total",
+                 extra="[price > 10.0]")
+    h = rt.get_input_handler("Trades")
+    h.send(["IBM", 100.0, 7, T0])
+    h.send(["IBM", 5.0, 1000, T0 + 10])    # filtered out
+    h.send(["IBM", 20.0, 3, T0 + 20])
+    rt.flush()
+    days = _q(rt, "days")
+    assert len(days) == 1 and days[0].data[2] == 10
+
+
+def test_multi_group_keys(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream Trades (symbol string, side string, volume long, ts long);
+    define aggregation A
+    from Trades
+    select symbol, side, sum(volume) as total
+    group by symbol, side
+    aggregate by ts every seconds...days;
+    """)
+    rt.start()
+    h = rt.get_input_handler("Trades")
+    for s, sd, v in (("IBM", "buy", 1), ("IBM", "sell", 2),
+                     ("IBM", "buy", 4), ("WSO2", "buy", 8)):
+        h.send([s, sd, v, T0])
+    rt.flush()
+    rows = {(e.data[1], e.data[2]): e.data[3] for e in rt.query(
+        'from A within "2020-06-01 00:00:00", "2020-06-02 00:00:00" '
+        'per "days" select *')}
+    assert rows[("IBM", "buy")] == 5
+    assert rows[("IBM", "sell")] == 2
+    assert rows[("WSO2", "buy")] == 8
+
+
+def test_within_bounds_exclude_outside_buckets(manager):
+    rt = _agg_rt(manager, "sum(volume) as total")
+    h = rt.get_input_handler("Trades")
+    h.send(["IBM", 1.0, 10, T0])
+    h.send(["IBM", 1.0, 20, T0 + 86_400_000])    # next day: outside within
+    rt.flush()
+    days = _q(rt, "days")
+    assert len(days) == 1 and days[0].data[2] == 10
+
+
+def test_avg_weighted_across_buckets(manager):
+    # avg over a coarser duration re-weights by count, not bucket means
+    rt = _agg_rt(manager, "avg(price) as ap")
+    h = rt.get_input_handler("Trades")
+    h.send(["IBM", 10.0, 1, T0])
+    h.send(["IBM", 20.0, 1, T0 + 10])
+    h.send(["IBM", 90.0, 1, T0 + 61_000])   # second minute, single event
+    rt.flush()
+    days = _q(rt, "days")
+    # true mean = (10+20+90)/3 = 40, NOT mean-of-minute-means (15+90)/2
+    assert days[0].data[2] == pytest.approx(40.0)
+
+
+def test_ondemand_aggregate_functions_over_buckets(manager):
+    # on-demand re-aggregation on top of the bucket read
+    rt = _agg_rt(manager, "sum(volume) as total")
+    h = rt.get_input_handler("Trades")
+    for i in range(5):
+        h.send(["IBM", 1.0, 10, T0 + i * 1000])
+    rt.flush()
+    out = rt.query(
+        'from A within "2020-06-01 00:00:00", "2020-06-02 00:00:00" '
+        'per "seconds" select sum(total) as grand')
+    assert out[0].data[0] == 50

@@ -100,3 +100,65 @@ def test_output_all_passthrough_default(manager):
     """
     got = _run(manager, ql, [(["a", 1], None), (["b", 2], None)])
     assert [g[0] for g in got] == ["a", "b"]
+
+
+def test_output_all_every_n_events(manager):
+    # reference: EventOutputRateLimitTestCase 'output every 2 events' —
+    # ALL accumulated events flush together every N
+    rt = manager.create_siddhi_app_runtime("""
+    define stream In (k string, v int);
+    @info(name='q') from In select k, v
+    output every 3 events insert into Out;
+    """)
+    chunks = []
+    rt.add_callback("q", lambda ts, cur, exp: chunks.append(
+        [e.data[0] for e in (cur or [])]))
+    rt.start()
+    h = rt.get_input_handler("In")
+    for i in range(7):
+        h.send([f"e{i}", i])
+    rt.flush()
+    m = [c for c in chunks if c]
+    assert m[0] == ["e0", "e1", "e2"]
+    assert m[1] == ["e3", "e4", "e5"]
+
+
+def test_output_last_per_group(manager):
+    # reference: EventOutputRateLimitTestCase group-by variant — LAST is
+    # per group key, not global
+    rt = manager.create_siddhi_app_runtime("""
+    define stream In (k string, v int);
+    @info(name='q') from In select k, v group by k
+    output last every 4 events insert into Out;
+    """)
+    chunks = []
+    rt.add_callback("q", lambda ts, cur, exp: chunks.append(
+        [tuple(e.data) for e in (cur or [])]))
+    rt.start()
+    h = rt.get_input_handler("In")
+    for k, v in (("a", 1), ("b", 2), ("a", 3), ("b", 4)):
+        h.send([k, v])
+    rt.flush()
+    flat = [e for c in chunks for e in c]
+    # last event of each group within the window of 4
+    assert ("a", 3) in flat and ("b", 4) in flat
+    assert ("a", 1) not in flat
+
+
+def test_output_first_per_group(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream In (k string, v int);
+    @info(name='q') from In select k, v group by k
+    output first every 4 events insert into Out;
+    """)
+    chunks = []
+    rt.add_callback("q", lambda ts, cur, exp: chunks.append(
+        [tuple(e.data) for e in (cur or [])]))
+    rt.start()
+    h = rt.get_input_handler("In")
+    for k, v in (("a", 1), ("b", 2), ("a", 3), ("b", 4)):
+        h.send([k, v])
+    rt.flush()
+    flat = [e for c in chunks for e in c]
+    assert ("a", 1) in flat and ("b", 2) in flat
+    assert ("a", 3) not in flat and ("b", 4) not in flat

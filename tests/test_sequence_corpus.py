@@ -186,3 +186,22 @@ def test_skip_and_collect_interpretations_coexist():
     # zero-occurrence close on X (e1 null) + collector {X} closed by Y
     assert (None, 25) in rows, rows
     assert (25, 30) in rows, rows
+
+
+def test_skip_completion_leaves_origin_collection_intact():
+    # review finding: a skip-completion must not bump the origin slot's
+    # count — its LATER collections must land at depth 0 with correct
+    # e1[0]/e1[last], and further skips stay possible
+    got = _run("""
+    @info(name='q')
+    from every e1=Stream2[price>20]*, e2=Stream1[price>0]
+    select e1[0].price as p0, e1[last].price as pl, e2.price as p2
+    insert into Out;
+    """, [("Stream1", ["B1", 1.0, 1]),      # zero-occurrence completion
+          ("Stream2", ["A1", 25.0, 1]),     # collect depth 0
+          ("Stream2", ["A2", 30.0, 1]),     # collect depth 1
+          ("Stream1", ["B2", 2.0, 1])])     # closes {A1, A2}
+    rows = [(a if a is None else round(a),
+             b if b is None else round(b), round(c)) for a, b, c in got]
+    assert (None, None, 1) in rows, rows     # the skip completion
+    assert (25, 30, 2) in rows, rows         # the full collection
