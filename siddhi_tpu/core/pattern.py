@@ -418,6 +418,9 @@ class PatternExec:
                                    # captures ride `capture` for forks/
                                    # emission but must not advance the
                                    # surviving origin's count
+        skip_marks = {}            # atom ckey -> [P,K] skip-match mask:
+                                   # after forks inherit, the surviving
+                                   # origin reverts these captures to null
 
         def mark(d, key, m):
             d[key] = jnp.logical_or(d.get(key, F), m)
@@ -456,6 +459,8 @@ class PatternExec:
                     m_skip = jnp.logical_and(
                         jnp.logical_and(from_skip, cond), ev_ok[None, :])
                 m = jnp.logical_or(m_here, m_skip)
+                if atom is a and skip_srcs.get(a.pos):
+                    mark(skip_marks, atom.ckey, m_skip)
                 if atom.absent:
                     # absence violated — unless the obligation was already
                     # satisfied (timed pair whose wait elapsed, bit 1<<side)
@@ -501,6 +506,7 @@ class PatternExec:
                         # position; the collector stays where it was
                         fork = jnp.logical_or(fork, m_skip)
                         fork_tgt = jnp.where(m_skip, a.pos + 1, fork_tgt)
+                        fork_cnt = jnp.where(m_skip, 0, fork_cnt)
                 else:
                     newc = st.count + 1
                     maxc = spec.count_cap if a.max_count < 0 else a.max_count
@@ -723,6 +729,30 @@ class PatternExec:
         st = self._spawn(st, fork, fork_tgt, fork_cnt, seed_spawn,
                          seed_pos, seed_count, seed_side, seed_fork_also,
                          stream_id, ev_cols, ev_ts, a0)
+
+        # surviving zero-collect origins revert skip-written captures to
+        # null AFTER emission (phase 5) and fork inheritance (phase 6)
+        # consumed them: a later fork from the origin must not carry a
+        # capture that belongs to the skipped interpretation only
+        if skip_marks:
+            newcaps2 = dict(st.caps)
+            for a in spec.all_atoms():
+                msk = skip_marks.get(a.ckey)
+                if msk is None or a.absent:
+                    continue
+                ts_c, cols_c = st.caps[a.ckey]
+                D2 = ts_c.shape[1]
+                idx2 = jnp.clip(st.count, 0, D2 - 1)
+                a_sch = self.schemas[a.stream_id]
+                nts2 = _set_along(ts_c, idx2, jnp.full(idx2.shape, -1,
+                                                       jnp.int64), msk)
+                ncols2 = tuple(
+                    _set_along(c, idx2,
+                               jnp.full(idx2.shape, ev.null_value(t),
+                                        c.dtype), msk)
+                    for c, t in zip(cols_c, a_sch.types))
+                newcaps2[a.ckey] = (nts2, ncols2)
+            st = st._replace(caps=newcaps2)
 
         # ---- phase 7: in-place advance / kill / deactivate -----------------
         captured_now = capture_any(capture_here, F)

@@ -43,9 +43,12 @@ def test_min_max_count_rollup(manager):
     h.send(["IBM", 50.0, 1, T0 + 100])
     h.send(["IBM", 300.0, 1, T0 + 61_000])    # next minute
     rt.flush()
-    rows = {e.data[0]: tuple(e.data[2:5]) for e in _q(rt, "minutes")}
-    # minute bucket 1: lo=50 hi=100 n=2; bucket 2: 300/300/1
-    assert len(_q(rt, "minutes")) == 2
+    minutes = _q(rt, "minutes")
+    assert len(minutes) == 2
+    # keyed on bucket start: bucket 1 lo=50 hi=100 n=2; bucket 2 300/300/1
+    by_bucket = {e.data[0]: tuple(e.data[2:5]) for e in minutes}
+    assert by_bucket[T0] == (50.0, 100.0, 2)
+    assert by_bucket[T0 + 60_000] == (300.0, 300.0, 1)
     days = _q(rt, "days")
     assert len(days) == 1
     _, _, lo, hi, n = days[0].data[:5]

@@ -1,0 +1,116 @@
+"""Built-in function matrix (reference: TEST/query/function/
+{Cast,Convert,IfThenElse,InstanceOf,Maximum,Minimum,UUID}FunctionTestCase
+— conversion across every numeric pair, branch typing, n-ary extremes
+with null skipping, type introspection, per-event UUID uniqueness)."""
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+def _drive(manager, select, rows, schema="(i int, l long, f float, d double, b bool, s string)"):
+    rt = manager.create_siddhi_app_runtime(f"""
+    define stream S {schema};
+    @info(name='q') from S select {select} insert into Out;
+    """)
+    got = []
+    rt.add_callback("q", lambda ts, cur, exp: got.extend(
+        list(e.data) for e in (cur or [])))
+    rt.start()
+    h = rt.get_input_handler("S")
+    for r in rows:
+        h.send(list(r))
+    rt.flush()
+    return got
+
+
+ROW = (7, 9_000_000_000, 2.5, 3.25, True, "x")
+
+
+@pytest.mark.parametrize("expr,expect", [
+    # cast: numeric pairs (CastFunctionExecutorTestCase matrix)
+    ("cast(i, 'long')", 7), ("cast(i, 'float')", 7.0),
+    ("cast(i, 'double')", 7.0),
+    ("cast(l, 'double')", 9_000_000_000.0),
+    ("cast(f, 'int')", 2), ("cast(f, 'long')", 2),
+    ("cast(f, 'double')", 2.5),
+    ("cast(d, 'int')", 3), ("cast(d, 'float')", 3.25),
+    # convert aliases cast for numerics (ConvertFunctionTestCase)
+    ("convert(i, 'double')", 7.0),
+    ("convert(d, 'long')", 3),
+    ("convert(b, 'bool')", True),
+])
+def test_cast_convert_matrix(manager, expr, expect):
+    got = _drive(manager, f"{expr} as x", [ROW])
+    v = got[0][0]
+    if isinstance(expect, float):
+        assert v == pytest.approx(expect, rel=1e-6), (expr, v)
+    else:
+        assert v == expect, (expr, v)
+
+
+def test_if_then_else_branch_types(manager):
+    got = _drive(manager,
+                 "ifThenElse(b, i, 0) as a, "
+                 "ifThenElse(i > 100, f, d) as c", [ROW])
+    assert got[0][0] == 7
+    assert got[0][1] == pytest.approx(3.25)
+
+
+def test_maximum_minimum_nary(manager):
+    got = _drive(manager,
+                 "maximum(i, cast(f, 'int'), 5) as mx, "
+                 "minimum(i, cast(f, 'int'), 5) as mn", [ROW])
+    assert got[0] == [7, 2]
+
+
+def test_maximum_skips_null_arguments(manager):
+    # reference: MaximumFunctionExtensionTestCase — nulls are ignored
+    got = _drive(manager, "maximum(i, j) as mx, minimum(i, j) as mn",
+                 [[3, None], [None, 9], [None, None]],
+                 schema="(i int, j int)")
+    assert got[0] == [3, 3]
+    assert got[1] == [9, 9]
+    assert got[2] == [None, None]      # all-null -> null
+
+
+@pytest.mark.parametrize("fn,expect", [
+    ("instanceOfInteger(i)", True), ("instanceOfInteger(l)", False),
+    ("instanceOfLong(l)", True), ("instanceOfFloat(f)", True),
+    ("instanceOfDouble(d)", True), ("instanceOfBoolean(b)", True),
+    ("instanceOfString(s)", True), ("instanceOfString(i)", False),
+])
+def test_instance_of_matrix(manager, fn, expect):
+    got = _drive(manager, f"{fn} as x", [ROW])
+    assert got[0][0] is expect, (fn, got)
+
+
+def test_uuid_unique_per_event(manager):
+    got = _drive(manager, "UUID() as u, i as i", [ROW, ROW, ROW])
+    ids = [r[0] for r in got]
+    assert len(set(ids)) == 3
+    assert all(isinstance(u, str) and len(u) == 36 for u in ids)
+
+
+def test_coalesce_and_default(manager):
+    got = _drive(manager,
+                 "coalesce(j, i) as c, default(j, 42) as d",
+                 [[1, None], [2, 9]], schema="(i int, j int)")
+    assert got[0] == [1, 42]
+    assert got[1] == [9, 9]
+
+
+def test_math_namespace_chain(manager):
+    got = _drive(manager,
+                 "math:abs(0.0 - f) as a, math:floor(d) as fl, "
+                 "math:sqrt(cast(i, 'double') + 2.0) as r", [ROW])
+    a, fl, r = got[0]
+    assert a == pytest.approx(2.5)
+    assert fl == pytest.approx(3.0)
+    assert r == pytest.approx(3.0)
