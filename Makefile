@@ -2,9 +2,9 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test lint dryrun bench all
+.PHONY: test lint dryrun bench metrics-smoke all
 
-all: lint test dryrun
+all: lint test dryrun metrics-smoke
 
 lint:
 	$(PY) -m compileall -q siddhi_tpu tests samples
@@ -17,3 +17,8 @@ dryrun:
 
 bench:
 	$(PY) bench.py
+
+# boots a sample app behind the REST service, scrapes GET /metrics, and
+# asserts the required metric families are present (observability layer)
+metrics-smoke:
+	$(CPU_ENV) $(PY) samples/metrics_smoke.py

@@ -1,0 +1,78 @@
+"""Metrics smoke test: boot a sample app behind the REST service, push
+traffic, scrape GET /metrics, and assert the required metric families are
+present and well-formed.  Run via `make metrics-smoke` (CI/tooling hook of
+the observability layer; see README "Observability")."""
+import json
+import re
+import sys
+import urllib.request
+
+sys.path.insert(0, ".")
+
+from siddhi_tpu.service import SiddhiRestService  # noqa: E402
+
+APP = """@app:name('SmokeApp')
+@app:statistics('DETAIL')
+define stream Trades (symbol string, price double, volume long);
+@info(name='vwap')
+from Trades#window.lengthBatch(16)
+select symbol, sum(price * volume) / sum(volume) as vwap
+group by symbol insert into Vwap;
+"""
+
+REQUIRED_FAMILIES = (
+    "siddhi_uptime_seconds",
+    "siddhi_stream_events_total",
+    "siddhi_query_events_total",
+    "siddhi_query_latency_seconds",
+    "siddhi_junction_dispatch_seconds",
+    "siddhi_query_recompiles_total",
+)
+
+SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? '
+    r'[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|[0-9]+)$')
+
+
+def main() -> int:
+    svc = SiddhiRestService().start()
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        req = urllib.request.Request(f"{base}/siddhi-apps",
+                                     data=APP.encode(), method="POST")
+        assert urllib.request.urlopen(req).status == 201, "deploy failed"
+        events = [["ACME", 50.0 + i, 10 + i] for i in range(64)]
+        body = json.dumps({"events": events}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/siddhi-apps/SmokeApp/streams/Trades", data=body,
+            method="POST"))
+        svc.manager.runtimes["SmokeApp"].flush()
+
+        resp = urllib.request.urlopen(f"{base}/metrics")
+        assert resp.status == 200, resp.status
+        text = resp.read().decode()
+        families = set()
+        for line in text.rstrip("\n").split("\n"):
+            if line.startswith("# TYPE "):
+                families.add(line.split(" ")[2])
+            elif not line.startswith("#"):
+                assert SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+        missing = [f for f in REQUIRED_FAMILIES if f not in families]
+        assert not missing, f"missing metric families: {missing}"
+        assert 'siddhi_stream_events_total{app="SmokeApp",stream="Trades"}' \
+            in text, "per-stream throughput counter missing"
+        assert re.search(r'siddhi_query_latency_seconds_bucket\{app="SmokeApp'
+                         r'",query="vwap",le="[^"]+"\}', text), \
+            "per-query latency histogram buckets missing"
+        traces = json.loads(urllib.request.urlopen(
+            f"{base}/trace/vwap").read().decode())["traces"]
+        assert traces, "DETAIL pipeline traces missing"
+        print(f"metrics-smoke OK: {len(families)} families, "
+              f"{len(text.splitlines())} lines, {len(traces)} traces")
+        return 0
+    finally:
+        svc.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

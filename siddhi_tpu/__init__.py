@@ -14,7 +14,20 @@ import os
 # 2-column (long,int) partitioned NFA step) and compile slower than the
 # legacy emitters.  Best-effort opt-out before the backend initializes; a
 # no-op for TPU and for processes that already compiled something.
-if "--xla_cpu_use_fusion_emitters" not in os.environ.get("XLA_FLAGS", ""):
+# VERSION-GATED: older jaxlibs (< 0.9) don't know the flag, and XLA
+# hard-aborts the process on unknown XLA_FLAGS — the opt-out must only be
+# injected where the flag exists.
+def _jaxlib_has_fusion_emitters() -> bool:
+    try:
+        import jaxlib
+        major, minor = (int(x) for x in jaxlib.__version__.split(".")[:2])
+        return (major, minor) >= (0, 9)
+    except Exception:  # noqa: BLE001 — never block import on a probe
+        return False
+
+
+if "--xla_cpu_use_fusion_emitters" not in os.environ.get("XLA_FLAGS", "") \
+        and _jaxlib_has_fusion_emitters():
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
                                " --xla_cpu_use_fusion_emitters=false")
 

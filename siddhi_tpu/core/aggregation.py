@@ -500,7 +500,7 @@ class AggregationRuntime:
                 vals.append(v)
             return keep, jnp.stack(vals) if vals else jnp.zeros((0,) + ts.shape)
 
-        self._step = jit_step(step)
+        self._step = jit_step(step, owner=f"agg:{adef.id}")
 
         # device merge: one scatter per base row into the duration slab
         kinds = tuple(b.kind for b in self.base)
@@ -521,7 +521,8 @@ class AggregationRuntime:
                 rows.append(r)
             return jnp.stack(rows)
 
-        self._merge = jit_step(merge, donate_argnums=(0,))
+        self._merge = jit_step(merge, owner=f"agg:{adef.id}",
+                               donate_argnums=(0,))
 
     # -- construction ---------------------------------------------------------
     def _decompose(self, selector, scope: Scope) -> None:

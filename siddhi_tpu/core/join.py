@@ -405,7 +405,7 @@ def plan_join_query(
                 (nstate[0], nstate[1], sel_state), mesh)
             return new_state, out, wout.next_wakeup
 
-        return jit_step(step, donate_argnums=(0,))
+        return jit_step(step, owner=name, donate_argnums=(0,))
 
     step_left = None
     step_right = None
@@ -419,9 +419,9 @@ def plan_join_query(
         step_right = make_step(right, left, False)
     # non-triggering stream sides still need their window maintained
     if not left.is_table and step_left is None:
-        step_left = _make_feed_only(left, True, mesh)
+        step_left = _make_feed_only(left, True, mesh, owner=name)
     if not right.is_table and step_right is None:
-        step_right = _make_feed_only(right, False, mesh)
+        step_right = _make_feed_only(right, False, mesh, owner=name)
 
     def init_state():
         wl = left.window.init_state() if left.window else ()
@@ -448,7 +448,8 @@ def plan_join_query(
         compact_rows=emit_rows, emit_explicit=emit_explicit)
 
 
-def _make_feed_only(side: JoinSide, is_left: bool, mesh=None):
+def _make_feed_only(side: JoinSide, is_left: bool, mesh=None,
+                    owner=None):
     def step(state, ts, kind, valid, cols, gslot, other_table_cols, now):
         wl_state, wr_state, sel_state = state
         this_state = wl_state if is_left else wr_state
@@ -469,4 +470,4 @@ def _make_feed_only(side: JoinSide, is_left: bool, mesh=None):
         return _constrain_state(new_state, mesh), out_empty, \
             wout.next_wakeup
 
-    return jit_step(step, donate_argnums=(0,))
+    return jit_step(step, owner=owner, donate_argnums=(0,))

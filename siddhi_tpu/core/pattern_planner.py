@@ -24,7 +24,7 @@ from .pattern import PatternExec, PatternSpec, linearize, oh_take
 from .pattern_block import block_eligible, make_block_step
 from .selector import SelectorExec
 from .window import NO_WAKEUP, Rows
-from .steputil import jit_step
+from .steputil import jit_step, pcast, shard_map
 
 # test hook: force the sequential scan path even for block-eligible specs
 # (golden cross-checks compare the two implementations on the same input)
@@ -313,23 +313,26 @@ def plan_pattern_query(
         block_bodies = {sid: make_block_step(
             spec, pexec, sel, schemas, packer, sid, compact_rows)
             for sid in spec.stream_ids}
-        steps = {sid: jit_step(b, donate_argnums=(0, 1))
+        steps = {sid: jit_step(b, owner=name, donate_argnums=(0, 1))
                  for sid, b in block_bodies.items()}
-        steps_w = {sid: jit_step(wire_ts(b), donate_argnums=(0, 1))
+        steps_w = {sid: jit_step(wire_ts(b), owner=name,
+                                 donate_argnums=(0, 1))
                    for sid, b in block_bodies.items()}
     elif mesh is None:
-        steps = {sid: jit_step(body, donate_argnums=(0, 1))
+        steps = {sid: jit_step(body, owner=name, donate_argnums=(0, 1))
                  for sid, body in raw_steps.items()}
-        steps_w = {sid: jit_step(wire_ts(body), donate_argnums=(0, 1))
+        steps_w = {sid: jit_step(wire_ts(body), owner=name,
+                                 donate_argnums=(0, 1))
                    for sid, body in raw_steps.items()}
-        dense_steps = {sid: jit_step(make_step(sid, dense=True),
+        dense_steps = {sid: jit_step(make_step(sid, dense=True), owner=name,
                                      donate_argnums=(0, 1))
                        for sid in spec.stream_ids}
         dense_steps_w = {sid: jit_step(wire_ts(make_step(sid, dense=True)),
-                                       donate_argnums=(0, 1))
+                                       owner=name, donate_argnums=(0, 1))
                          for sid in spec.stream_ids}
     else:
-        steps = {sid: _shard_step(body, mesh, packer, pexec, sel)
+        steps = {sid: _shard_step(body, mesh, packer, pexec, sel,
+                                  owner=name)
                  for sid, body in raw_steps.items()}
 
     timer_step = None
@@ -361,7 +364,8 @@ def plan_pattern_query(
                 jnp.any(nb64 != b64, axis=0)
             return (nb32, nb64, nscalars), sel_state, out, wake, changed
 
-        timer_step = jit_step(tstep, donate_argnums=(0, 1))
+        timer_step = jit_step(tstep, owner=name,
+                              donate_argnums=(0, 1))
 
     def init_state(K: int):
         return packer.pack(pexec.init_state(K)), sel.init_state()
@@ -417,7 +421,8 @@ def _used_refs(query: Query, spec: PatternSpec) -> set:
 
 
 def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
-                sel: SelectorExec):
+                sel: SelectorExec,
+                owner=None):
     """Shard the pattern step over the mesh 'shard' axis.
 
     Design (scaling-book style): partition keys are the shard axis — each
@@ -449,30 +454,30 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
         b32, b64, scalars = packed
         old_scalars = scalars
         # replicated scalar counters become device-varying inside; mark them
-        scalars = tuple(lax.pcast(s, ("shard",), to="varying")
+        scalars = tuple(pcast(s, ("shard",), to="varying")
                         for s in scalars)
-        raw_cols = tuple(lax.pcast(c, ("shard",), to="varying")
+        raw_cols = tuple(pcast(c, ("shard",), to="varying")
                          for c in raw_cols)
-        raw_ts = lax.pcast(raw_ts, ("shard",), to="varying")
+        raw_ts = pcast(raw_ts, ("shard",), to="varying")
         in_tabs = jax.tree.map(
-            lambda x: lax.pcast(x, ("shard",), to="varying"), in_tabs)
+            lambda x: pcast(x, ("shard",), to="varying"), in_tabs)
         ps, ss, out, wake = body((b32, b64, scalars), sel_state, raw_cols,
                                  raw_ts, sel, key_idx, now, in_tabs)
         out = (lax.psum(out[0], "shard"), lax.psum(out[1], "shard")) + out[2:]
         nb32, nb64, nscal = ps
         # re-replicate scalar counters: old + psum(local delta)
         nscal = tuple(
-            old + lax.psum(new - lax.pcast(old, ("shard",), to="varying"),
+            old + lax.psum(new - pcast(old, ("shard",), to="varying"),
                            "shard")
             for old, new in zip(old_scalars, nscal))
         wake = lax.pmin(wake, "shard")
         return (nb32, nb64, nscal), ss, out, wake
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh,
         in_specs=(pspec, sspec, rspec, rspec, bspec, bspec, P(), P()),
         out_specs=(pspec, sspec, (P(), P(), bspec, bspec, bspec, bspec), P()))
-    return jit_step(sharded, donate_argnums=(0, 1))
+    return jit_step(sharded, owner=owner, donate_argnums=(0, 1))
 
 
 def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,

@@ -26,7 +26,7 @@ from ..query_api.query import (
 )
 from . import event as ev
 from .executor import CompileError, Scope, compile_expression
-from .steputil import jit_step
+from .steputil import jit_step, pcast, shard_map
 from .keyslots import SlotAllocator
 from .selector import SelectorExec
 from .window import (
@@ -113,7 +113,8 @@ def _merge_rows(ovalid, col):
     return lax.psum(z, "shard")
 
 
-def _shard_plain_step(step, mesh, sel, wproc, group_slots: int):
+def _shard_plain_step(step, mesh, sel, wproc, group_slots: int,
+                      owner=None):
     """Shard a windowless partitioned group-by step over the mesh.
 
     Design (same scaling-book recipe as the pattern path): group slots are
@@ -140,17 +141,17 @@ def _shard_plain_step(step, mesh, sel, wproc, group_slots: int):
 
     def local(state, ts, kind, valid, cols, gslot, now, in_tabs, pslots):
         dev = lax.axis_index("shard")
-        ts = lax.pcast(ts, ("shard",), to="varying")
-        kind = lax.pcast(kind, ("shard",), to="varying")
-        valid = lax.pcast(valid, ("shard",), to="varying")
-        cols = tuple(lax.pcast(c, ("shard",), to="varying") for c in cols)
-        gslot = lax.pcast(gslot, ("shard",), to="varying")
+        ts = pcast(ts, ("shard",), to="varying")
+        kind = pcast(kind, ("shard",), to="varying")
+        valid = pcast(valid, ("shard",), to="varying")
+        cols = tuple(pcast(c, ("shard",), to="varying") for c in cols)
+        gslot = pcast(gslot, ("shard",), to="varying")
         in_tabs = jax.tree.map(
-            lambda x: lax.pcast(x, ("shard",), to="varying"), in_tabs)
+            lambda x: pcast(x, ("shard",), to="varying"), in_tabs)
         wstate, astate = state
         old_w = wstate
         wstate = jax.tree.map(
-            lambda x: lax.pcast(x, ("shard",), to="varying"), wstate)
+            lambda x: pcast(x, ("shard",), to="varying"), wstate)
         # round-robin ownership (slot % n): sequential slot allocation
         # would park every early group on device 0 under a block split —
         # same layout as the pattern path, device column = (s%n)*blk + s//n
@@ -172,19 +173,19 @@ def _shard_plain_step(step, mesh, sel, wproc, group_slots: int):
         # old + sum of per-device deltas (pattern-path recipe)
         wstate = jax.tree.map(
             lambda old, new: old + lax.psum(
-                new - lax.pcast(old, ("shard",), to="varying"), "shard"),
+                new - pcast(old, ("shard",), to="varying"), "shard"),
             old_w, wstate)
         return (wstate, astate), (ots, okind, ovalid, ocols), wake
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh,
         in_specs=((wspec, sspec), rspec, rspec, rspec, rspec, rspec, P(),
                   rspec, rspec),
         out_specs=((wspec, sspec), (P(), P(), P(), P()), P()))
-    return jit_step(sharded, donate_argnums=(0,))
+    return jit_step(sharded, owner=owner, donate_argnums=(0,))
 
 
-def _shard_keyed_step(kstep, mesh, K: int):
+def _shard_keyed_step(kstep, mesh, K: int, owner=None):
     """Shard the keyed-window step over the mesh 'shard' axis.
 
     Partition keys are the shard axis: each device owns the window-state
@@ -210,7 +211,7 @@ def _shard_keyed_step(kstep, mesh, K: int):
         is_bool = old.dtype == jnp.bool_
         oi = old.astype(jnp.int32) if is_bool else old
         ni = new.astype(jnp.int32) if is_bool else new
-        oi_v = lax.pcast(oi, ("shard",), to="varying")
+        oi_v = pcast(oi, ("shard",), to="varying")
         changed = ni != oi_v
         merged = oi + lax.psum(
             jnp.where(changed, ni - oi_v, jnp.zeros_like(ni)), "shard")
@@ -219,7 +220,7 @@ def _shard_keyed_step(kstep, mesh, K: int):
     def local(state, ts, kind, valid, cols, gslot, key_idx, sel_idx, now,
               in_tabs):
         dev = lax.axis_index("shard")
-        vary = lambda x: lax.pcast(x, ("shard",), to="varying")  # noqa: E731
+        vary = lambda x: pcast(x, ("shard",), to="varying")  # noqa: E731
         ts, kind, valid, gslot = vary(ts), vary(kind), vary(valid), \
             vary(gslot)
         cols = tuple(vary(c) for c in cols)
@@ -245,12 +246,12 @@ def _shard_keyed_step(kstep, mesh, K: int):
 
     wspec = P("shard")
     rspec = P()
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh,
         in_specs=((wspec, rspec), rspec, rspec, rspec, rspec, rspec, rspec,
                   rspec, P(), rspec),
         out_specs=((wspec, rspec), (P(), P(), P(), P()), P()))
-    return jit_step(sharded, donate_argnums=(0,))
+    return jit_step(sharded, owner=owner, donate_argnums=(0,))
 
 
 def plan_single_query(
@@ -543,10 +544,10 @@ def plan_single_query(
             # the replicated-state delta merge; they stay single-device
             and not wproc.emits_reset)
         if kshardable:
-            step_fn = _shard_keyed_step(kstep, mesh, K)
+            step_fn = _shard_keyed_step(kstep, mesh, K, owner=name)
             keyed_mesh = mesh
         else:
-            step_fn = jit_step(kstep, donate_argnums=(0,))
+            step_fn = jit_step(kstep, owner=name, donate_argnums=(0,))
             keyed_mesh = None
 
         def init_state():
@@ -568,10 +569,10 @@ def plan_single_query(
             # single-device delivery order
             wproc.compact = False
             step_fn = _shard_plain_step(step, mesh, sel, wproc,
-                                        allocator.capacity)
+                                        allocator.capacity, owner=name)
             plain_mesh = mesh
         else:
-            step_fn = jit_step(step, donate_argnums=(0,))
+            step_fn = jit_step(step, owner=name, donate_argnums=(0,))
             plain_mesh = None
 
         def init_state():

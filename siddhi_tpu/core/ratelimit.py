@@ -15,6 +15,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, List, Optional, Tuple
 
+from ..observability import tracing as _tracing
 from . import event as ev
 
 
@@ -33,6 +34,15 @@ class OutputRateLimiter:
         self._lk = threading.RLock()
 
     def process(self, pairs: List[Tuple[int, ev.Event]], now: int) -> None:
+        # rate-limit span on a DETAIL pipeline trace; the active() guard
+        # keeps the common (untraced) path allocation-free
+        if _tracing.active() is not None:
+            with _tracing.span("ratelimit",
+                               limiter=type(self).__name__,
+                               pairs=len(pairs)):
+                with self._lk:
+                    self._process(pairs, now)
+            return
         with self._lk:
             self._process(pairs, now)
 

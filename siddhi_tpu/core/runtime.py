@@ -24,6 +24,7 @@ import numpy as np
 
 from ..exceptions import (CannotRestoreStateError, DefinitionNotExistError,
                           MatchOverflowError, QueryNotExistError)
+from ..observability import tracing as _tracing
 from ..query_api.app import SiddhiApp
 from ..query_api.definition import StreamDefinition
 from ..query_api.query import Partition, Query, SingleInputStream
@@ -39,6 +40,26 @@ _NO_WAKEUP_INT = int(NO_WAKEUP)
 # @app:statistics DETAIL-level event tracing (reference: log4j TRACE at
 # StreamJunction.sendEvent :147 and QuerySelector.process :77)
 _trace_log = logging.getLogger("siddhi_tpu.trace")
+
+# shared no-op context for span sites on the OFF/BASIC hot path (nullcontext
+# enter/exit is stateless, so ONE instance serves every thread without
+# allocating per batch)
+_NULL_CM = contextlib.nullcontext()
+
+
+def _maybe_span(stage: str, **meta):
+    """A `tracing.span` when a DETAIL pipeline trace is active on this
+    thread, else the shared no-op context — one thread-local read at
+    OFF/BASIC, zero allocation."""
+    if _tracing.active() is None:
+        return _NULL_CM
+    return _tracing.span(stage, **meta)
+
+
+def _sub_name(sub, default: str) -> str:
+    """Metric name of a junction subscriber (wrappers hold the runtime in
+    _qr; plain runtimes carry .name)."""
+    return getattr(getattr(sub, "_qr", sub), "name", default)
 
 
 def current_millis() -> int:
@@ -235,10 +256,12 @@ class QueryRuntime:
             for alloc, pos in p.pair_allocs)
         batch = staged.to_device(p.in_schema)
         in_tabs = self.app.in_probe_tables(p.in_deps)
-        self.state, out, wake = p.step(
-            self.state, batch.ts, batch.kind, batch.valid, batch.cols,
-            jax.numpy.asarray(gslot), jax.numpy.asarray(now, jax.numpy.int64),
-            in_tabs, pslots)
+        with _maybe_span("step", query=self.name, kind="window"):
+            self.state, out, wake = p.step(
+                self.state, batch.ts, batch.kind, batch.valid, batch.cols,
+                jax.numpy.asarray(gslot),
+                jax.numpy.asarray(now, jax.numpy.int64),
+                in_tabs, pslots)
         # the device-computed wake scalar rides the emission fetch (a sync
         # int(wake) here would stall the send path one tunnel RTT per batch)
         wake_arg = None
@@ -289,11 +312,12 @@ class QueryRuntime:
         batch = ev.StagedBatch(staged.ts, staged.kind, valid, staged.cols,
                                staged.n).to_device(p.in_schema)
         in_tabs = self.app.in_probe_tables(p.in_deps)
-        self.state, out, wake = p.step(
-            self.state, batch.ts, batch.kind, batch.valid, batch.cols,
-            jax.numpy.asarray(gslot), jax.numpy.asarray(key_idx),
-            jax.numpy.asarray(sel),
-            jax.numpy.asarray(now, jax.numpy.int64), in_tabs)
+        with _maybe_span("step", query=self.name, kind="keyed-window"):
+            self.state, out, wake = p.step(
+                self.state, batch.ts, batch.kind, batch.valid, batch.cols,
+                jax.numpy.asarray(gslot), jax.numpy.asarray(key_idx),
+                jax.numpy.asarray(sel),
+                jax.numpy.asarray(now, jax.numpy.int64), in_tabs)
         wake_arg = None
         if p.needs_timer:
             if getattr(p.window, "host_scheduled", False):
@@ -379,6 +403,12 @@ class PatternQueryRuntime:
             "%s: %d pattern match rows dropped at emission capacity %d; "
             "growing the cap to %d (set @emit(rows='N') to pre-size and "
             "silence this)", self.name, n_dropped, cap, new_cap)
+        # operator-visible counter: each growth is a step recompile
+        # (minutes through the TPU tunnel) — invisible cap churn was the
+        # old failure mode
+        stats = self.app.stats
+        if stats.enabled:
+            stats.counter_inc(f"{self.name}.cap_growths")
         self.planned = self._replan(new_cap)
         return True
 
@@ -503,14 +533,15 @@ class PatternQueryRuntime:
             key_idx = jax.numpy.asarray(np.zeros((1,), np.int32))
         pstate, sel_state = self.state
         now_d = jax.numpy.asarray(now, jax.numpy.int64)
-        if ts_wire is not None:
-            pstate, sel_state, out, wake = p.steps_w[stream_id](
-                pstate, sel_state, raw_cols, ts_wire[0], ts_wire[1],
-                sel_d, key_idx, now_d, self._in_tabs())
-        else:
-            pstate, sel_state, out, wake = p.steps[stream_id](
-                pstate, sel_state, raw_cols, raw_ts, sel_d, key_idx,
-                now_d, self._in_tabs())
+        with _maybe_span("step", query=self.name, kind="pattern"):
+            if ts_wire is not None:
+                pstate, sel_state, out, wake = p.steps_w[stream_id](
+                    pstate, sel_state, raw_cols, ts_wire[0], ts_wire[1],
+                    sel_d, key_idx, now_d, self._in_tabs())
+            else:
+                pstate, sel_state, out, wake = p.steps[stream_id](
+                    pstate, sel_state, raw_cols, raw_ts, sel_d, key_idx,
+                    now_d, self._in_tabs())
         self.state = (pstate, sel_state)
         _emit_output(self, out, now, wake=self._wake_arg(wake))
 
@@ -788,6 +819,17 @@ class _LazyBatchPayload(dict):
 
 
 def _emit_output_sync(qr, out, now: int, header=None) -> None:
+    """Emission with an `emit` span when a DETAIL pipeline trace is active
+    on this thread (sync/pipeline deliveries; drainer-thread deliveries
+    fall outside the dispatch trace by design — see observability/
+    tracing.py)."""
+    if _tracing.active() is None:
+        return _emit_output_sync_impl(qr, out, now, header)
+    with _tracing.span("emit", query=qr.name):
+        return _emit_output_sync_impl(qr, out, now, header)
+
+
+def _emit_output_sync_impl(qr, out, now: int, header=None) -> None:
     """Shared output emission: fan out to columnar batch callbacks first
     (zero-transfer for counting consumers — the device-computed count
     scalars ride the header fetch), then unpack to host events only if
@@ -829,6 +871,11 @@ def _emit_output_sync(qr, out, now: int, header=None) -> None:
         else:
             nv, ncur = int(h0), None
         if nd:
+            # dropped-row counter BEFORE the growth attempt: even when the
+            # cap grows for the next batch, THIS batch lost nd rows
+            _st = qr.app.stats
+            if _st.enabled:
+                _st.counter_inc(f"{qr.name}.dropped", nd)
             what = ("join result rows exceeded the emission"
                     if getattr(qr.planned, "mixed_kinds", False)
                     else "pattern match rows exceeded the per-key emission")
@@ -1040,6 +1087,10 @@ class JoinQueryRuntime:
             "%s: %d join result rows dropped at emission capacity; growing "
             "the cap to %d (set @emit(rows='N') to pre-size and silence "
             "this)", self.name, n_dropped, new_rows)
+        # operator-visible counter (see PatternQueryRuntime._grow_emission_cap)
+        stats = self.app.stats
+        if stats.enabled:
+            stats.counter_inc(f"{self.name}.cap_growths")
         old = self.planned
         newp = self._replan(new_rows)
         # group allocators hold live host slot maps — carry them over,
@@ -1103,11 +1154,12 @@ class JoinQueryRuntime:
         else:
             gslot = np.zeros((staged.ts.shape[0],), np.int32)
         batch = staged.to_device(side.schema)
-        self.state, out, wake = step(
-            self.state, batch.ts, batch.kind, batch.valid, batch.cols,
-            jax.numpy.asarray(gslot),
-            self._other_table(is_left),
-            jax.numpy.asarray(now, jax.numpy.int64))
+        with _maybe_span("step", query=self.name, kind="join"):
+            self.state, out, wake = step(
+                self.state, batch.ts, batch.kind, batch.valid, batch.cols,
+                jax.numpy.asarray(gslot),
+                self._other_table(is_left),
+                jax.numpy.asarray(now, jax.numpy.int64))
         _emit_output(self, out, now,
                      wake=wake if p.needs_timer else None)
 
@@ -1211,7 +1263,7 @@ class NamedWindowRuntime:
         # (_other_table) without holding _qlock through their own step —
         # donation would let a concurrent ingest delete the buffers a
         # join just captured
-        self._step = jit_step(step)
+        self._step = jit_step(step, owner=f"window:{wdef.id}")
         self.state = jax.tree.map(
             lambda x: jax.numpy.array(x, copy=True), wproc.init_state())
 
@@ -1368,56 +1420,105 @@ class StreamJunction:
     def subscribe_callback(self, cb: Callable) -> None:
         self.stream_callbacks.append(cb)
 
-    def dispatch_staged(self, staged: ev.StagedBatch, now: int) -> None:
-        """Run every subscribed query over a staged batch, serialized per
-        QUERY (not per app) so queries on different streams — or workers of
-        different streams — process concurrently."""
-        stats = self.app.stats if self.app is not None else None
-        if stats is not None and stats.detail:
-            # reference: log4j TRACE at StreamJunction.sendEvent :147
-            _trace_log.debug("junction %s: dispatching %d staged rows to "
-                             "%d queries @ %d", self.stream_id, staged.n,
-                             len(self.queries), now)
-        for q in self.queries:
-            lk = _sub_lock(q)
-            try:
+    def _dispatch_one(self, q, staged: ev.StagedBatch, now: int,
+                      stats, n: int, traced: bool) -> None:
+        """One subscriber's processing, with per-query latency histogram
+        and (at DETAIL with an active trace) a per-query span."""
+        lk = _sub_lock(q)
+        if stats is None:
+            if lk is not None:
+                with _query_lock(lk, self.stream_id):
+                    q.process_staged(staged, now)
+            else:
+                q.process_staged(staged, now)
+            return
+        qname = _sub_name(q, self.stream_id)
+        t0 = time.perf_counter_ns()
+        try:
+            with (_tracing.span("query", query=qname) if traced
+                  else _NULL_CM):
                 if lk is not None:
                     with _query_lock(lk, self.stream_id):
                         q.process_staged(staged, now)
                 else:
                     q.process_staged(staged, now)
-            except Exception as exc:  # noqa: BLE001 — fault routing
-                self._handle_error_staged(staged, exc, now)
+        finally:
+            stats.query_latency(qname, n, time.perf_counter_ns() - t0)
+
+    def dispatch_staged(self, staged: ev.StagedBatch, now: int) -> None:
+        """Run every subscribed query over a staged batch, serialized per
+        QUERY (not per app) so queries on different streams — or workers of
+        different streams — process concurrently."""
+        stats = self.app.stats if self.app is not None else None
+        if stats is None or not stats.enabled:
+            for q in self.queries:
+                try:
+                    self._dispatch_one(q, staged, now, None, 0, False)
+                except Exception as exc:  # noqa: BLE001 — fault routing
+                    self._handle_error_staged(staged, exc, now)
+            return
+        stats.stream_in(self.stream_id, staged.n)
+        tr = stats.tracer.start(self.stream_id, staged.n) \
+            if stats.detail else None
+        if stats.detail:
+            # reference: log4j TRACE at StreamJunction.sendEvent :147
+            _trace_log.debug("junction %s: dispatching %d staged rows to "
+                             "%d queries @ %d", self.stream_id, staged.n,
+                             len(self.queries), now)
+        j0 = time.perf_counter_ns()
+        try:
+            for q in self.queries:
+                try:
+                    self._dispatch_one(q, staged, now, stats, staged.n,
+                                       tr is not None)
+                except Exception as exc:  # noqa: BLE001 — fault routing
+                    self._handle_error_staged(staged, exc, now)
+        finally:
+            stats.junction_latency(self.stream_id,
+                                   time.perf_counter_ns() - j0)
+            if tr is not None:
+                stats.tracer.finish(tr)
 
     def publish(self, events: List[ev.Event], now: int) -> None:
         stats = self.app.stats if self.app is not None else None
-        if stats is not None and stats.enabled:
-            stats.stream_in(self.stream_id, len(events))
-            if stats.detail:
-                # reference: log4j TRACE at StreamJunction.sendEvent :147
-                _trace_log.debug(
-                    "junction %s: dispatching %d events to %d queries @ %d",
-                    self.stream_id, len(events), len(self.queries), now)
-        for cb in self.stream_callbacks:
-            cb(events)
-        if self.queries:
-            staged = ev.pack_np(self.schema, events)
-            for q in self.queries:
-                lk = _sub_lock(q)
-                try:
-                    if stats is not None and stats.detail:
-                        t0 = time.perf_counter_ns()
-                    if lk is not None:
-                        with _query_lock(lk, self.stream_id):
-                            q.process_staged(staged, now)
-                    else:
-                        q.process_staged(staged, now)
-                    if stats is not None and stats.detail:
-                        stats.query_latency(
-                            getattr(q, "name", self.stream_id), len(events),
-                            time.perf_counter_ns() - t0)
-                except Exception as exc:  # noqa: BLE001 — fault routing
-                    self._handle_error(events, exc, now)
+        if stats is None or not stats.enabled:
+            for cb in self.stream_callbacks:
+                cb(events)
+            if self.queries:
+                staged = ev.pack_np(self.schema, events)
+                for q in self.queries:
+                    try:
+                        self._dispatch_one(q, staged, now, None, 0, False)
+                    except Exception as exc:  # noqa: BLE001 — fault route
+                        self._handle_error(events, exc, now)
+            return
+        stats.stream_in(self.stream_id, len(events))
+        tr = stats.tracer.start(self.stream_id, len(events)) \
+            if stats.detail else None
+        if stats.detail:
+            # reference: log4j TRACE at StreamJunction.sendEvent :147
+            _trace_log.debug(
+                "junction %s: dispatching %d events to %d queries @ %d",
+                self.stream_id, len(events), len(self.queries), now)
+        j0 = time.perf_counter_ns()
+        try:
+            for cb in self.stream_callbacks:
+                cb(events)
+            if self.queries:
+                with (_tracing.span("ingest", stream=self.stream_id)
+                      if tr is not None else _NULL_CM):
+                    staged = ev.pack_np(self.schema, events)
+                for q in self.queries:
+                    try:
+                        self._dispatch_one(q, staged, now, stats,
+                                           len(events), tr is not None)
+                    except Exception as exc:  # noqa: BLE001 — fault route
+                        self._handle_error(events, exc, now)
+        finally:
+            stats.junction_latency(self.stream_id,
+                                   time.perf_counter_ns() - j0)
+            if tr is not None:
+                stats.tracer.finish(tr)
 
     def _handle_error(self, events, exc: Exception, now: int) -> None:
         import logging
@@ -1702,6 +1803,11 @@ class _EmissionDrainer:
 
     def flush(self):
         self._q.join()
+
+    def pending(self) -> int:
+        """Outputs accepted but not yet delivered (public accessor for the
+        buffered-emissions metric; safe on a never-started drainer)."""
+        return self._q.unfinished_tasks
 
     def stop(self):
         if self._started:
@@ -2862,6 +2968,38 @@ class SiddhiAppRuntime:
     def statistics(self) -> Dict:
         """Metric report (reference: SiddhiStatisticsManager)."""
         return self.stats.report(self)
+
+    def buffered_emissions(self) -> int:
+        """Device outputs queued in the async emission drainer (public
+        accessor — reference: SiddhiBufferedEventsMetric).  Returns 0 on a
+        stopped or mid-teardown app instead of raising."""
+        d = getattr(self, "_drainer", None)
+        if d is None:
+            return 0
+        try:
+            return d.pending()
+        except Exception:  # noqa: BLE001 — metrics must not throw
+            return 0
+
+    def buffered_ingress(self) -> Dict[str, int]:
+        """Batches pending in @async ingress queues, per stream (only
+        streams with a non-zero backlog).  Safe mid-shutdown: a junction
+        whose queue was already torn down reports nothing."""
+        out: Dict[str, int] = {}
+        for sid, j in list(self.junctions.items()):
+            try:
+                n = j.pending_async()
+            except Exception:  # noqa: BLE001 — metrics must not throw
+                n = 0
+            if n > 0:
+                out[sid] = n
+        return out
+
+    def trace_dump(self, query: Optional[str] = None,
+                   limit: int = 64) -> List[Dict]:
+        """Recent DETAIL-level batch traces, newest first, optionally only
+        those that touched `query` (see observability/tracing.py)."""
+        return self.stats.tracer.dump(query, limit)
 
     def set_statistics_level(self, level: str) -> None:
         self.stats.level = level.upper()

@@ -25,6 +25,24 @@ import functools
 
 import jax
 
+# jax moved shard_map from jax.experimental to the top level; support both
+# so the mesh paths run on every jaxlib this repo meets (the container
+# bakes 0.4.x, newer deployments ship it at jax.shard_map)
+try:
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover - version-dependent
+    from jax.experimental.shard_map import shard_map  # noqa: F401
+
+# lax.pcast (replicated<->varying annotation cast inside shard_map) is a
+# newer-jax API; it is data-identity, and 0.4.x's shard_map rep-inference
+# handles replicated/varying mixing on its own, so identity is the correct
+# fallback
+try:
+    pcast = jax.lax.pcast
+except AttributeError:  # pragma: no cover - version-dependent
+    def pcast(x, axes, to="varying"):
+        return x
+
 
 def _strong_leaf(x):
     if isinstance(x, (bool, int, float, complex)):
@@ -45,13 +63,29 @@ def strongify(tree):
     return jax.tree.map(_strong_leaf, tree)
 
 
-def jit_step(fn, **jit_kwargs):
+def jit_step(fn, owner=None, **jit_kwargs):
     """`jax.jit` with compile-signature-stable outputs: every returned
     leaf is strong-typed, so feeding returned state back into the step
-    can never re-trace.  Drop-in for `jax.jit(fn, donate_argnums=...)`."""
+    can never re-trace.  Drop-in for `jax.jit(fn, donate_argnums=...)`.
+
+    `owner` labels this step for recompile accounting: the wrapped body
+    only executes while jax is TRACING a new signature, so recording there
+    counts exactly the compile events — with the triggering abstract
+    shapes — at zero steady-state cost (observability/recompile.py).  A
+    DETAIL-level pipeline trace active on the tracing thread additionally
+    gets a `compile` span, making a recompile-stalled batch self-evident
+    in its trace dump."""
+    from ..observability.recompile import RECOMPILES
+    from ..observability import tracing
+    label = owner or getattr(fn, "__qualname__", None) or "step"
 
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
-        return strongify(fn(*args, **kwargs))
+        RECOMPILES.record(label, args)
+        tr = tracing.active()
+        if tr is None:
+            return strongify(fn(*args, **kwargs))
+        with tracing.span("compile", owner=label):
+            return strongify(fn(*args, **kwargs))
 
     return jax.jit(wrapped, **jit_kwargs)

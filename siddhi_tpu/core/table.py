@@ -96,8 +96,11 @@ class TableRuntime:
         self._append_ptr = 0  # non-keyed append position (host-tracked)
         self._free_rows: List[int] = []
 
-        self._jit_write = jit_step(self._write_impl, donate_argnums=(0, 1, 2))
+        self._jit_write = jit_step(self._write_impl,
+                                   owner=f"table:{definition.id}",
+                                   donate_argnums=(0, 1, 2))
         self._jit_masked_delete = jit_step(self._masked_delete_impl,
+                                          owner=f"table:{definition.id}",
                                           donate_argnums=(0,))
 
     # -- row-slot resolution ---------------------------------------------------

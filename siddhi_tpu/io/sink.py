@@ -5,6 +5,7 @@ InMemorySink.java:115, distributed/RoundRobin:99 + Partitioned:111).
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Optional
 
 from ..core import event as ev
@@ -194,6 +195,23 @@ class SinkRuntime:
 
     # StreamCallback entry
     def __call__(self, events: List[ev.Event]) -> None:
+        stats = self.app.stats
+        if not stats.enabled:
+            self._flush(events)
+            return
+        from ..observability import tracing as _tracing
+        t0 = time.perf_counter_ns()
+        try:
+            if _tracing.active() is not None:
+                with _tracing.span("sink", stream=self.stream_id,
+                                   events=len(events)):
+                    self._flush(events)
+            else:
+                self._flush(events)
+        finally:
+            stats.sink_latency(self.stream_id, time.perf_counter_ns() - t0)
+
+    def _flush(self, events: List[ev.Event]) -> None:
         payloads = self.mapper.map(events)
         if self.strategy is None or len(self.sinks) == 1:
             for p in payloads:

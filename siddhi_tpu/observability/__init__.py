@@ -1,0 +1,33 @@
+"""Observability layer: latency histograms, pipeline tracing, JIT/recompile
+accounting, and Prometheus text exposition.
+
+Reference (what): the reference engine ships a Dropwizard-metrics statistics
+subsystem (throughput/latency/memory/buffered-event gauges, runtime-
+switchable OFF/BASIC/DETAIL — SiddhiAppRuntimeImpl.setStatisticsLevel
+:859-895) plus log4j TRACE-level event tracing.
+
+TPU design (how): a JAX/XLA deployment has two failure modes the reference
+never had — *tail latency* dominated by device dispatch + tunnel roundtrips,
+and *silent XLA recompilation* (a re-trace stalls a query for seconds on CPU
+and minutes through a remote TPU tunnel).  This package therefore records
+
+- fixed-bucket log2 latency **histograms** (p50/p95/p99/max) instead of
+  avg/max scalars (`histogram.py`),
+- per-batch **pipeline traces** with per-stage spans in a ring buffer
+  (`tracing.py`),
+- per-query **recompile counters** with the triggering abstract shapes,
+  hooked into `steputil.jit_step` (`recompile.py`),
+- **Prometheus text exposition** of all of the above (`exposition.py`).
+
+Everything is allocation-free on the hot path when statistics are OFF: each
+hook sits behind a single `enabled`/`active()` check.
+"""
+from .histogram import LogHistogram                       # noqa: F401
+from .recompile import RECOMPILES, RecompileRegistry      # noqa: F401
+from .tracing import PipelineTracer, active, span         # noqa: F401
+from .exposition import render_prometheus                 # noqa: F401
+
+__all__ = [
+    "LogHistogram", "PipelineTracer", "RECOMPILES", "RecompileRegistry",
+    "active", "span", "render_prometheus",
+]

@@ -1,0 +1,129 @@
+"""Prometheus text-format exposition (version 0.0.4) of the statistics
+registry.
+
+Reference (what): the reference exposes Dropwizard metrics through its
+reporter SPI (console/JMX); operators bridge to Prometheus externally.
+TPU design (how): render the text format directly — no dependency, one
+pass over the registries, and the scrape never touches the device (no
+`device_get`, no pytree walks), so a Prometheus poll can never stall a
+query step or pay a tunnel roundtrip.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .histogram import LogHistogram
+
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _labels(**kv) -> str:
+    inner = ",".join(f'{k}="{_esc(v)}"' for k, v in kv.items()
+                     if v is not None)
+    return "{" + inner + "}" if inner else ""
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return repr(f) if f != int(f) else str(int(f))
+
+
+class _Family:
+    def __init__(self, lines: List[str], name: str, kind: str, help_: str):
+        self.lines = lines
+        self.name = name
+        self._opened = False
+        self._kind = kind
+        self._help = help_
+
+    def _open(self) -> None:
+        if not self._opened:
+            self._opened = True
+            self.lines.append(f"# HELP {self.name} {self._help}")
+            self.lines.append(f"# TYPE {self.name} {self._kind}")
+
+    def sample(self, value, suffix: str = "", **labels) -> None:
+        self._open()
+        self.lines.append(
+            f"{self.name}{suffix}{_labels(**labels)} {_fmt(value)}")
+
+    def histogram(self, h: LogHistogram, **labels) -> None:
+        """Cumulative le-buckets + _sum + _count for one labelled series."""
+        self._open()
+        for le, cum in h.buckets_seconds():
+            self.sample(cum, "_bucket", **dict(labels, le=_fmt_le(le)))
+        self.sample(h.total, "_bucket", **dict(labels, le="+Inf"))
+        self.sample(h.sum_ns / 1e9, "_sum", **labels)
+        self.sample(h.total, "_count", **labels)
+
+
+def _fmt_le(le: float) -> str:
+    return f"{le:.9g}"
+
+
+def render_prometheus(runtimes: Dict) -> str:
+    """Render every app's metrics in one exposition payload.  `runtimes`
+    maps app name -> SiddhiAppRuntime (the manager's `runtimes` dict)."""
+    lines: List[str] = []
+
+    def fam(name, kind, help_):
+        return _Family(lines, name, kind, help_)
+
+    uptime = fam("siddhi_uptime_seconds", "gauge",
+                 "Seconds since the app's statistics epoch")
+    level = fam("siddhi_statistics_level", "gauge",
+                "Statistics level (0=OFF, 1=BASIC, 2=DETAIL)")
+    s_in = fam("siddhi_stream_events_total", "counter",
+               "Events received per stream")
+    q_ev = fam("siddhi_query_events_total", "counter",
+               "Events processed per query")
+    q_lat = fam("siddhi_query_latency_seconds", "histogram",
+                "Per-query processing latency")
+    j_lat = fam("siddhi_junction_dispatch_seconds", "histogram",
+                "Per-junction-hop dispatch latency (all subscribers)")
+    k_lat = fam("siddhi_sink_flush_seconds", "histogram",
+                "Per-sink-flush publish latency")
+    recomp = fam("siddhi_query_recompiles_total", "counter",
+                 "XLA trace/compile events per query step owner")
+    ctr = fam("siddhi_events_dropped_total", "counter",
+              "Output rows dropped at emission capacity, per query")
+    grow = fam("siddhi_emission_cap_growths_total", "counter",
+               "Adaptive emission-cap growths (each one recompiles), "
+               "per query")
+    buf_e = fam("siddhi_buffered_emissions", "gauge",
+                "Device outputs queued in the async emission drainer")
+    buf_i = fam("siddhi_buffered_ingress_events", "gauge",
+                "Batches pending in @async ingress queues, per stream")
+
+    for app_name, rt in sorted(runtimes.items()):
+        st = rt.stats
+        snap = st.exposition_snapshot()
+        uptime.sample(snap["uptime_s"], app=app_name)
+        level.sample({"OFF": 0, "BASIC": 1, "DETAIL": 2}.get(st.level, 0),
+                     app=app_name)
+        for sid, n in sorted(snap["stream_in"].items()):
+            s_in.sample(n, app=app_name, stream=sid)
+        for q, n in sorted(snap["query_events"].items()):
+            q_ev.sample(n, app=app_name, query=q)
+        for q, h in sorted(snap["query_hist"].items()):
+            q_lat.histogram(h, app=app_name, query=q)
+        for sid, h in sorted(snap["junction_hist"].items()):
+            j_lat.histogram(h, app=app_name, stream=sid)
+        for sid, h in sorted(snap["sink_hist"].items()):
+            k_lat.histogram(h, app=app_name, sink=sid)
+        for owner, info in sorted(st.recompiles(rt).items()):
+            recomp.sample(info["count"], app=app_name, query=owner)
+        for name, n in sorted(snap["counters"].items()):
+            if name.endswith(".dropped"):
+                ctr.sample(n, app=app_name, query=name[:-len(".dropped")])
+            elif name.endswith(".cap_growths"):
+                grow.sample(n, app=app_name,
+                            query=name[:-len(".cap_growths")])
+        buf_e.sample(rt.buffered_emissions(), app=app_name)
+        for sid, n in sorted(rt.buffered_ingress().items()):
+            buf_i.sample(n, app=app_name, stream=sid)
+
+    return "\n".join(lines) + ("\n" if lines else "")

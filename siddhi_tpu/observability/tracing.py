@@ -1,0 +1,153 @@
+"""Per-batch pipeline tracing with a ring-buffer trace store.
+
+Reference (what): the reference's DETAIL statistics level enables log4j
+TRACE lines at StreamJunction.sendEvent :147 and QuerySelector.process :77
+— per-event breadcrumbs scattered through the log.  TPU design (how): our
+unit of work is a micro-batch flowing ingest -> junction -> query step ->
+(window/join/pattern) -> rate-limit -> sink; a slow batch needs a stage-by-
+stage explanation, not interleaved log lines.  Each dispatched batch gets a
+`BatchTrace` (trace id, stream, event count, per-stage spans); finished
+traces land in a bounded ring buffer and are dumped via
+`SiddhiAppRuntime.trace_dump()` / `GET /trace/<query>`.
+
+The active trace is a module-level thread-local so deep layers (rate
+limiters, sinks, the jitted-step wrappers) can attach spans without any
+plumbing; a batch handed to another thread (@async / drainer) simply stops
+collecting spans there — the dispatch-side stages are the ones that explain
+latency, and cross-thread handoff would need locking on the hot path.
+Everything is a no-op (one thread-local read) when no trace is active.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+_tls = threading.local()
+_ids = itertools.count(1)
+
+
+class Span:
+    __slots__ = ("stage", "start_ns", "end_ns", "meta")
+
+    def __init__(self, stage: str, start_ns: int, end_ns: int, meta: Dict):
+        self.stage = stage
+        self.start_ns = start_ns
+        self.end_ns = end_ns
+        self.meta = meta
+
+    def to_dict(self) -> Dict:
+        d = {"stage": self.stage,
+             "duration_us": (self.end_ns - self.start_ns) / 1e3,
+             "offset_us": None}  # filled by BatchTrace.to_dict
+        d.update(self.meta)
+        return d
+
+
+class BatchTrace:
+    __slots__ = ("trace_id", "stream_id", "n_events", "wall_ms",
+                 "start_ns", "end_ns", "spans")
+
+    def __init__(self, stream_id: str, n_events: int):
+        self.trace_id = next(_ids)
+        self.stream_id = stream_id
+        self.n_events = n_events
+        self.wall_ms = int(time.time() * 1000)
+        self.start_ns = time.perf_counter_ns()
+        self.end_ns = self.start_ns
+        self.spans: List[Span] = []
+
+    def add_span(self, stage: str, start_ns: int, end_ns: int,
+                 meta: Dict) -> None:
+        self.spans.append(Span(stage, start_ns, end_ns, meta))
+
+    def queries(self) -> List[str]:
+        return sorted({s.meta["query"] for s in self.spans
+                       if "query" in s.meta})
+
+    def to_dict(self) -> Dict:
+        spans = []
+        for s in self.spans:
+            d = s.to_dict()
+            d["offset_us"] = (s.start_ns - self.start_ns) / 1e3
+            spans.append(d)
+        return {
+            "trace_id": self.trace_id,
+            "stream": self.stream_id,
+            "events": self.n_events,
+            "wall_ms": self.wall_ms,
+            "total_us": (self.end_ns - self.start_ns) / 1e3,
+            "spans": spans,
+        }
+
+
+def active() -> Optional[BatchTrace]:
+    """The thread's in-flight trace, or None.  THE hot-path guard: callers
+    must check this before building span context managers."""
+    return getattr(_tls, "trace", None)
+
+
+@contextlib.contextmanager
+def span(stage: str, **meta):
+    """Record one stage span on the active trace (no-op without one).
+    Callers on latency-sensitive paths should guard with `active()` first
+    so the generator isn't even created at OFF/BASIC."""
+    tr = getattr(_tls, "trace", None)
+    if tr is None:
+        yield
+        return
+    t0 = time.perf_counter_ns()
+    try:
+        yield
+    finally:
+        tr.add_span(stage, t0, time.perf_counter_ns(), meta)
+
+
+class PipelineTracer:
+    """Owns the ring buffer and the start/finish lifecycle.  One per
+    StatisticsManager (i.e. per app runtime)."""
+
+    def __init__(self, capacity: int = 256):
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+
+    def start(self, stream_id: str, n_events: int) -> Optional[BatchTrace]:
+        """Begin tracing the batch being dispatched on this thread.  Nested
+        dispatch (a query emitting into a downstream stream) keeps the
+        OUTER trace: the inner hop shows up as spans on it, which is
+        exactly the stage-by-stage story a slow batch needs."""
+        if getattr(_tls, "trace", None) is not None:
+            return None
+        tr = BatchTrace(stream_id, n_events)
+        _tls.trace = tr
+        return tr
+
+    def finish(self, tr: Optional[BatchTrace]) -> None:
+        if tr is None:      # nested dispatch: outer owner finishes it
+            return
+        _tls.trace = None
+        tr.end_ns = time.perf_counter_ns()
+        with self._lock:
+            self._ring.append(tr)
+
+    def dump(self, query: Optional[str] = None,
+             limit: int = 64) -> List[Dict]:
+        """Newest-first trace dicts, optionally only those that touched
+        `query` (matched against span `query=` metadata)."""
+        with self._lock:
+            traces = list(self._ring)
+        out = []
+        for tr in reversed(traces):
+            if query is not None and query not in tr.queries():
+                continue
+            out.append(tr.to_dict())
+            if len(out) >= limit:
+                break
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()

@@ -14,6 +14,14 @@ Endpoints (JSON in/out):
                                                   "timestamp": opt}
   POST   /query              body={"app": name, "query": on-demand QL}
   GET    /siddhi-apps/<name>/statistics     -> metrics report
+  GET    /metrics                           -> Prometheus text exposition
+                                               (all apps; latency histogram
+                                               buckets, throughput counters,
+                                               recompile counts)
+  GET    /trace/<query>                     -> recent DETAIL-level pipeline
+                                               traces touching <query>
+                                               (searched across apps)
+  GET    /siddhi-apps/<name>/trace/<query>  -> same, one app
   GET    /health                            -> {"status": "ok"}
 """
 from __future__ import annotations
@@ -52,11 +60,33 @@ class SiddhiRestService:
                 n = int(self.headers.get("Content-Length", 0))
                 return self.rfile.read(n)
 
+            def _text(self, code: int, body: str, ctype: str) -> None:
+                raw = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(raw)))
+                self.end_headers()
+                self.wfile.write(raw)
+
             def do_GET(self):
                 try:
                     parts = [p for p in self.path.split("/") if p]
                     if parts == ["health"]:
                         self._json(200, {"status": "ok"})
+                    elif parts == ["metrics"]:
+                        # Prometheus scrape endpoint (text format 0.0.4);
+                        # never touches the device — see observability/
+                        # exposition.py
+                        from .observability import render_prometheus
+                        self._text(
+                            200, render_prometheus(svc.manager.runtimes),
+                            "text/plain; version=0.0.4; charset=utf-8")
+                    elif len(parts) == 2 and parts[0] == "trace":
+                        traces = []
+                        for rt in svc.manager.runtimes.values():
+                            traces.extend(rt.trace_dump(parts[1]))
+                        self._json(200, {"query": parts[1],
+                                         "traces": traces})
                     elif parts == ["siddhi-apps"]:
                         self._json(200, {
                             "apps": sorted(svc.manager.runtimes)})
@@ -67,6 +97,15 @@ class SiddhiRestService:
                             self._json(404, {"error": "no such app"})
                         else:
                             self._json(200, rt.statistics())
+                    elif len(parts) == 4 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "trace":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                        else:
+                            self._json(200, {
+                                "query": parts[3],
+                                "traces": rt.trace_dump(parts[3])})
                     else:
                         self._json(404, {"error": "unknown path"})
                 except Exception as exc:  # noqa: BLE001 — HTTP boundary

@@ -2,12 +2,25 @@
 metrics in the reference; here a dependency-free registry with the same
 metric roles: throughput per stream, latency per query, memory, buffered
 events.  Levels OFF/BASIC/DETAIL, runtime-switchable as in
-SiddhiAppRuntimeImpl.setStatisticsLevel :859-895)."""
+SiddhiAppRuntimeImpl.setStatisticsLevel :859-895).
+
+TPU additions beyond the reference's scalar gauges (see observability/):
+per-query/junction/sink log2 latency HISTOGRAMS (p50/p95/p99/max — tail
+latency is the TPU story, averages hide recompile stalls), per-query XLA
+recompile counts with triggering shapes, and a DETAIL-level per-batch
+pipeline tracer.  Every hot-path hook is guarded by one `enabled` check
+and allocates nothing at OFF.
+"""
 from __future__ import annotations
 
+import sys
 import threading
 import time
-from typing import Dict
+from typing import Dict, Optional
+
+from ..observability.histogram import LogHistogram, hist_of
+from ..observability.recompile import RECOMPILES
+from ..observability.tracing import PipelineTracer
 
 OFF, BASIC, DETAIL = "OFF", "BASIC", "DETAIL"
 
@@ -22,8 +35,11 @@ class StatisticsManager:
         self._lock = threading.Lock()
         self._stream_in: Dict[str, int] = {}
         self._query_events: Dict[str, int] = {}
-        self._query_time_ns: Dict[str, int] = {}
-        self._query_max_ns: Dict[str, int] = {}
+        self._query_hist: Dict[str, LogHistogram] = {}
+        self._junction_hist: Dict[str, LogHistogram] = {}
+        self._sink_hist: Dict[str, LogHistogram] = {}
+        self._counters: Dict[str, int] = {}
+        self.tracer = PipelineTracer()
         self._start = time.time()
 
     def _included(self, path: str) -> bool:
@@ -47,12 +63,54 @@ class StatisticsManager:
                 self._stream_in.get(stream_id, 0) + n
 
     def query_latency(self, name: str, n: int, elapsed_ns: int) -> None:
+        hist_of(self._query_hist, name, self._lock).record(elapsed_ns)
         with self._lock:
             self._query_events[name] = self._query_events.get(name, 0) + n
-            self._query_time_ns[name] = \
-                self._query_time_ns.get(name, 0) + elapsed_ns
-            if elapsed_ns > self._query_max_ns.get(name, 0):
-                self._query_max_ns[name] = elapsed_ns
+
+    def junction_latency(self, stream_id: str, elapsed_ns: int) -> None:
+        hist_of(self._junction_hist, stream_id, self._lock) \
+            .record(elapsed_ns)
+
+    def sink_latency(self, sink_id: str, elapsed_ns: int) -> None:
+        hist_of(self._sink_hist, sink_id, self._lock).record(elapsed_ns)
+
+    def counter_inc(self, name: str, n: int = 1) -> None:
+        """Generic operational counter (emission drops, cap growths)."""
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    # -- recompile projection --------------------------------------------------
+    @staticmethod
+    def _owners_of(app) -> Optional[list]:
+        if app is None:
+            return None
+        owners = list(getattr(app, "query_runtimes", ()))
+        owners += [f"table:{t}" for t in getattr(app, "tables", ())]
+        owners += [f"window:{w}" for w in getattr(app, "named_windows", ())]
+        owners += [f"agg:{a}" for a in getattr(app, "aggregations", ())]
+        return owners
+
+    def recompiles(self, app=None) -> Dict:
+        """Per-owner XLA compile counts + triggering shape signatures,
+        projected to the app's queries/tables/windows/aggregations (the
+        registry is process-global — see observability/recompile.py)."""
+        return RECOMPILES.snapshot(self._owners_of(app))
+
+    # -- exposition ------------------------------------------------------------
+    def exposition_snapshot(self) -> Dict:
+        """Shallow-copied registries for the Prometheus renderer — the
+        histograms are shared read-only references (no bucket copying on
+        scrape)."""
+        with self._lock:
+            return {
+                "uptime_s": max(time.time() - self._start, 1e-9),
+                "stream_in": dict(self._stream_in),
+                "query_events": dict(self._query_events),
+                "query_hist": dict(self._query_hist),
+                "junction_hist": dict(self._junction_hist),
+                "sink_hist": dict(self._sink_hist),
+                "counters": dict(self._counters),
+            }
 
     # -- reporting -------------------------------------------------------------
     def report(self, app=None) -> Dict:
@@ -70,13 +128,31 @@ class StatisticsManager:
             for name, n in self._query_events.items():
                 if not self._included(f"queries.{name}"):
                     continue
-                t = self._query_time_ns.get(name, 0)
-                out["queries"][name] = {
-                    "events": n,
-                    "total_ms": t / 1e6,
-                    "avg_latency_us": (t / max(n, 1)) / 1e3,
-                    "max_latency_ms": self._query_max_ns.get(name, 0) / 1e6,
-                }
+                h = self._query_hist.get(name)
+                q = {"events": n}
+                if h is not None:
+                    # total/avg keys kept from the scalar era; the
+                    # quantiles are the ones that matter on TPU
+                    q["total_ms"] = h.sum_ns / 1e6
+                    q["avg_latency_us"] = h.mean_ns / 1e3
+                    q["p50_us"] = h.quantile(0.50) / 1e3
+                    q["p95_us"] = h.quantile(0.95) / 1e3
+                    q["p99_us"] = h.quantile(0.99) / 1e3
+                    q["max_latency_ms"] = h.max_ns / 1e6
+                out["queries"][name] = q
+            if self._junction_hist:
+                out["junctions"] = {
+                    sid: h.snapshot()
+                    for sid, h in self._junction_hist.items()
+                    if self._included(f"streams.{sid}")}
+            if self._sink_hist:
+                out["sinks"] = {sid: h.snapshot()
+                                for sid, h in self._sink_hist.items()}
+            if self._counters:
+                out["counters"] = dict(self._counters)
+        rec = self.recompiles(app)
+        if rec:
+            out["recompiles"] = rec
         if app is not None:
             # memory metric (reference: SiddhiMemoryUsageMetric's object-
             # graph walk — here an exact pytree byte count, per query)
@@ -95,20 +171,24 @@ class StatisticsManager:
             out["state_bytes"] = sum(mem_by_query.values())
             out["state_bytes_by_query"] = mem_by_query
             # buffered-events metric (reference: SiddhiBufferedEventsMetric)
-            out["buffered_emissions"] = app._drainer._q.qsize() \
-                if app._drainer is not None else 0
-            pend = {sid: j.pending_async()
-                    for sid, j in app.junctions.items()}
-            out["buffered_ingress"] = {
-                sid: n for sid, n in pend.items() if n > 0}
+            # via the runtime's PUBLIC accessors — a stopped/mid-teardown
+            # app reports zeros instead of raising
+            try:
+                out["buffered_emissions"] = app.buffered_emissions()
+                out["buffered_ingress"] = app.buffered_ingress()
+            except Exception:  # noqa: BLE001 — metrics must not throw
+                out.setdefault("buffered_emissions", 0)
+                out.setdefault("buffered_ingress", {})
         return out
 
     def reset(self) -> None:
         with self._lock:
             self._stream_in.clear()
             self._query_events.clear()
-            self._query_time_ns.clear()
-            self._query_max_ns.clear()
+            self._query_hist.clear()
+            self._junction_hist.clear()
+            self._sink_hist.clear()
+            self._counters.clear()
             self._start = time.time()
 
 
@@ -117,24 +197,31 @@ class ConsoleReporter:
     startReporting :55 — console reporter role).  `@app:statistics(
     reporter='console', interval='5 sec')` or start one programmatically."""
 
+    _WARN_INTERVAL_S = 30.0
+
     def __init__(self, app, interval_s: float = 5.0, out=None):
         self.app = app
         self.interval_s = interval_s
         self.out = out              # callable(line) or None -> print
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
+        self._thread: Optional[threading.Thread] = None
+        self._last_warn = 0.0
 
     def start(self) -> "ConsoleReporter":
-        self._stop.clear()            # restartable after stop()
+        if self._thread is not None and self._thread.is_alive():
+            return self                   # already running: idempotent
+        self._stop.clear()                # restartable after stop()
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="siddhi-stats-report")
         self._thread.start()
         return self
 
     def stop(self) -> None:
+        """Idempotent; safe before start() and on repeat calls."""
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=2.0)
+        t, self._thread = self._thread, None
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
 
     def _run(self) -> None:
         import json
@@ -145,5 +232,12 @@ class ConsoleReporter:
                     self.out(line)
                 else:
                     print(f"[siddhi-stats] {line}", flush=True)
-            except Exception:  # noqa: BLE001 — reporter must not die
-                pass
+            except Exception as exc:  # noqa: BLE001 — reporter must not die
+                # rate-limited warning instead of a silent swallow: a
+                # reporter that dies quietly looks like a healthy app with
+                # frozen metrics
+                now = time.monotonic()
+                if now - self._last_warn >= self._WARN_INTERVAL_S:
+                    self._last_warn = now
+                    print(f"[siddhi-stats] report failed: {exc!r}",
+                          file=sys.stderr, flush=True)
