@@ -2,9 +2,9 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test lint dryrun bench metrics-smoke all
+.PHONY: test lint dryrun bench metrics-smoke fuse-smoke all
 
-all: lint test dryrun metrics-smoke
+all: lint test dryrun metrics-smoke fuse-smoke
 
 lint:
 	$(PY) -m compileall -q siddhi_tpu tests samples
@@ -22,3 +22,9 @@ bench:
 # asserts the required metric families are present (observability layer)
 metrics-smoke:
 	$(CPU_ENV) $(PY) samples/metrics_smoke.py
+
+# fused-vs-sequential parity + throughput check on CPU (<60 s): identical
+# workloads run with and without @fuse(batches=K); fails on any emission
+# mismatch (scan-fusion layer, README "Fused stepping")
+fuse-smoke:
+	$(CPU_ENV) $(PY) samples/fuse_smoke.py

@@ -84,7 +84,16 @@ def run_tpu(async_ingest: bool = False, pipeline: bool = False):
         ts = clock[0] + np.tile(np.arange(4, dtype=np.int64), BATCH)
         h.send_columns([key_block[block], price4, vol4], timestamps=ts)
 
-    send(0)   # warmup / compile
+    # warmup / compile — a FULL sweep over the key space, not just block
+    # 0: once all slots are allocated, the LAST block's key_lo + padded
+    # Kb exceeds key_capacity, so it falls off the dense-slice fast path
+    # onto the gather/scatter step — a DIFFERENT compiled program.
+    # Warming only block 0 left that compile mid-run, which was the
+    # entire 48-533x p99/p50 tail of the CPU flagship suite (pinned
+    # round 6: one ~4.7 s XLA compile at sweep 0, block N-1 — not GC,
+    # not cap growth, not periodic flush)
+    for b in range(blocks):
+        send(b)
     rt.flush()
     warm_matches = matches[0]
     print(f"warmup done, matches={warm_matches}", file=sys.stderr)
@@ -106,6 +115,7 @@ def run_tpu(async_ingest: bool = False, pipeline: bool = False):
     print(f"tpu[{mode}]: {total} events in {dt:.2f}s -> {eps:,.0f} ev/s; "
           f"matches={matches[0]}; batch p50={stats['p50_ms']}ms "
           f"p99={stats['p99_ms']}ms", file=sys.stderr)
+    _assert_tail(f"flagship[{mode}]", stats)
     expected = SWEEPS * blocks * BATCH  # one match per key per sweep
     if matches[0] - warm_matches != expected:
         print(f"WARNING: match count {matches[0]-warm_matches} != "
@@ -159,13 +169,30 @@ def run_python_baseline(n_events=400_000):
 # JSON line under "configs" and never break it: failures report as errors.
 # ---------------------------------------------------------------------------
 
+TAIL_RATIO_MAX = 10.0   # p99/p50 above this means an unwarmed compile,
+                        # GC stall, or cap growth leaked into the timed run
+
+
 def _lat_stats(lat_s):
-    """{p50_ms, p99_ms} from a list of per-send wall times (seconds) —
+    """{p50_ms, p99_ms, tail_ratio} from per-send wall times (seconds) —
     the BASELINE metric is 'events/sec ...; p99 match latency'."""
     arr = np.sort(np.asarray(lat_s, np.float64)) * 1000.0
-    return {"p50_ms": round(float(arr[len(arr) // 2]), 2),
-            "p99_ms": round(float(arr[min(len(arr) - 1,
-                                          int(len(arr) * 0.99))]), 2)}
+    p50 = float(arr[len(arr) // 2])
+    p99 = float(arr[min(len(arr) - 1, int(len(arr) * 0.99))])
+    return {"p50_ms": round(p50, 2), "p99_ms": round(p99, 2),
+            "tail_ratio": round(p99 / max(p50, 1e-9), 2)}
+
+
+def _assert_tail(tag, stats):
+    """stderr p99/p50 assertion: a ratio above TAIL_RATIO_MAX means some
+    one-time cost (an unwarmed XLA compile signature, adaptive cap
+    growth) leaked into the timed window — pre-size/warm the bench
+    instead of averaging it away."""
+    r = stats["tail_ratio"]
+    verdict = "OK" if r <= TAIL_RATIO_MAX else "FAIL"
+    print(f"{tag}: p99/p50={r} (assert <= {TAIL_RATIO_MAX}: {verdict})",
+          file=sys.stderr)
+    return verdict == "OK"
 
 
 def _drive(ql, qname, stream, make_batch, n_batches, warmup=1,
@@ -348,6 +375,123 @@ def flagship_small_batch(B, n_sends=64):
     dt = time.perf_counter() - t0
     manager.shutdown()
     return total / dt, _lat_stats(lat)
+
+
+SEQUENCE_QL = """
+@app:playback
+define stream S (symbol long, price float, volume int);
+@capacity(keys='1', slots='8')
+@emit(rows='4096')
+{ann}
+@info(name='q')
+from every e1=S[volume == 1], e2=S[volume == 2 and price > e1.price]
+  within 1 sec
+select e1.price as p1, e2.price as p2
+insert into M;
+"""
+
+
+def _sequence_staged(B, k, interner):
+    """K staged micro-batches of the sequence_within workload (the config
+    PERF.md names as pinned at the RTT floor by construction)."""
+    from siddhi_tpu.core import event as ev
+    rng = np.random.default_rng(4)
+    items = []
+    for i in range(k):
+        ts = 1000 + i * 50 + np.arange(B, dtype=np.int64) % 50
+        cols = [np.zeros(B, np.int64),
+                rng.random(B).astype(np.float32),
+                np.tile(np.array([1, 2], np.int32), B // 2)]
+        valid = np.ones(B, np.bool_)
+        kind = np.zeros(B, np.int32)
+        items.append(("S", ev.StagedBatch(ts, kind, valid, cols, B),
+                      1000 + i * 50))
+    return items
+
+
+def run_device_loop(k=16, B=1 << 11, iters=50):
+    """--mode device_loop: tunnel-independent CHIP-SIDE events/sec.
+
+    The fused step's inputs are staged to the device ONCE; the loop then
+    re-dispatches the same [K, B] stack `iters` times with no emission
+    fetch (no consumers) and no host staging, blocking only at the end —
+    so the measured rate is the compiled query step's device throughput,
+    independent of tunnel RTT and host packing (the measurement
+    VERDICT round 6 asks for: 'prove the chip, not the tunnel')."""
+    import jax
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core import fusion
+    _probe_backend()
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(
+        SEQUENCE_QL.format(ann=f"@fuse(batches='{k}')"))
+    rt.start()
+    qr = rt.query_runtimes["q"]
+    assert qr._fuse is not None, "sequence query must be fuse-eligible"
+    items = _sequence_staged(B, k, manager.interner)
+    fn, xs, const = fusion._prepare_pattern(qr, items)
+    state = qr.state
+    t0 = time.perf_counter()
+    state, _ = fn(state, xs, const)
+    jax.block_until_ready(state)
+    compile_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, _ = fn(state, xs, const)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    qr.state = state
+    eps = iters * k * B / dt
+    print(f"device_loop: {iters} fused dispatches x {k} batches x {B} "
+          f"events in {dt:.3f}s (compile {compile_s:.1f}s)",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "device_loop_chip_events_per_sec",
+        "value": round(eps),
+        "unit": "events/sec",
+        "k": k, "batch": B, "iters": iters,
+        "dispatch_ms": round(dt / iters * 1000, 3),
+        "device": str(jax.devices()[0]),
+        "note": ("chip-side throughput of the compiled sequence step: "
+                 "device-resident [K,B] inputs, zero emission fetches — "
+                 "tunnel-independent by construction"),
+    }))
+    manager.shutdown()
+    return eps
+
+
+def run_fuse_compare(k=8, B=1 << 11, n_batches=64):
+    """--mode fuse_compare: end-to-end sequential vs @fuse(batches=K) on
+    the sequence_within workload — the per-batch dispatch-overhead
+    amortization measured through the full send path."""
+    results = {}
+    for tag, ann in (("sequential", ""),
+                     (f"fused_k{k}", f"@fuse(batches='{k}')")):
+        rng = np.random.default_rng(4)
+
+        def mk(i):
+            return ([np.zeros(B, np.int64),
+                     rng.random(B, np.float32),
+                     np.tile(np.array([1, 2], np.int32), B // 2)],
+                    {"timestamps": 1000 + i * 50 +
+                     np.arange(B, dtype=np.int64) % 50})
+        eps, count, lat = _drive(SEQUENCE_QL.format(ann=ann), "q", "S",
+                                 mk, n_batches, warmup=max(2, k))
+        results[tag] = {"value": round(eps), "unit": "events/sec",
+                        "matches": count, **lat}
+        print(f"fuse_compare[{tag}]: {eps:,.0f} ev/s "
+              f"p50={lat['p50_ms']}ms p99={lat['p99_ms']}ms",
+              file=sys.stderr)
+    base = results["sequential"]["value"]
+    fused = results[f"fused_k{k}"]["value"]
+    print(json.dumps({
+        "metric": "fuse_compare_sequence_events_per_sec",
+        "k": k, "batch": B, "n_batches": n_batches,
+        "speedup": round(fused / max(base, 1), 2),
+        "configs": results,
+    }))
+    return results
 
 
 def _enable_compile_cache():
@@ -535,4 +679,26 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", default="full",
+                    choices=["full", "device_loop", "fuse_compare"],
+                    help="full: the flagship suite (default); "
+                         "device_loop: tunnel-independent chip-side "
+                         "events/sec via fused dispatch re-execution; "
+                         "fuse_compare: end-to-end @fuse vs sequential")
+    ap.add_argument("--k", type=int, default=16,
+                    help="fused stack depth (device_loop/fuse_compare)")
+    ap.add_argument("--batch", type=int, default=1 << 11,
+                    help="events per micro-batch (device_loop/fuse_compare)")
+    ap.add_argument("--iters", type=int, default=50,
+                    help="fused dispatches to time (device_loop)")
+    args = ap.parse_args()
+    if args.mode == "device_loop":
+        _enable_compile_cache()
+        run_device_loop(args.k, args.batch, args.iters)
+    elif args.mode == "fuse_compare":
+        _enable_compile_cache()
+        run_fuse_compare(args.k, args.batch)
+    else:
+        main()

@@ -363,6 +363,32 @@ class StagedBatch:
                           jnp.asarray(self.valid), cols)
 
 
+class StackedBatch:
+    """K same-capacity staged micro-batches stacked into [K, B] host
+    arrays for ONE fused device dispatch (core/fusion.py): one
+    host->device transfer and one `lax.scan` execution replace K of
+    each.  Capacity equality is the caller's contract (the fuse buffer
+    keys its stack on the bucket size)."""
+
+    __slots__ = ("ts", "kind", "valid", "cols", "k")
+
+    def __init__(self, staged_list: Sequence["StagedBatch"]):
+        self.k = len(staged_list)
+        self.ts = np.stack([s.ts for s in staged_list])
+        self.kind = np.stack([s.kind for s in staged_list])
+        self.valid = np.stack([s.valid for s in staged_list])
+        self.cols = tuple(
+            np.stack([s.cols[j] for s in staged_list])
+            for j in range(len(staged_list[0].cols)))
+
+    def to_device(self, schema: Schema) -> EventBatch:
+        """[K, B] EventBatch (EventBatch is shape-agnostic)."""
+        cols = tuple(jnp.asarray(c).astype(d)
+                     for c, d in zip(self.cols, schema.dtypes))
+        return EventBatch(jnp.asarray(self.ts), jnp.asarray(self.kind),
+                          jnp.asarray(self.valid), cols)
+
+
 def pack_np(schema: Schema, events: Sequence[Event],
             kinds: Optional[Sequence[int]] = None,
             capacity: Optional[int] = None) -> StagedBatch:

@@ -1,0 +1,379 @@
+"""Scan-fused multi-batch stepping: K device steps per dispatch, one
+header fetch (`@fuse(batches='K')`).
+
+Reference behavior (what): none — the reference processes one event at a
+time; batching depth is a TPU-native concern.
+
+TPU design (how): PERF.md's phase breakdown shows the engine is
+host/tunnel-bound — the device does ~0.2 ms of HBM work per send while
+each send pays a fixed ~73-95 ms round-trip plus a blocking emission
+fetch.  Fused stepping stacks K staged micro-batches into [K, B]
+host arrays, ships them in ONE transfer, and runs the compiled query
+step as a `lax.scan` over the leading axis in ONE dispatch:
+partition/window/NFA state threads through the scan carry exactly as it
+threads through K sequential `jit_step` calls, emissions accumulate into
+a [K, cap] block, and a single combined [K, 2] header rides one
+`device_get`.  Per-send RTT and dispatch overhead divide by K.
+
+Semantics: a fused query's processing (and therefore its delivery,
+table writes, and downstream routing) lags up to K-1 batches until the
+stack fills or `flush()` drains it — the same relaxation `@pipeline`
+makes for delivery, extended to the step itself.  Partial stacks drain
+through the ORIGINAL sequential path, so a flush is byte-identical to
+never having fused.  Timer-bearing queries (time/cron windows, absent
+patterns) are excluded at wiring time, same rule as `@pipeline`: their
+device-computed wake scalar cannot lag.
+
+Paths fused: plain (non-keyed, non-range-partition) single-stream
+queries, non-partitioned pattern/sequence queries, and join sides —
+each wraps the plan's un-jitted step body so fused and sequential
+execution run the identical per-batch program.  Keyed-window, sharded,
+and partitioned-pattern paths fall back to sequential dispatch.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from ..observability import tracing as _tracing
+from . import event as ev
+from .steputil import fuse_step
+
+jnp = jax.numpy
+
+
+def ineligible_reason(qr, kind: str):
+    """Why this runtime cannot fuse (None = eligible).  Static properties
+    only; per-batch variation is handled by the stack signature."""
+    p = qr.planned
+    if kind == "plain":
+        if p.needs_timer:
+            return "timer-bearing window (time/cron) — wake cannot lag"
+        if p.keyed_window:
+            return "keyed-window slab path is not fused yet"
+        if p.partition_key_fn is not None:
+            return "range-partition key derivation is not fused yet"
+        if p.raw_step is None:
+            return "sharded step has no fusable body"
+        return None
+    if kind == "pattern":
+        if p.timer_step is not None:
+            return "absent pattern needs timer wakeups — wake cannot lag"
+        if p.partition_positions:
+            return "partitioned pattern grouping is not fused yet"
+        if p.mesh is not None or p.step_bodies is None:
+            return "sharded pattern step has no fusable body"
+        return None
+    if kind == "join":
+        if p.needs_timer:
+            return "timer-bearing join window — wake cannot lag"
+        if (p.step_left is not None and p.raw_left is None) or \
+                (p.step_right is not None and p.raw_right is None):
+            return "sharded join step has no fusable body"
+        return None
+    return f"unknown runtime kind {kind!r}"
+
+
+class FuseBuffer:
+    """Per-query accumulator of staged sends for fused dispatch.
+
+    All entry points run under the query lock (junction dispatch holds
+    it), so the buffer needs no lock of its own.  `offer` stacks
+    same-signature batches (same input tag + bucket capacity); a
+    signature change drains the pending stack sequentially first, so
+    cross-batch order within the query is preserved exactly.
+    """
+
+    __slots__ = ("qr", "k", "kind", "items", "sig", "bypass")
+
+    def __init__(self, qr, k: int, kind: str):
+        self.qr = qr
+        self.k = max(1, int(k))
+        self.kind = kind
+        self.items: List[Tuple] = []
+        self.sig = None
+        self.bypass = False
+
+    def offer(self, args: Tuple, staged: ev.StagedBatch, tag) -> bool:
+        """Accept a send into the stack.  Returns False when the caller
+        must run the sequential path itself (drain re-entry, or an
+        attached debugger that expects per-batch breakpoints)."""
+        if self.bypass or self.qr.app.__dict__.get("_debugger") is not None:
+            return False
+        sig = (tag, staged.ts.shape[0])
+        if self.items and sig != self.sig:
+            self.drain()
+        self.sig = sig
+        self.items.append(args)
+        if len(self.items) >= self.k:
+            self.dispatch()
+        return True
+
+    def drain(self) -> None:
+        """Deliver a partial stack through the ORIGINAL sequential path
+        (flush()/quiesce/signature change): byte-identical to never
+        having fused, at sequential cost — partial stacks are rare and a
+        scan re-trace per partial length would be a recompile per size."""
+        if not self.items:
+            return
+        items, self.items = self.items, []
+        self.bypass = True
+        try:
+            for args in items:
+                self.qr.process_staged(*args)
+        finally:
+            self.bypass = False
+
+    def dispatch(self) -> None:
+        """Run the full stack as ONE fused device dispatch."""
+        items, self.items = self.items, []
+        qr = self.qr
+        stats = qr.app.stats
+        k = len(items)
+        t0 = time.perf_counter_ns() if stats.enabled else 0
+        if _tracing.active() is None:
+            _DISPATCH[self.kind](qr, items)
+        else:
+            with _tracing.span("fused_step", query=qr.name, k=k):
+                _DISPATCH[self.kind](qr, items)
+        if stats.enabled:
+            n = sum(int(a[-2].n) for a in items)
+            stats.fused_dispatch(qr.name, k, n,
+                                 time.perf_counter_ns() - t0)
+
+
+def pending(qr) -> int:
+    """Batches held in a runtime's fuse stack (0 for unfused runtimes)."""
+    fb = getattr(qr, "_fuse", None)
+    return len(fb.items) if fb is not None else 0
+
+
+def drain(qr) -> None:
+    """Flush a runtime's partial stack (lifecycle: flush/quiesce/
+    shutdown).  Takes the query lock — the producer's offer path runs
+    under it too, so a concurrent send can never double-process."""
+    fb = getattr(qr, "_fuse", None)
+    if fb is None or not fb.items:
+        return
+    lk = getattr(qr, "_qlock", None)
+    if lk is None:
+        fb.drain()
+        return
+    with lk:
+        fb.drain()
+
+
+# ---------------------------------------------------------------------------
+# fused step compilation (one per (kind, base body); jit handles K/shape
+# specialization).  The cache holds the body so a replan (emission-cap
+# growth swaps the plan's bodies) can never alias a recycled id().
+# ---------------------------------------------------------------------------
+
+def _fused_fn(qr, kind: str, body: Callable) -> Callable:
+    cache: Dict = qr.__dict__.setdefault("_fused_cache", {})
+    key = (kind, id(body))
+    ent = cache.get(key)
+    if ent is not None and ent[0] is body:
+        return ent[1]
+    adapter = _ADAPTERS[kind](body)
+    fn = fuse_step(adapter, owner=f"fused:{qr.name}")
+    cache[key] = (body, fn)
+    return fn
+
+
+def _adapt_plain(body):
+    def fused_body(carry, x, const):
+        ts, kind, valid, cols, gslot, now, pslots = x
+        carry, out, _wake = body(carry, ts, kind, valid, cols, gslot,
+                                 now, const, pslots)
+        return carry, out
+    return fused_body
+
+
+def _adapt_pattern(body):
+    def fused_body(carry, x, const):
+        cols, ts, sel_idx, key_idx, now = x
+        pstate, sel_state, out, _wake = body(
+            carry[0], carry[1], cols, ts, sel_idx, key_idx, now, const)
+        return (pstate, sel_state), out
+    return fused_body
+
+
+def _adapt_join(body):
+    def fused_body(carry, x, const):
+        ts, kind, valid, cols, gslot, now = x
+        carry, out, _wake = body(carry, ts, kind, valid, cols, gslot,
+                                 const, now)
+        return carry, out
+    return fused_body
+
+
+_ADAPTERS = {"plain": _adapt_plain, "pattern": _adapt_pattern,
+             "join": _adapt_join}
+
+
+# ---------------------------------------------------------------------------
+# per-kind dispatch: host slot prep (in arrival order), stack, one fused
+# step, unstack + deliver
+# ---------------------------------------------------------------------------
+
+def _now_stack(items) -> jax.Array:
+    return jnp.asarray(np.asarray([a[-1] for a in items], np.int64))
+
+
+def _dispatch_plain(qr, items) -> None:
+    p = qr.planned
+    prep = [qr._slots_for_batch(staged, now) for staged, now in items]
+    stack = ev.StackedBatch([staged for staged, _ in items])
+    batch = stack.to_device(p.in_schema)
+    gslot_k = jnp.asarray(np.stack([np.asarray(g) for g, _ in prep]))
+    pslots_k = tuple(
+        jnp.asarray(np.stack([np.asarray(ps[j]) for _, ps in prep]))
+        for j in range(len(p.pair_allocs)))
+    xs = (batch.ts, batch.kind, batch.valid, batch.cols, gslot_k,
+          _now_stack(items), pslots_k)
+    const = qr.app.in_probe_tables(p.in_deps)
+    fn = _fused_fn(qr, "plain", p.raw_step)
+    qr.state, outs = fn(qr.state, xs, const)
+    _deliver_fused(qr, outs, [now for _, now in items])
+
+
+def _prepare_pattern(qr, items) -> Tuple[Callable, Tuple, Tuple]:
+    """(fused fn, stacked xs, const) for a pattern stack — also the entry
+    bench.py's device_loop mode uses to time chip-side throughput with
+    device-resident inputs and zero emission fetches."""
+    from . import runtime as _rt
+    p = qr.planned
+    stream_id = items[0][0]
+    B = items[0][1].ts.shape[0]
+    sels = []
+    for _, staged, _ in items:
+        if staged.valid.all():
+            sels.append(_rt._identity_sel(B))
+        else:
+            sels.append(np.where(staged.valid,
+                                 np.arange(B, dtype=np.int32),
+                                 -1)[None, :])
+    stack = ev.StackedBatch([staged for _, staged, _ in items])
+    # the sequential pattern path ships raw staged columns (np_dtype
+    # already matches the device dtypes) — mirror it exactly
+    cols_k = tuple(jnp.asarray(c) for c in stack.cols)
+    k = len(items)
+    xs = (cols_k, jnp.asarray(stack.ts), jnp.asarray(np.stack(sels)),
+          jnp.asarray(np.zeros((k, 1), np.int32)), _now_stack(items))
+    return (_fused_fn(qr, "pattern", p.step_bodies[stream_id]), xs,
+            qr._in_tabs())
+
+
+def _dispatch_pattern(qr, items) -> None:
+    fn, xs, const = _prepare_pattern(qr, items)
+    qr.state, outs = fn(qr.state, xs, const)
+    _deliver_fused(qr, outs, [now for _, _, now in items])
+
+
+def _dispatch_join(qr, items) -> None:
+    p = qr.planned
+    is_left = items[0][0]
+    side = p.left if is_left else p.right
+    body = p.raw_left if is_left else p.raw_right
+    gs = [qr._join_slots(is_left, staged) for _, staged, _ in items]
+    stack = ev.StackedBatch([staged for _, staged, _ in items])
+    batch = stack.to_device(side.schema)
+    xs = (batch.ts, batch.kind, batch.valid, batch.cols,
+          jnp.asarray(np.stack([np.asarray(g) for g in gs])),
+          _now_stack(items))
+    # table/aggregation other-side snapshot is taken ONCE at dispatch:
+    # under @fuse the per-batch read-your-writes of a concurrently
+    # updated table relaxes to dispatch granularity (stream other-sides
+    # live in the carry and stay exact)
+    const = qr._other_table(is_left)
+    fn = _fused_fn(qr, "join", body)
+    qr.state, outs = fn(qr.state, xs, const)
+    _deliver_fused(qr, outs, [now for _, _, now in items])
+
+
+_DISPATCH = {"plain": _dispatch_plain, "pattern": _dispatch_pattern,
+             "join": _dispatch_join}
+
+
+# ---------------------------------------------------------------------------
+# fused delivery: one [K, 2] header fetch, per-batch unstacked emission
+# ---------------------------------------------------------------------------
+
+def _deliver_fused(qr, outs, nows: List[int]) -> None:
+    """Unstack the fused [K, ...] output block and deliver each batch's
+    emission in order.
+
+    Sync mode fetches ONE combined header ([K, 2] for compacted
+    pattern/join outputs; the whole capacity-bounded block for plain
+    outputs) and feeds per-batch numpy slices through the standard
+    emission path.  @async/@pipeline compose by re-entering
+    `_emit_output` per batch — the drainer/deque already batch their
+    header fetches.  A per-batch failure (emission-cap overflow,
+    callback error) defers until every batch has been delivered, then
+    the first error propagates to the junction's fault routing."""
+    from . import runtime as _rt
+    if not _rt._has_consumers(qr):
+        return
+    K = len(nows)
+    if getattr(qr, "async_emit", False) and qr.app._drainer is not None \
+            or getattr(qr, "pipeline_emit", 0):
+        for i in range(K):
+            _rt._emit_output(qr, _slice_out(outs, i), nows[i], wake=None)
+        return
+    first_exc = None
+    if len(outs) == 6:
+        # ONE fetch for the combined [K, 2] header (join headers are
+        # [K, 2] vectors themselves; still one fetch)
+        h0, h1 = jax.device_get((outs[0], outs[1]))
+        need_rows = bool(qr.callbacks) or \
+            getattr(qr, "table_op", None) is not None or \
+            getattr(qr, "rate_limiter", None) is not None or \
+            getattr(qr.planned, "emits_uuid", False)
+        tgt = qr.planned.output_target
+        if not need_rows and tgt:
+            # mirror _emit_output_sync_impl's target-live check: a dead
+            # downstream junction must not force a bulk fetch
+            app = qr.app
+            if tgt in getattr(app, "named_windows", {}) or \
+                    tgt in getattr(app, "tables", {}):
+                need_rows = True
+            else:
+                j = app.junctions.get(tgt)
+                need_rows = j is not None and bool(
+                    j.queries or j.stream_callbacks or app.stats.enabled)
+        bulk = jax.device_get(outs[2:]) if need_rows else outs[2:]
+        for i in range(K):
+            out_i = (h0[i], h1[i], bulk[0][i], bulk[1][i], bulk[2][i],
+                     tuple(c[i] for c in bulk[3]))
+            try:
+                _rt._emit_output_sync(qr, out_i, nows[i],
+                                      header=(h0[i], h1[i]))
+            except Exception as exc:  # noqa: BLE001 — deliver the rest
+                first_exc = first_exc or exc
+    else:
+        # plain outputs are window-capacity bounded and always ship
+        # whole on the sequential path too: ONE fetch for the block
+        ots, okind, ovalid, ocols = jax.device_get(outs)
+        for i in range(K):
+            out_i = (ots[i], okind[i], ovalid[i],
+                     tuple(c[i] for c in ocols))
+            try:
+                _rt._emit_output_sync(qr, out_i, nows[i])
+            except Exception as exc:  # noqa: BLE001 — deliver the rest
+                first_exc = first_exc or exc
+    if first_exc is not None:
+        raise first_exc
+
+
+def _slice_out(outs, i: int):
+    """Per-batch device-array view of the stacked output (for @async/
+    @pipeline composition, where the fetch happens downstream)."""
+    if len(outs) == 6:
+        return (outs[0][i], outs[1][i], outs[2][i], outs[3][i],
+                outs[4][i], tuple(c[i] for c in outs[5]))
+    return (outs[0][i], outs[1][i], outs[2][i],
+            tuple(c[i] for c in outs[3]))

@@ -86,6 +86,9 @@ class PlannedJoinQuery:
     # join emissions carry CURRENT and EXPIRED rows; the runtime must not
     # assume all-current when deriving batch counts from the header
     mixed_kinds: bool = True
+    # un-jitted side bodies for @fuse(batches=K) scan fusion (core/fusion.py)
+    raw_left: Optional[Callable] = None
+    raw_right: Optional[Callable] = None
 
 
 def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
@@ -405,23 +408,30 @@ def plan_join_query(
                 (nstate[0], nstate[1], sel_state), mesh)
             return new_state, out, wout.next_wakeup
 
-        return jit_step(step, owner=name, donate_argnums=(0,))
+        return step
 
-    step_left = None
-    step_right = None
+    # raw (un-jitted) bodies are kept on the plan: @fuse(batches=K) wraps
+    # them in its lax.scan so fused execution runs the identical per-batch
+    # program (core/fusion.py)
+    step_left = raw_left = None
+    step_right = raw_right = None
     # named-window sides trigger too (bidirectional, Window.java:145-184);
     # plain table/aggregation sides stay probe-only
     if (not left.is_table or left.is_named_window) and \
             trigger in ("ALL_EVENTS", "LEFT"):
-        step_left = make_step(left, right, True)
+        raw_left = make_step(left, right, True)
     if (not right.is_table or right.is_named_window) and \
             trigger in ("ALL_EVENTS", "RIGHT"):
-        step_right = make_step(right, left, False)
+        raw_right = make_step(right, left, False)
     # non-triggering stream sides still need their window maintained
-    if not left.is_table and step_left is None:
-        step_left = _make_feed_only(left, True, mesh, owner=name)
-    if not right.is_table and step_right is None:
-        step_right = _make_feed_only(right, False, mesh, owner=name)
+    if not left.is_table and raw_left is None:
+        raw_left = _make_feed_only(left, True, mesh)
+    if not right.is_table and raw_right is None:
+        raw_right = _make_feed_only(right, False, mesh)
+    if raw_left is not None:
+        step_left = jit_step(raw_left, owner=name, donate_argnums=(0,))
+    if raw_right is not None:
+        step_right = jit_step(raw_right, owner=name, donate_argnums=(0,))
 
     def init_state():
         wl = left.window.init_state() if left.window else ()
@@ -445,11 +455,11 @@ def plan_join_query(
         needs_timer=(left.window is not None and left.window.needs_timer) or
                     (right.window is not None and right.window.needs_timer),
         emits_uuid=scope.uses_uuid,
-        compact_rows=emit_rows, emit_explicit=emit_explicit)
+        compact_rows=emit_rows, emit_explicit=emit_explicit,
+        raw_left=raw_left, raw_right=raw_right)
 
 
-def _make_feed_only(side: JoinSide, is_left: bool, mesh=None,
-                    owner=None):
+def _make_feed_only(side: JoinSide, is_left: bool, mesh=None):
     def step(state, ts, kind, valid, cols, gslot, other_table_cols, now):
         wl_state, wr_state, sel_state = state
         this_state = wl_state if is_left else wr_state
@@ -470,4 +480,4 @@ def _make_feed_only(side: JoinSide, is_left: bool, mesh=None,
         return _constrain_state(new_state, mesh), out_empty, \
             wout.next_wakeup
 
-    return jit_step(step, owner=owner, donate_argnums=(0,))
+    return step

@@ -161,6 +161,11 @@ class PlannedPatternQuery:
     # per-key emission row cap the steps compiled with (adaptive growth
     # doubles it after an implicit-cap overflow)
     compact_rows: int = 8
+    # the un-jitted bodies `steps` was built from (block bodies when the
+    # block path is active, else the scan bodies) — @fuse(batches=K) wraps
+    # THESE in its lax.scan so fused and sequential execution run the
+    # identical per-batch program (core/fusion.py); None on the mesh path
+    step_bodies: Optional[Dict[str, Callable]] = None
 
 
 def plan_pattern_query(
@@ -305,6 +310,7 @@ def plan_pattern_query(
     dense_steps = None
     steps_w = None
     dense_steps_w = None
+    step_bodies = None
     if mesh is None and partition_positions is None and \
             block_eligible(spec) and not _FORCE_SCAN:
         # single-key simple chain: the sequential E-tick scan degrades to
@@ -318,6 +324,7 @@ def plan_pattern_query(
         steps_w = {sid: jit_step(wire_ts(b), owner=name,
                                  donate_argnums=(0, 1))
                    for sid, b in block_bodies.items()}
+        step_bodies = block_bodies
     elif mesh is None:
         steps = {sid: jit_step(body, owner=name, donate_argnums=(0, 1))
                  for sid, body in raw_steps.items()}
@@ -330,6 +337,7 @@ def plan_pattern_query(
         dense_steps_w = {sid: jit_step(wire_ts(make_step(sid, dense=True)),
                                        owner=name, donate_argnums=(0, 1))
                          for sid in spec.stream_ids}
+        step_bodies = raw_steps
     else:
         steps = {sid: _shard_step(body, mesh, packer, pexec, sel,
                                   owner=name)
@@ -387,7 +395,7 @@ def plan_pattern_query(
         partition_key_fns=partition_key_fns,
         raw_steps=raw_steps, mesh=mesh, emit_explicit=emit_explicit,
         selector_exec=sel, emits_uuid=pexec.scope.uses_uuid,
-        compact_rows=compact_rows)
+        compact_rows=compact_rows, step_bodies=step_bodies)
 
 
 def _first_schema(spec: PatternSpec, schemas) -> ev.Schema:

@@ -80,6 +80,9 @@ class PlannedQuery:
     keyed_mesh: Any = None
     # UUID() appears in this query: emission materializes sentinels once
     emits_uuid: bool = False
+    # un-jitted step body for @fuse(batches=K) scan fusion (core/fusion.py);
+    # None on the keyed-window and sharded paths, which don't fuse
+    raw_step: Optional[Callable] = None
 
 
 def _env_for(scope_key: str, cols, ts):
@@ -474,6 +477,7 @@ def plan_single_query(
 
     plain_mesh = None
     keyed_mesh = None
+    raw_step = None
     if keyed_window:
         # ---- keyed window: one window state per partition key ------------
         # The window processor is a pure (state, rows, now) -> (state', out)
@@ -574,6 +578,7 @@ def plan_single_query(
         else:
             step_fn = jit_step(step, owner=name, donate_argnums=(0,))
             plain_mesh = None
+            raw_step = step
 
         def init_state():
             return (wproc.init_state(), sel.init_state())
@@ -603,4 +608,5 @@ def plan_single_query(
         mesh=plain_mesh,
         keyed_mesh=keyed_mesh,
         emits_uuid=scope.uses_uuid,
+        raw_step=raw_step,
     )

@@ -34,6 +34,7 @@ from .keyslots import SlotAllocator
 from .planner import PlannedQuery, plan_single_query
 from .window import NO_WAKEUP
 from .steputil import jit_step
+from . import fusion as _fusion
 
 _NO_WAKEUP_INT = int(NO_WAKEUP)
 
@@ -215,10 +216,31 @@ class QueryRuntime:
         # set by _PartitionPurger: fn(slots, now) recording key liveness
         self._touch = None
         self._touch_group = None
+        # @fuse(batches=K): stack buffer for scan-fused dispatch, or None
+        self._fuse = None
 
     @property
     def name(self):
         return self.planned.name
+
+    def _slots_for_batch(self, staged: ev.StagedBatch,
+                         now: int) -> Tuple[np.ndarray, Tuple]:
+        """Group/distinctCount slot resolution for a non-range-partition
+        batch (host side effects: slot binding + purger liveness touch) —
+        shared by the sequential path and fused dispatch (core/fusion.py)."""
+        p = self.planned
+        valid = staged.valid
+        if p.group_by_positions and p.slot_allocator is not None:
+            gslot = p.slot_allocator.slots_for(
+                [staged.cols[i] for i in p.group_by_positions], valid)
+        else:
+            gslot = _zero_slots(staged.ts.shape[0])
+        if self._touch is not None:
+            self._touch(gslot, now)
+        # distinctCount: (group, value) -> pair refcount slots
+        pslots = tuple(alloc.slots_for([gslot, staged.cols[pos]], valid)
+                       for alloc, pos in p.pair_allocs)
+        return gslot, pslots
 
     def process_staged(self, staged: ev.StagedBatch, now: int) -> None:
         p = self.planned
@@ -228,32 +250,29 @@ class QueryRuntime:
         if p.keyed_window:
             self._process_keyed(staged, now)
             return
-        valid = staged.valid
+        fb = self._fuse
+        if fb is not None and fb.offer((staged, now), staged, None):
+            return
         if p.partition_key_fn is not None:
             # range partition: derived key column; rows matching no range
             # are excluded from the query entirely
             kcols, kvalid = p.partition_key_fn(staged)
-            valid = valid & kvalid
+            valid = staged.valid & kvalid
             if p.slot_allocator is not None:
                 key_cols = list(kcols) + [staged.cols[i]
                                           for i in p.group_by_positions]
                 gslot = p.slot_allocator.slots_for(key_cols, valid)
             else:
-                gslot = np.zeros((staged.ts.shape[0],), np.int32)
+                gslot = _zero_slots(staged.ts.shape[0])
             staged = ev.StagedBatch(staged.ts, staged.kind, valid,
                                     staged.cols, staged.n)
-        elif p.group_by_positions and p.slot_allocator is not None:
-            key_cols = [staged.cols[i] for i in p.group_by_positions]
-            gslot = p.slot_allocator.slots_for(key_cols, valid)
+            if self._touch is not None:
+                self._touch(gslot, now)
+            pslots = tuple(alloc.slots_for([gslot, staged.cols[pos]], valid)
+                           for alloc, pos in p.pair_allocs)
         else:
-            gslot = np.zeros((staged.ts.shape[0],), np.int32)
-        if self._touch is not None:
-            self._touch(gslot, now)
-        # distinctCount: (group, value) -> pair refcount slots
-        pslots = tuple(
-            jax.numpy.asarray(alloc.slots_for(
-                [gslot, staged.cols[pos]], valid))
-            for alloc, pos in p.pair_allocs)
+            gslot, pslots = self._slots_for_batch(staged, now)
+        pslots = tuple(jax.numpy.asarray(s) for s in pslots)
         batch = staged.to_device(p.in_schema)
         in_tabs = self.app.in_probe_tables(p.in_deps)
         with _maybe_span("step", query=self.name, kind="window"):
@@ -308,7 +327,7 @@ class QueryRuntime:
                 self._touch_group(gslot, now)
         else:
             # timer ticks carry no data rows: no group slots to resolve
-            gslot = np.zeros((staged.ts.shape[0],), np.int32)
+            gslot = _zero_slots(staged.ts.shape[0])
         batch = ev.StagedBatch(staged.ts, staged.kind, valid, staged.cols,
                                staged.n).to_device(p.in_schema)
         in_tabs = self.app.in_probe_tables(p.in_deps)
@@ -374,6 +393,8 @@ class PatternQueryRuntime:
         # steady-state block memo for _grouped_slots: (k0, n) ->
         # (allocator version, key_idx, sel, keys copy)
         self._block_cache: Dict = {}
+        # @fuse(batches=K): stack buffer for scan-fused dispatch, or None
+        self._fuse = None
 
     @property
     def name(self):
@@ -451,6 +472,10 @@ class PatternQueryRuntime:
         B = staged.ts.shape[0]
         if p.partition_positions and p.mesh is not None:
             self._process_sharded(stream_id, staged, now)
+            return
+        fb = self._fuse
+        if fb is not None and fb.offer((stream_id, staged, now), staged,
+                                       stream_id):
             return
         raw_cols = tuple(jax.numpy.asarray(c) for c in staged.cols)
         # ts-delta wire: ship (base scalar, i32 delta) instead of a fresh
@@ -1058,6 +1083,8 @@ class JoinQueryRuntime:
         # set at wiring time: fn(new_rows) -> PlannedJoinQuery replanned
         # with a larger emission compaction cap
         self._replan = None
+        # @fuse(batches=K): stack buffer for scan-fused dispatch, or None
+        self._fuse = None
 
     @property
     def name(self):
@@ -1135,6 +1162,20 @@ class JoinQueryRuntime:
             return (t.cols, t.ts, t.valid)
         return (jax.numpy.zeros((1,)),) * 3
 
+    def _join_slots(self, is_left: bool,
+                    staged: ev.StagedBatch) -> np.ndarray:
+        """Per-side group-by slots (joined rows compose both sides' ids);
+        TIMER rows carry zeroed columns — allocating for them would burn
+        a phantom slot for the all-zeros key on every tick.  Shared by the
+        sequential path and fused dispatch (core/fusion.py)."""
+        p = self.planned
+        galloc = p.slot_allocator if is_left else p.slot_allocator2
+        gpos = p.gl_pos if is_left else p.gr_pos
+        if galloc is None:
+            return _zero_slots(staged.ts.shape[0])
+        gvalid = staged.valid & (staged.kind != ev.TIMER)
+        return galloc.slots_for([staged.cols[i] for i in gpos], gvalid)
+
     def process_staged(self, is_left: bool, staged: ev.StagedBatch,
                        now: int) -> None:
         p = self.planned
@@ -1142,17 +1183,11 @@ class JoinQueryRuntime:
         step = p.step_left if is_left else p.step_right
         if step is None:
             return
-        # per-side group-by slots (joined rows compose both sides' ids);
-        # TIMER rows carry zeroed columns — allocating for them would burn
-        # a phantom slot for the all-zeros key on every tick
-        galloc = p.slot_allocator if is_left else p.slot_allocator2
-        gpos = p.gl_pos if is_left else p.gr_pos
-        if galloc is not None:
-            gvalid = staged.valid & (staged.kind != ev.TIMER)
-            gslot = galloc.slots_for(
-                [staged.cols[i] for i in gpos], gvalid)
-        else:
-            gslot = np.zeros((staged.ts.shape[0],), np.int32)
+        fb = self._fuse
+        if fb is not None and fb.offer((is_left, staged, now), staged,
+                                       is_left):
+            return
+        gslot = self._join_slots(is_left, staged)
         batch = staged.to_device(side.schema)
         with _maybe_span("step", query=self.name, kind="join"):
             self.state, out, wake = step(
@@ -1735,6 +1770,19 @@ class _PartitionPurger:
 
 _BUCKET_PLANES: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
 _IDENTITY_SEL: Dict[int, np.ndarray] = {}
+_ZERO_SLOTS: Dict[int, np.ndarray] = {}
+
+
+def _zero_slots(cap: int) -> np.ndarray:
+    """[cap] all-zero int32 group-slot column, cached read-only per size —
+    every send of every keyed stream allocated this afresh before (consumers
+    only read it: device upload and purger liveness touch)."""
+    z = _ZERO_SLOTS.get(cap)
+    if z is None:
+        z = np.zeros((cap,), np.int32)
+        z.setflags(write=False)
+        _ZERO_SLOTS[cap] = z
+    return z
 
 
 def _identity_sel(cap: int) -> np.ndarray:
@@ -2188,6 +2236,7 @@ class SiddhiAppRuntime:
                 compact_rows_override=cap)
             runtime.async_emit = self._async_enabled(q)
             runtime.pipeline_emit = self._pipeline_enabled(q)
+            self._maybe_fuse(runtime, q, "pattern")
             self.query_runtimes[name] = runtime
             for sid in planned.spec.stream_ids:
 
@@ -2240,6 +2289,7 @@ class SiddhiAppRuntime:
         runtime = QueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
         runtime.pipeline_emit = self._pipeline_enabled(q)
+        self._maybe_fuse(runtime, q, "plain")
         self.query_runtimes[name] = runtime
         if from_window:
             self.named_windows[in_sid].subscribers.append(runtime)
@@ -2368,6 +2418,7 @@ class SiddhiAppRuntime:
         runtime._replan = lambda rows, _p=plan: _p(emit_rows_override=rows)
         runtime.async_emit = self._async_enabled(q)
         runtime.pipeline_emit = self._pipeline_enabled(q)
+        self._maybe_fuse(runtime, q, "join")
         self.query_runtimes[name] = runtime
         for side, is_left in ((planned.left, True), (planned.right, False)):
             class _JSub:
@@ -2426,6 +2477,43 @@ class SiddhiAppRuntime:
         if ann is None:
             return 0
         return max(1, int(ann.element("depth", 1) or 1))
+
+    def _fuse_enabled(self, q) -> int:
+        """@fuse(batches='K') on the query, any input stream definition,
+        or the app (@app:fuse): stack K staged micro-batches and run them
+        as ONE lax.scan device dispatch — per-send RTT and dispatch
+        overhead divide by K (core/fusion.py).  Composes with @pipeline/
+        @async (per-batch emissions re-enter their paths) and @emit.
+        Returns the stack depth K (0 = off)."""
+        ann = q.get_annotation("fuse")
+        if ann is None:
+            ist = q.input_stream
+            sids = getattr(ist, "all_stream_ids", None) or \
+                [getattr(ist, "stream_id", None)]
+            for sid in sids:
+                sdef = self.app.stream_definition_map.get(sid)
+                if sdef is not None and \
+                        sdef.get_annotation("fuse") is not None:
+                    ann = sdef.get_annotation("fuse")
+                    break
+        if ann is None:
+            ann = self.app.get_annotation("app:fuse")
+        if ann is None:
+            return 0
+        k = ann.element("batches", ann.element(None, 8)) or 8
+        return max(1, int(k))
+
+    def _maybe_fuse(self, runtime, q, kind: str) -> None:
+        k = self._fuse_enabled(q)
+        if k <= 0:
+            return
+        why = _fusion.ineligible_reason(runtime, kind)
+        if why is not None:
+            logging.getLogger("siddhi_tpu").warning(
+                "@fuse(batches=%d) ignored on query %s: %s", k,
+                runtime.name, why)
+            return
+        runtime._fuse = _fusion.FuseBuffer(runtime, k, kind)
 
     def _add_partition(self, part: Partition, qi: int) -> int:
         """Partitions: key-scoped state clones (reference:
@@ -2536,6 +2624,7 @@ class SiddhiAppRuntime:
                     compact_rows_override=cap)
                 runtime.async_emit = self._async_enabled(q)
                 runtime.pipeline_emit = self._pipeline_enabled(q)
+                self._maybe_fuse(runtime, q, "pattern")
                 self.query_runtimes[qname] = runtime
                 part_runtimes.append(runtime)
                 for sid in planned.spec.stream_ids:
@@ -2621,6 +2710,7 @@ class SiddhiAppRuntime:
                 runtime = QueryRuntime(planned, self)
                 runtime.async_emit = self._async_enabled(q)
                 runtime.pipeline_emit = self._pipeline_enabled(q)
+                self._maybe_fuse(runtime, q, "plain")
                 self.query_runtimes[qname] = runtime
                 part_runtimes.append(runtime)
                 self.junctions[sid].subscribe_query(runtime)
@@ -2734,8 +2824,10 @@ class SiddhiAppRuntime:
             for j in self.junctions.values():
                 j.stop_async()       # drain accepted sends, stop workers
             for qr in self.query_runtimes.values():
-                # held @pipeline emissions deliver before teardown: an
-                # accepted send's output must not vanish (at-least-once)
+                # buffered @fuse stacks and held @pipeline emissions
+                # deliver before teardown: an accepted send's output must
+                # not vanish (at-least-once)
+                _fusion.drain(qr)
                 _drain_pending_emit(qr)
             for sk in self.sinks:
                 sk.stop()
@@ -2760,10 +2852,12 @@ class SiddhiAppRuntime:
             for j in self.junctions.values():
                 j.flush_async()
             for qr in self.query_runtimes.values():
+                _fusion.drain(qr)   # partial @fuse stacks process NOW
                 _drain_pending_emit(qr)
             self._drainer.flush()
             if all(j.pending_async() == 0 for j in self.junctions.values()) \
-                    and not any(getattr(qr, "_pending_emit", None)
+                    and not any(getattr(qr, "_pending_emit", None) or
+                                _fusion.pending(qr)
                                 for qr in self.query_runtimes.values()):
                 return
         import logging
@@ -2825,10 +2919,14 @@ class SiddhiAppRuntime:
                 for j in self.junctions.values():
                     j.flush_async()
                 for qr in self.query_runtimes.values():
+                    # @fuse stacks hold UNPROCESSED events — they must
+                    # land in the snapshotted state, not vanish
+                    _fusion.drain(qr)
                     _drain_pending_emit(qr)
                 if all(j.pending_async() == 0
                        for j in self.junctions.values()) and \
-                        not any(getattr(qr, "_pending_emit", None)
+                        not any(getattr(qr, "_pending_emit", None) or
+                                _fusion.pending(qr)
                                 for qr in self.query_runtimes.values()):
                     break
             locks = [self._lock]

@@ -63,6 +63,34 @@ def strongify(tree):
     return jax.tree.map(_strong_leaf, tree)
 
 
+def fuse_step(body, owner=None):
+    """K query steps in ONE device dispatch: `body(carry, x, const) ->
+    (carry', y)` becomes a jitted `fused(carry, xs, const) -> (carry',
+    ys)` running `lax.scan` over the leading [K] axis of every `xs` leaf.
+
+    This is the deep-batching lever PERF.md names: per-dispatch and
+    per-fetch fixed costs (a ~73-95 ms tunnel round-trip per send on the
+    remote TPU; Python dispatch overhead on CPU) divide by K because K
+    staged micro-batches ride one transfer, one XLA execution, and one
+    emission-header fetch.  State threads through the scan carry exactly
+    as it threads through K sequential `jit_step` calls; the carry is
+    `strongify`-ed every iteration so a weak-typed leaf can never make
+    the carry aval drift mid-scan (the same guarantee jit_step gives at
+    the jit boundary).
+
+    `owner` should be the fused recompile owner (`fused:<query>`) so a
+    K-change or shape-change recompile is attributed in /metrics instead
+    of appearing as a silent re-trace of the base step."""
+
+    def fused(carry, xs, const):
+        def scan_body(c, x):
+            c2, y = body(c, x, const)
+            return strongify(c2), y
+        return jax.lax.scan(scan_body, carry, xs)
+
+    return jit_step(fused, owner=owner, donate_argnums=(0,))
+
+
 def jit_step(fn, owner=None, **jit_kwargs):
     """`jax.jit` with compile-signature-stable outputs: every returned
     leaf is strong-typed, so feeding returned state back into the step

@@ -97,6 +97,12 @@ def render_prometheus(runtimes: Dict) -> str:
                 "Device outputs queued in the async emission drainer")
     buf_i = fam("siddhi_buffered_ingress_events", "gauge",
                 "Batches pending in @async ingress queues, per stream")
+    fus_d = fam("siddhi_fused_dispatches_total", "counter",
+                "@fuse scan dispatches per query (one device step runs "
+                "K stacked batches)")
+    fus_b = fam("siddhi_fused_batches_total", "counter",
+                "Micro-batches executed through @fuse dispatches, "
+                "per query")
 
     for app_name, rt in sorted(runtimes.items()):
         st = rt.stats
@@ -122,6 +128,12 @@ def render_prometheus(runtimes: Dict) -> str:
             elif name.endswith(".cap_growths"):
                 grow.sample(n, app=app_name,
                             query=name[:-len(".cap_growths")])
+            elif name.endswith(".fused_dispatches"):
+                fus_d.sample(n, app=app_name,
+                             query=name[:-len(".fused_dispatches")])
+            elif name.endswith(".fused_batches"):
+                fus_b.sample(n, app=app_name,
+                             query=name[:-len(".fused_batches")])
         buf_e.sample(rt.buffered_emissions(), app=app_name)
         for sid, n in sorted(rt.buffered_ingress().items()):
             buf_i.sample(n, app=app_name, stream=sid)

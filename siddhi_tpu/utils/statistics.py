@@ -38,6 +38,7 @@ class StatisticsManager:
         self._query_hist: Dict[str, LogHistogram] = {}
         self._junction_hist: Dict[str, LogHistogram] = {}
         self._sink_hist: Dict[str, LogHistogram] = {}
+        self._fused_k_hist: Dict[str, LogHistogram] = {}
         self._counters: Dict[str, int] = {}
         self.tracer = PipelineTracer()
         self._start = time.time()
@@ -79,12 +80,37 @@ class StatisticsManager:
         with self._lock:
             self._counters[name] = self._counters.get(name, 0) + n
 
+    def fused_dispatch(self, name: str, k: int, n: int,
+                       elapsed_ns: int) -> None:
+        """One @fuse dispatch covering k micro-batches (n events):
+        latency lands in the query histogram under `<name>:fused` so a
+        fused dispatch is not misread as one slow batch, and the
+        batches-per-dispatch distribution gets its own log2 histogram
+        (quantiles in BATCHES, not ns) — partial flushes and signature
+        breaks show up as a left-shifted k distribution."""
+        hist_of(self._query_hist, name + ":fused", self._lock) \
+            .record(elapsed_ns)
+        hist_of(self._fused_k_hist, name, self._lock).record(k)
+        with self._lock:
+            self._query_events[name + ":fused"] = \
+                self._query_events.get(name + ":fused", 0) + n
+            self._counters[f"{name}.fused_dispatches"] = \
+                self._counters.get(f"{name}.fused_dispatches", 0) + 1
+            self._counters[f"{name}.fused_batches"] = \
+                self._counters.get(f"{name}.fused_batches", 0) + k
+
     # -- recompile projection --------------------------------------------------
     @staticmethod
     def _owners_of(app) -> Optional[list]:
         if app is None:
             return None
         owners = list(getattr(app, "query_runtimes", ()))
+        # fused scan steps carry their own recompile label so a K-change
+        # recompile is attributed instead of reading as a silent re-trace
+        # of the base step
+        owners += [f"fused:{q}" for q, qr in
+                   getattr(app, "query_runtimes", {}).items()
+                   if getattr(qr, "_fuse", None) is not None]
         owners += [f"table:{t}" for t in getattr(app, "tables", ())]
         owners += [f"window:{w}" for w in getattr(app, "named_windows", ())]
         owners += [f"agg:{a}" for a in getattr(app, "aggregations", ())]
@@ -109,6 +135,7 @@ class StatisticsManager:
                 "query_hist": dict(self._query_hist),
                 "junction_hist": dict(self._junction_hist),
                 "sink_hist": dict(self._sink_hist),
+                "fused_k_hist": dict(self._fused_k_hist),
                 "counters": dict(self._counters),
             }
 
@@ -148,6 +175,12 @@ class StatisticsManager:
             if self._sink_hist:
                 out["sinks"] = {sid: h.snapshot()
                                 for sid, h in self._sink_hist.items()}
+            if self._fused_k_hist:
+                # batches-per-dispatch distribution: snapshot() reports in
+                # "ns" keys but the recorded unit here is BATCHES
+                out["fused_batches_per_dispatch"] = {
+                    name: h.snapshot()
+                    for name, h in self._fused_k_hist.items()}
             if self._counters:
                 out["counters"] = dict(self._counters)
         rec = self.recompiles(app)
@@ -188,6 +221,7 @@ class StatisticsManager:
             self._query_hist.clear()
             self._junction_hist.clear()
             self._sink_hist.clear()
+            self._fused_k_hist.clear()
             self._counters.clear()
             self._start = time.time()
 

@@ -1,0 +1,323 @@
+"""@fuse(batches=K) scan-fused stepping: K device steps ride ONE
+dispatch (core/fusion.py).  The contract under test is byte-identical
+parity — fused execution must produce exactly the emissions and final
+snapshot state of K sequential sync sends — across the fused paths
+(filter, sliding window, join, 4-state pattern), plus the K=1
+degenerate stack, partial-stack flush, and the exclusion/composition
+rules."""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+
+
+def _collect(rt, qname):
+    got = []
+    rt.add_callback(qname, lambda ts, cur, exp: got.extend(
+        [("C", ts, tuple(e.data)) for e in (cur or [])] +
+        [("E", ts, tuple(e.data)) for e in (exp or [])]))
+    return got
+
+
+def _run(ql, feed, qname="q"):
+    """Build, feed, flush; returns (emissions, final state snapshot)."""
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(ql)
+    got = _collect(rt, qname)
+    rt.start()
+    feed(rt)
+    rt.flush()
+    blob = rt.snapshot()
+    m.shutdown()
+    return got, blob
+
+
+def _assert_parity(template, feed, k, qname="q"):
+    """Fused vs sequential: identical emissions AND identical snapshot
+    bytes (snapshot pickles the full state pytrees — byte equality means
+    the scan carry threaded state exactly as K sequential steps did)."""
+    seq, seq_blob = _run(template.format(ann=""), feed, qname)
+    fus, fus_blob = _run(
+        template.format(ann=f"@fuse(batches='{k}')"), feed, qname)
+    assert fus == seq
+    assert fus_blob == seq_blob
+    return seq
+
+
+# ---------------------------------------------------------------------------
+# parity across the fused paths
+# ---------------------------------------------------------------------------
+
+FILTER_QL = """
+@app:playback
+define stream S (v int, p float);
+{ann} @info(name='q') from S[v > 2 and p < 0.9]
+select v, p * 2.0 as d insert into Out;
+"""
+
+
+def _feed_filter(rt):
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(11)
+    for i in range(13):
+        h.send([[int(rng.integers(0, 6)), round(float(rng.random()), 3)]
+                for _ in range(8)], timestamp=1000 + i)
+
+
+@pytest.mark.parametrize("k", [1, 4, 8])
+def test_fused_filter_parity(k):
+    out = _assert_parity(FILTER_QL, _feed_filter, k)
+    assert out  # the workload must actually emit
+
+
+WINDOW_QL = """
+@app:playback
+define stream S (g long, p float);
+{ann} @info(name='q') from S#window.length(4)
+select g, sum(p) as sp group by g insert into Out;
+"""
+
+
+def _feed_window(rt):
+    h = rt.get_input_handler("S")
+    for i in range(11):
+        h.send([[i % 3, float(i)], [(i + 1) % 3, i * 0.5]],
+               timestamp=1000 + i)
+
+
+def test_fused_sliding_window_parity():
+    out = _assert_parity(WINDOW_QL, _feed_window, 4)
+    assert out
+
+
+JOIN_QL = """
+@app:playback
+define stream L (s long, p float);
+define stream R (s long, n int);
+@emit(rows='4096') {ann} @info(name='q')
+from L#window.length(8) join R#window.length(8) on L.s == R.s
+select L.s as s, L.p as p, R.n as v insert into Out;
+"""
+
+
+def _feed_join(rt):
+    hl, hr = rt.get_input_handler("L"), rt.get_input_handler("R")
+    rng = np.random.default_rng(3)
+    for i in range(6):
+        # bursts per side: same-side batches stack; the side switch
+        # breaks the stack signature and drains it in order
+        for _ in range(3):
+            hl.send([[int(rng.integers(0, 4)),
+                      round(float(rng.random()), 3)]], timestamp=1000 + i)
+        for _ in range(3):
+            hr.send([[int(rng.integers(0, 4)),
+                      int(rng.integers(1, 9))]], timestamp=1000 + i)
+
+
+def test_fused_join_parity():
+    out = _assert_parity(JOIN_QL, _feed_join, 3)
+    assert out
+
+
+PATTERN_QL = """
+@app:playback
+define stream S (k long, p float, v int);
+@capacity(keys='1', slots='8') @emit(rows='4096') {ann}
+@info(name='q')
+from every e1=S[v == 1] -> e2=S[v == 2 and p >= e1.p]
+     -> e3=S[v == 3] -> e4=S[v == 4 and p >= e3.p]
+select e1.p as p1, e2.p as p2, e4.p as p4 insert into M;
+"""
+
+
+def _feed_pattern(rt):
+    h = rt.get_input_handler("S")
+    rng = np.random.default_rng(7)
+    for i in range(12):
+        vols = rng.integers(1, 5, 16).tolist()
+        prices = [round(float(x), 3) for x in rng.random(16)]
+        h.send([[0, prices[j], vols[j]] for j in range(16)],
+               timestamp=1000 + i)
+
+
+@pytest.mark.parametrize("k", [1, 4])
+def test_fused_4state_pattern_parity(k):
+    out = _assert_parity(PATTERN_QL, _feed_pattern, k)
+    assert out
+
+
+# ---------------------------------------------------------------------------
+# stack mechanics: partial flush, lag-until-full, snapshot drain
+# ---------------------------------------------------------------------------
+
+def test_partial_stack_flush_delivers_pending(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @fuse(batches='8') @info(name='q')
+    from S select v * 2 as w insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    qr = rt.query_runtimes["q"]
+    assert qr._fuse is not None and qr._fuse.k == 8
+    h = rt.get_input_handler("S")
+    for v in range(3):
+        h.send([v])
+    # stack not full: processing (and delivery) lags
+    assert got == [] and len(qr._fuse.items) == 3
+    rt.flush()      # partial stack drains through the sequential path
+    assert [e[2][0] for e in got] == [0, 2, 4]
+    assert qr._fuse.items == []
+
+
+def test_full_stack_dispatches_without_flush(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @fuse(batches='3') @info(name='q')
+    from S select v + 1 as w insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in range(3):
+        h.send([v])
+    # Kth send dispatched the whole stack inline — no flush needed
+    assert [e[2][0] for e in got] == [1, 2, 3]
+
+
+def test_snapshot_drains_fuse_stack(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @fuse(batches='8') @info(name='q')
+    from S select sum(v) as t insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([7])
+    h.send([5])
+    blob = rt.snapshot()    # quiesce must process buffered sends
+    assert blob and [e[2][0] for e in got] == [7, 12]
+
+
+def test_signature_change_drains_in_order(manager):
+    # a bucket-size change mid-stack must not reorder batches
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @fuse(batches='4') @info(name='q')
+    from S select v as w insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1])
+    h.send([2])
+    # 9 events -> 32-bucket: different capacity, drains the pending pair
+    h.send([[v] for v in range(3, 12)])
+    rt.flush()
+    assert [e[2][0] for e in got] == [1, 2] + list(range(3, 12))
+
+
+# ---------------------------------------------------------------------------
+# exclusions and composition
+# ---------------------------------------------------------------------------
+
+def test_timer_window_not_fused(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @fuse(batches='4') @info(name='q') from S#window.time(1 sec)
+    select sum(v) as t insert into Out;
+    """)
+    # time windows need the device wake scalar promptly: excluded
+    assert rt.query_runtimes["q"]._fuse is None
+
+
+def test_partitioned_pattern_not_fused(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (k long, v int);
+    partition with (k of S) begin
+    @capacity(keys='16', slots='4') @fuse(batches='4') @info(name='p')
+    from every e1=S[v == 1] -> e2=S[v == 2]
+    select e1.k as k insert into Out;
+    end;
+    """)
+    assert rt.query_runtimes["p"]._fuse is None
+
+
+def test_app_level_fuse_annotation(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @app:fuse(batches='2')
+    define stream S (v int);
+    @info(name='q') from S select v as w insert into Out;
+    """)
+    assert rt.query_runtimes["q"]._fuse is not None
+    assert rt.query_runtimes["q"]._fuse.k == 2
+
+
+def test_stream_level_fuse_annotation(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @fuse(batches='2')
+    define stream S (v int);
+    @info(name='q') from S select v as w insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    assert rt.query_runtimes["q"]._fuse is not None
+    h = rt.get_input_handler("S")
+    h.send([1])
+    h.send([2])
+    assert [e[2][0] for e in got] == [1, 2]
+
+
+def test_fuse_composes_with_pipeline(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @fuse(batches='2') @pipeline @info(name='q')
+    from S select v * 10 as w insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in range(4):
+        h.send([v])
+    rt.flush()
+    assert [e[2][0] for e in got] == [0, 10, 20, 30]
+
+
+def test_fused_dispatch_metrics(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @app:statistics
+    define stream S (v int);
+    @fuse(batches='2') @info(name='q')
+    from S select v as w insert into Out;
+    """)
+    _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in range(4):
+        h.send([v])
+    rep = rt.statistics()
+    assert rep["counters"]["q.fused_dispatches"] == 2
+    assert rep["counters"]["q.fused_batches"] == 4
+    assert "q" in rep["fused_batches_per_dispatch"]
+    # the fused scan step owns its OWN recompile label, so a K change is
+    # attributed instead of reading as a silent re-trace of the base step
+    assert any(o.startswith("fused:q") for o in rep.get("recompiles", {}))
+
+
+def test_fused_recompile_owner_in_metrics_exposition(manager):
+    from siddhi_tpu.observability.exposition import render_prometheus
+    rt = manager.create_siddhi_app_runtime("""
+    @app:statistics
+    define stream S (v int);
+    @fuse(batches='2') @info(name='q')
+    from S select v as w insert into Out;
+    """)
+    _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in range(2):
+        h.send([v])
+    text = render_prometheus(manager.runtimes)
+    assert 'siddhi_fused_dispatches_total{app=' in text
+    assert 'query="fused:q"' in text
