@@ -2,9 +2,9 @@
 PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: test lint dryrun bench metrics-smoke fuse-smoke all
+.PHONY: test lint dryrun bench metrics-smoke fuse-smoke explain-smoke all
 
-all: lint test dryrun metrics-smoke fuse-smoke
+all: lint test dryrun metrics-smoke fuse-smoke explain-smoke
 
 lint:
 	$(PY) -m compileall -q siddhi_tpu tests samples
@@ -28,3 +28,10 @@ metrics-smoke:
 # mismatch (scan-fusion layer, README "Fused stepping")
 fuse-smoke:
 	$(CPU_ENV) $(PY) samples/fuse_smoke.py
+
+# boots a sample app, then asserts the whole introspection surface:
+# GET /explain carries XLA cost analysis, /healthz reports live+ready,
+# /trace.json parses as Chrome trace-event JSON, and the
+# siddhi_state_bytes family scrapes (observability v2 layer)
+explain-smoke:
+	$(CPU_ENV) $(PY) samples/explain_smoke.py

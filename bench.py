@@ -678,15 +678,91 @@ def main():
     }))
 
 
+def run_cost_analysis(B=1 << 12, n_keys=1 << 12):
+    """--mode cost_analysis: the PERF.md round-7 table — EXPLAIN's XLA
+    cost/memory analysis of the flagship and sequence_within steps at the
+    signatures real traffic traces (observability/explain.py).  Device
+    numbers, not wall clock: flops, bytes accessed, and peak memory per
+    dispatch, so perf PRs can argue arithmetic intensity instead of only
+    end-to-end seconds."""
+    from siddhi_tpu import SiddhiManager
+    rng = np.random.default_rng(0)
+    workloads = []
+    ql_flag = QL_TEMPLATE.format(async_ann="", pipe_ann="",
+                                 n_keys=n_keys, slots=SLOTS)
+    nk = B // 4
+
+    def send_flagship(h, s):
+        h.send_columns(
+            [np.repeat(np.arange(nk, dtype=np.int64), 4),
+             rng.random(B).astype(np.float32),
+             np.tile(np.array([1, 2, 3, 4], np.int32), nk)],
+            timestamps=1000 + s * 100 + np.arange(B, dtype=np.int64) % 50)
+    workloads.append(("flagship", ql_flag, "TradeStream", "flagship",
+                      send_flagship))
+    ql_seq = """
+    @app:playback
+    define stream S (symbol long, price float, volume int);
+    @capacity(keys='1', slots='8')
+    @emit(rows='4096')
+    @info(name='q')
+    from every e1=S[volume == 1], e2=S[volume == 2 and price > e1.price]
+      within 1 sec
+    select e1.price as p1, e2.price as p2
+    insert into M;
+    """
+
+    def send_seq(h, s):
+        h.send_columns(
+            [np.zeros(B, np.int64), rng.random(B).astype(np.float32),
+             np.tile(np.array([1, 2], np.int32), B // 2)],
+            timestamps=1000 + s * 50 + np.arange(B, dtype=np.int64) % 50)
+    workloads.append(("sequence_within", ql_seq, "S", "q", send_seq))
+    out = {}
+    for label, ql, sid, qname, send in workloads:
+        m = SiddhiManager()
+        rt = m.create_siddhi_app_runtime(ql)
+        rt.start()
+        h = rt.get_input_handler(sid)
+        for s in range(2):          # warm: trace the steady-state step
+            send(h, s)
+        rt.flush()
+        rep = rt.explain(qname)
+        steps = {}
+        for role, c in rep["steps"].items():
+            if not c.get("available"):
+                continue
+            memb = c.get("memory", {})
+            steps[role] = {
+                "flops": c.get("flops"),
+                "bytes_accessed": c.get("bytes_accessed"),
+                "peak_bytes": memb.get("peak_bytes"),
+                "temp_bytes": memb.get("temp_bytes"),
+                "flops_per_byte": round(
+                    c["flops"] / c["bytes_accessed"], 4)
+                if c.get("bytes_accessed") else None,
+            }
+            print(f"{label}/{role}: flops={c.get('flops'):,.0f} "
+                  f"bytes={c.get('bytes_accessed'):,.0f} "
+                  f"peak={memb.get('peak_bytes', 0):,}", file=sys.stderr)
+        out[label] = {"B": B, "steps": steps,
+                      "state_bytes": rep["state"]["component_bytes"]}
+        m.shutdown()
+    print(json.dumps({"mode": "cost_analysis", **out}))
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode", default="full",
-                    choices=["full", "device_loop", "fuse_compare"],
+                    choices=["full", "device_loop", "fuse_compare",
+                             "cost_analysis"],
                     help="full: the flagship suite (default); "
                          "device_loop: tunnel-independent chip-side "
                          "events/sec via fused dispatch re-execution; "
-                         "fuse_compare: end-to-end @fuse vs sequential")
+                         "fuse_compare: end-to-end @fuse vs sequential; "
+                         "cost_analysis: EXPLAIN flops/bytes/peak-memory "
+                         "of the flagship + sequence_within steps")
     ap.add_argument("--k", type=int, default=16,
                     help="fused stack depth (device_loop/fuse_compare)")
     ap.add_argument("--batch", type=int, default=1 << 11,
@@ -700,5 +776,7 @@ if __name__ == "__main__":
     elif args.mode == "fuse_compare":
         _enable_compile_cache()
         run_fuse_compare(args.k, args.batch)
+    elif args.mode == "cost_analysis":
+        run_cost_analysis(B=args.batch)
     else:
         main()

@@ -77,6 +77,24 @@ def ineligible_reason(qr, kind: str):
     return f"unknown runtime kind {kind!r}"
 
 
+def eligibility(qr, kind: str) -> Dict:
+    """Fusion facts for EXPLAIN (observability/explain.py): whether the
+    query CAN fuse, whether it IS fusing (and at what K), and — when
+    @fuse was requested but wiring skipped it — the concrete exclusion
+    reason instead of a log line that scrolled away."""
+    reason = ineligible_reason(qr, kind)
+    node: Dict = {"eligible": reason is None}
+    if reason is not None:
+        node["exclusion_reason"] = reason
+    fb = getattr(qr, "_fuse", None)
+    node["active"] = fb is not None
+    if fb is not None:
+        node["batches"] = fb.k
+    elif getattr(qr, "_fuse_requested", 0):
+        node["requested_batches"] = qr._fuse_requested
+    return node
+
+
 class FuseBuffer:
     """Per-query accumulator of staged sends for fused dispatch.
 

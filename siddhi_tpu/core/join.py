@@ -90,6 +90,42 @@ class PlannedJoinQuery:
     raw_left: Optional[Callable] = None
     raw_right: Optional[Callable] = None
 
+    @staticmethod
+    def _describe_side(s: "JoinSide") -> Dict:
+        kind = "aggregation" if s.is_aggregation else \
+            "named_window" if s.is_named_window else \
+            "table" if s.is_table else "stream"
+        d: Dict[str, Any] = {"id": s.stream_id, "kind": kind,
+                             "columns": list(s.schema.names)}
+        if s.window is not None:
+            d["window_processor"] = type(s.window).__name__
+        if s.pre_filters:
+            d["pre_filters"] = len(s.pre_filters)
+        return d
+
+    def describe(self) -> Dict:
+        """Compiled-plan facts for EXPLAIN (observability/explain.py):
+        side kinds (stream/table/window/aggregation), the window
+        processors chosen, emission compaction — beyond the query AST."""
+        d: Dict[str, Any] = {
+            "join_type": self.join_type,
+            "trigger": self.trigger,
+            "left": self._describe_side(self.left),
+            "right": self._describe_side(self.right),
+            "needs_timer": self.needs_timer,
+            "out_columns": list(self.out_schema.names),
+            "emission_cap_rows": self.compact_rows,
+            "emission_cap_explicit": bool(self.emit_explicit),
+        }
+        if self.slot_allocator is not None:
+            d["group_slot_capacity"] = (
+                self.slot_allocator.capacity,
+                self.slot_allocator2.capacity
+                if self.slot_allocator2 is not None else None)
+        if self.per_duration is not None:
+            d["aggregation_per"] = self.per_duration
+        return d
+
 
 def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
              scope: Scope, window_capacity_hint: int,

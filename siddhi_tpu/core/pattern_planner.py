@@ -167,6 +167,37 @@ class PlannedPatternQuery:
     # identical per-batch program (core/fusion.py); None on the mesh path
     step_bodies: Optional[Dict[str, Callable]] = None
 
+    # the 1<<30 compact_rows default means "effectively uncapped" for
+    # non-partitioned patterns (a per-key cap with K=1 would cap the batch)
+    _UNCAPPED = 1 << 30
+
+    def describe(self) -> Dict:
+        """Compiled-plan facts for EXPLAIN (observability/explain.py):
+        the NFA layout the planner built — key/slot capacities, emission
+        cap, which step specializations exist — beyond the query AST."""
+        d: Dict[str, Any] = {
+            "streams": list(self.spec.stream_ids),
+            "nfa_states": self.spec.n_states,
+            "state_type": self.spec.state_type,
+            "within_ms": self.spec.within,
+            "key_capacity": self.key_capacity,
+            "nfa_slots_per_key": self.slots,
+            "partitioned": bool(self.partition_positions),
+            "out_columns": list(self.out_schema.names),
+            # per-batch step specializations the runtime can dispatch to
+            "ts_delta_wire": self.steps_w is not None,
+            "dense_slot_fast_path": self.dense_steps is not None,
+            "timer_step": self.timer_step is not None,
+        }
+        if self.compact_rows >= self._UNCAPPED:
+            d["emission_cap_rows"] = None   # uncapped (K=1 layout)
+        else:
+            d["emission_cap_rows"] = int(self.compact_rows)
+        d["emission_cap_explicit"] = bool(self.emit_explicit)
+        if self.mesh is not None:
+            d["sharded_over_devices"] = int(self.mesh.devices.size)
+        return d
+
 
 def plan_pattern_query(
     query: Query,

@@ -84,6 +84,35 @@ class PlannedQuery:
     # None on the keyed-window and sharded paths, which don't fuse
     raw_step: Optional[Callable] = None
 
+    def describe(self) -> Dict:
+        """Compiled-plan facts for EXPLAIN (observability/explain.py):
+        what the planner chose — window processor, capacities, slot
+        spaces, sharding — beyond what the query AST shows."""
+        d: Dict[str, Any] = {
+            "input_stream": self.input_stream_id,
+            "batch_capacity": self.batch_capacity,
+            "window_processor": type(self.window).__name__,
+            "needs_timer": self.needs_timer,
+            "in_columns": list(self.in_schema.names),
+            "out_columns": list(self.out_schema.names),
+        }
+        if self.slot_allocator is not None:
+            d["group_slot_capacity"] = self.slot_allocator.capacity
+        if self.keyed_window:
+            d["keyed_window"] = True
+            d["key_capacity"] = self.key_capacity
+        if self.partition_key_fn is not None:
+            d["range_partition"] = True
+        if self.pair_allocs:
+            d["distinct_pair_slots"] = [a.capacity
+                                        for a, _ in self.pair_allocs]
+        if self.mesh is not None or self.keyed_mesh is not None:
+            m = self.mesh or self.keyed_mesh
+            d["sharded_over_devices"] = int(m.devices.size)
+        if self.in_deps:
+            d["table_probes"] = list(self.in_deps)
+        return d
+
 
 def _env_for(scope_key: str, cols, ts):
     return {scope_key: cols, "__ts__": ts}

@@ -2504,11 +2504,20 @@ class SiddhiAppRuntime:
         return max(1, int(k))
 
     def _maybe_fuse(self, runtime, q, kind: str) -> None:
+        # every query runtime passes through here with its AST and path
+        # kind — retained for EXPLAIN (observability/explain.py renders
+        # the operator tree from the AST; kind selects the fusion rules)
+        runtime._query_ast = q
+        runtime._kind = kind
         k = self._fuse_enabled(q)
         if k <= 0:
             return
+        runtime._fuse_requested = k
         why = _fusion.ineligible_reason(runtime, kind)
         if why is not None:
+            # kept for explain(): the concrete reason @fuse skipped this
+            # query, not just a log line that scrolled away
+            runtime._fuse_excluded = why
             logging.getLogger("siddhi_tpu").warning(
                 "@fuse(batches=%d) ignored on query %s: %s", k,
                 runtime.name, why)
@@ -3098,6 +3107,36 @@ class SiddhiAppRuntime:
         """Recent DETAIL-level batch traces, newest first, optionally only
         those that touched `query` (see observability/tracing.py)."""
         return self.stats.tracer.dump(query, limit)
+
+    def explain(self, query_name: Optional[str] = None,
+                deep: bool = True) -> Dict:
+        """EXPLAIN report: planned operator tree + per-step XLA cost
+        analysis (flops, bytes accessed, estimated peak memory), state
+        shapes and nbytes, emission caps, fusion eligibility with the
+        concrete exclusion reason, and recompile history.  One query, or
+        every query when `query_name` is None (then shallow by default —
+        see observability/explain.py).  May compile; this is an on-demand
+        diagnostic, never called from the scrape path."""
+        from ..observability.explain import explain_app, explain_query
+        if query_name is None:
+            return explain_app(self, deep=False)
+        return explain_query(self, query_name, deep=deep)
+
+    def state_memory(self) -> Dict:
+        """{owner: {component: nbytes}} across the app's device state —
+        window buffers, pattern slot blocks, selector slabs, tables,
+        named windows, aggregations, fuse stacks.  Metadata-only walk
+        (no device fetch); also exported as `siddhi_state_bytes` in
+        /metrics (observability/memory.py)."""
+        from ..observability.memory import component_bytes
+        return component_bytes(self)
+
+    def health(self) -> Dict:
+        """Host-side health report for this app: readiness/liveness
+        verdicts, per-stream last-event age + ingress backlog, and
+        sliding-window drop/recompile rates (observability/health.py)."""
+        from ..observability.health import app_health
+        return app_health(self)
 
     def set_statistics_level(self, level: str) -> None:
         self.stats.level = level.upper()

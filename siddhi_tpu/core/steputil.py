@@ -106,14 +106,32 @@ def jit_step(fn, owner=None, **jit_kwargs):
     from ..observability.recompile import RECOMPILES
     from ..observability import tracing
     label = owner or getattr(fn, "__qualname__", None) or "step"
+    # last-traced argument avals, captured for EXPLAIN: observability/
+    # explain.py re-lowers the jitted step from these ShapeDtypeStructs to
+    # run XLA cost analysis on exactly the signature that actually ran
+    # (specs are tiny host objects — no arrays are retained)
+    spec_holder = {"argspecs": None}
 
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
-        RECOMPILES.record(label, args)
+        if not RECOMPILES.suppressed():
+            RECOMPILES.record(label, args)
+            try:
+                spec_holder["argspecs"] = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(x.aval.shape,
+                                                   x.aval.dtype), args)
+            except Exception:  # noqa: BLE001 — accounting must not break
+                pass           # a trace (e.g. non-array leaves)
         tr = tracing.active()
         if tr is None:
             return strongify(fn(*args, **kwargs))
         with tracing.span("compile", owner=label):
             return strongify(fn(*args, **kwargs))
 
-    return jax.jit(wrapped, **jit_kwargs)
+    jitted = jax.jit(wrapped, **jit_kwargs)
+    try:
+        jitted._siddhi_owner = label
+        jitted._siddhi_argspec = spec_holder
+    except Exception:  # noqa: BLE001 — attribute support is best-effort
+        pass
+    return jitted

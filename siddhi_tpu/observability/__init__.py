@@ -17,17 +17,42 @@ and minutes through a remote TPU tunnel).  This package therefore records
   (`tracing.py`),
 - per-query **recompile counters** with the triggering abstract shapes,
   hooked into `steputil.jit_step` (`recompile.py`),
-- **Prometheus text exposition** of all of the above (`exposition.py`).
+- **Prometheus text exposition** of all of the above (`exposition.py`),
+
+and the v2 introspection layer (where the time and memory actually go):
+
+- query **EXPLAIN**: planned operator tree annotated with XLA
+  `cost_analysis()` per jitted step — flops, bytes accessed, estimated
+  peak memory — plus state shapes, emission caps, and fusion
+  eligibility (`explain.py`),
+- **state-memory accounting**: nbytes per device-state component from
+  shape/dtype metadata only, exported as `siddhi_state_bytes`
+  (`memory.py`),
+- **Perfetto export**: the pipeline-trace ring buffer as Chrome
+  trace-event JSON (`GET /trace.json`) + guarded `jax.profiler`
+  start/stop (`chrome_trace.py`),
+- **health probes**: readiness vs. liveness, per-stream last-event age
+  and backlog, sliding-window drop/recompile rates (`health.py`).
 
 Everything is allocation-free on the hot path when statistics are OFF: each
-hook sits behind a single `enabled`/`active()` check.
+hook sits behind a single `enabled`/`active()` check, and every scrape/
+probe path (`/metrics`, `/healthz`) reads host-side metadata only — no
+`device_get`, ever.
 """
 from .histogram import LogHistogram                       # noqa: F401
 from .recompile import RECOMPILES, RecompileRegistry      # noqa: F401
 from .tracing import PipelineTracer, active, span         # noqa: F401
 from .exposition import render_prometheus                 # noqa: F401
+from .explain import explain_app, explain_query           # noqa: F401
+from .memory import component_bytes, total_bytes          # noqa: F401
+from .chrome_trace import (chrome_trace, profiler_status,  # noqa: F401
+                           start_profiler, stop_profiler)
+from .health import app_health, healthz, liveness, readiness  # noqa: F401
 
 __all__ = [
     "LogHistogram", "PipelineTracer", "RECOMPILES", "RecompileRegistry",
     "active", "span", "render_prometheus",
+    "explain_app", "explain_query", "component_bytes", "total_bytes",
+    "chrome_trace", "start_profiler", "stop_profiler", "profiler_status",
+    "app_health", "healthz", "liveness", "readiness",
 ]

@@ -1,0 +1,120 @@
+"""Chrome trace-event (Perfetto) export of the pipeline-trace ring buffer.
+
+Reference (what): Dapper-style distributed trace viewers (Sigelman et al.,
+2010) made per-request span trees the standard latency-debugging surface;
+the reference engine's event-flow debugger serves the same role per event.
+TPU design (how): our PipelineTracer already holds per-batch span trees
+(ingest -> query -> step/compile -> emit, plus `fused_step` dispatch
+spans); this module converts that ring buffer to the Chrome trace-event
+JSON format, so `GET /trace.json` downloads a file that opens DIRECTLY in
+Perfetto (ui.perfetto.dev) or `chrome://tracing` with no translation step.
+
+Layout: one Chrome *process* per app, one *thread* (track) per batch
+trace — a batch's spans nest by time on its own track, and slow batches
+stand out as long tracks.  Timestamps are the tracer's own
+`perf_counter_ns` values scaled to microseconds: monotonic process-wide,
+so tracks order correctly across batches.
+
+Also here: the guarded `jax.profiler` start/stop used by
+`POST /profiler/start|stop` for device-level deep dives (XLA ops, HBM) —
+one active session at a time, never started implicitly.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+
+def trace_events(runtimes: Dict, query: Optional[str] = None,
+                 limit: int = 256) -> List[Dict]:
+    """Flat trace-event list for every app's recent batch traces."""
+    events: List[Dict] = []
+    for pid, (app_name, rt) in enumerate(sorted(runtimes.items()), 1):
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": f"siddhi:{app_name}"}})
+        for tr in rt.trace_dump(query, limit):
+            tid = int(tr["trace_id"])
+            spans = tr.get("spans", ())
+            # batch-level umbrella event spans the whole dispatch
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": f"batch {tr['trace_id']} "
+                                 f"[{tr['stream']}]"}})
+            # offsets are relative to the batch start; re-anchor on the
+            # batch's wall clock (ms resolution) so tracks align in time
+            base_us = float(tr.get("wall_ms", 0)) * 1e3
+            events.append({
+                "ph": "X", "name": f"dispatch {tr['stream']}",
+                "cat": "batch", "pid": pid, "tid": tid,
+                "ts": base_us, "dur": float(tr.get("total_us", 0.0)),
+                "args": {"events": tr.get("events"),
+                         "trace_id": tr.get("trace_id")}})
+            for s in spans:
+                args = {k: v for k, v in s.items()
+                        if k not in ("stage", "duration_us", "offset_us")}
+                events.append({
+                    "ph": "X", "name": s["stage"], "cat": "span",
+                    "pid": pid, "tid": tid,
+                    "ts": base_us + float(s.get("offset_us") or 0.0),
+                    "dur": float(s.get("duration_us", 0.0)),
+                    "args": args})
+    # a stable time order keeps the JSON loadable by strict parsers and
+    # the tracks deterministic (metadata records lead, then global ts
+    # order across all processes)
+    events.sort(key=lambda e: (0 if e["ph"] == "M" else 1,
+                               e.get("ts", 0.0)))
+    return events
+
+
+def chrome_trace(runtimes: Dict, query: Optional[str] = None,
+                 limit: int = 256) -> Dict:
+    """Chrome trace-event JSON object (the format Perfetto ingests)."""
+    return {
+        "traceEvents": trace_events(runtimes, query, limit),
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "siddhi_tpu PipelineTracer",
+                      "format": "chrome-trace-event"},
+    }
+
+
+# ---------------------------------------------------------------------------
+# jax.profiler guard: explicit start/stop, one session at a time
+# ---------------------------------------------------------------------------
+
+_prof_lock = threading.Lock()
+_prof_dir: Optional[str] = None
+
+
+def start_profiler(log_dir: str = "/tmp/siddhi_tpu_profile") -> Dict:
+    """Start a jax.profiler trace session (device-level deep dive).
+    Returns {started, log_dir} or raises RuntimeError when a session is
+    already active (the profiler is process-global — two sessions would
+    corrupt each other's capture)."""
+    global _prof_dir
+    with _prof_lock:
+        if _prof_dir is not None:
+            raise RuntimeError(
+                f"profiler already running (log_dir={_prof_dir!r}); "
+                f"POST /profiler/stop first")
+        import jax
+        jax.profiler.start_trace(log_dir)
+        _prof_dir = log_dir
+    return {"started": True, "log_dir": log_dir}
+
+
+def stop_profiler() -> Dict:
+    """Stop the active jax.profiler session; raises RuntimeError when
+    none is running."""
+    global _prof_dir
+    with _prof_lock:
+        if _prof_dir is None:
+            raise RuntimeError("no profiler session running")
+        import jax
+        d, _prof_dir = _prof_dir, None
+        jax.profiler.stop_trace()
+    return {"stopped": True, "log_dir": d}
+
+
+def profiler_status() -> Dict:
+    with _prof_lock:
+        return {"running": _prof_dir is not None, "log_dir": _prof_dir}

@@ -103,6 +103,11 @@ def render_prometheus(runtimes: Dict) -> str:
     fus_b = fam("siddhi_fused_batches_total", "counter",
                 "Micro-batches executed through @fuse dispatches, "
                 "per query")
+    mem = fam("siddhi_state_bytes", "gauge",
+              "Device-state bytes per query component (window buffers, "
+              "pattern slot blocks, selector slabs, tables, fuse "
+              "stacks) — computed from cached shape/dtype metadata, "
+              "never fetched")
 
     for app_name, rt in sorted(runtimes.items()):
         st = rt.stats
@@ -137,5 +142,12 @@ def render_prometheus(runtimes: Dict) -> str:
         buf_e.sample(rt.buffered_emissions(), app=app_name)
         for sid, n in sorted(rt.buffered_ingress().items()):
             buf_i.sample(n, app=app_name, stream=sid)
+        # state-memory accounting rides the scrape under the same
+        # invariant: memory.component_bytes walks shape/dtype metadata
+        # only (observability/memory.py), so this adds zero device work
+        from .memory import component_bytes
+        for owner, comps in sorted(component_bytes(rt).items()):
+            for comp, nb in sorted(comps.items()):
+                mem.sample(nb, app=app_name, query=owner, component=comp)
 
     return "\n".join(lines) + ("\n" if lines else "")

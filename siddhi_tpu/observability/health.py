@@ -1,0 +1,188 @@
+"""Health probes: readiness vs. liveness, stream staleness, backlog, and
+sliding-window drop/recompile rates.
+
+Reference (what): the reference's monitoring story distinguishes "the
+JVM answers" from "the app processes events" (isRunning + per-stream
+throughput gauges).  TPU design (how): against a remote accelerator the
+operator's first question about a stalled stream is *backlog problem or
+dead source?* — so `/healthz` reports, per stream, both the async-ingress
+backlog depth AND the last-event age, and classifies each stream from
+the pair.  Rates (drops, emission-cap growths, XLA recompiles) are
+reported over a sliding window sampled at probe time from the cumulative
+counters — a counter that jumped an hour ago must not keep a deployment
+red forever.
+
+Verdicts are distinct by design:
+
+- **live**: the engine's own threads (scheduler, emission drainer) are
+  running for every started app — restart-worthy when false.
+- **ready**: every app is started and accepting ingress (the snapshot
+  quiesce gate is open) — route-traffic-elsewhere-worthy when false,
+  e.g. during deploy or a long persist.
+
+Scrape-path invariant (same as exposition.py): probes read host-side
+counters, thread states, and queue depths only — never `device_get`,
+never a pytree fetch — so a flapping health checker can't stall a query
+step or pay a tunnel roundtrip.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, Optional, Tuple
+
+_WINDOW_S = 60.0
+
+
+class SlidingRate:
+    """Rate of a cumulative counter over a trailing window: each probe
+    appends (monotonic_t, value) and evicts samples older than the
+    window; the rate is the slope across the retained span."""
+
+    __slots__ = ("window_s", "samples")
+
+    def __init__(self, window_s: float = _WINDOW_S):
+        self.window_s = window_s
+        self.samples: deque = deque(maxlen=256)
+
+    def observe(self, value: float, now: Optional[float] = None) -> float:
+        t = time.monotonic() if now is None else now
+        self.samples.append((t, float(value)))
+        while len(self.samples) > 1 and \
+                t - self.samples[0][0] > self.window_s:
+            self.samples.popleft()
+        t0, v0 = self.samples[0]
+        span = t - t0
+        if span <= 0:
+            return 0.0
+        return max(0.0, (float(value) - v0) / span)
+
+
+def _rates_of(rt) -> Dict[str, SlidingRate]:
+    return rt.__dict__.setdefault("_health_rates", {})
+
+
+def _rate(rt, key: str, value: float) -> float:
+    rates = _rates_of(rt)
+    r = rates.get(key)
+    if r is None:
+        r = rates[key] = SlidingRate()
+    return r.observe(value)
+
+
+def _counter_sums(snap_counters: Dict[str, int]) -> Tuple[int, int]:
+    drops = sum(v for k, v in snap_counters.items()
+                if k.endswith(".dropped"))
+    growths = sum(v for k, v in snap_counters.items()
+                  if k.endswith(".cap_growths"))
+    return drops, growths
+
+
+def _threads_live(rt) -> Tuple[bool, Dict[str, bool]]:
+    """Engine-thread liveness of one app.  Only meaningful once started;
+    a deployed-but-stopped app is live (nothing should be running)."""
+    detail: Dict[str, bool] = {}
+    if not getattr(rt, "_started", False):
+        return True, detail
+    sched = getattr(getattr(rt, "_scheduler", None), "_thread", None)
+    if sched is not None:
+        detail["scheduler"] = bool(sched.is_alive())
+    drainer = getattr(rt, "_drainer", None)
+    # the drainer thread starts lazily on the first async emission: an
+    # idle drainer is healthy, a started-then-dead one is not
+    if drainer is not None and getattr(drainer, "_started", False):
+        t = getattr(drainer, "_thread", None)
+        detail["emission_drainer"] = t is not None and bool(t.is_alive())
+    return all(detail.values()) if detail else True, detail
+
+
+def app_health(rt, now_ms: Optional[int] = None) -> Dict:
+    """Health report for one SiddhiAppRuntime (host-side reads only)."""
+    now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+    started = bool(getattr(rt, "_started", False))
+    gate = getattr(rt, "_ingress_gate", None)
+    accepting = bool(gate.is_set()) if gate is not None else started
+    live, threads = _threads_live(rt)
+
+    st = rt.stats
+    snap = st.exposition_snapshot()
+    last_ms = snap.get("stream_last_ms", {})
+    backlog = rt.buffered_ingress()
+    streams: Dict[str, Dict] = {}
+    for sid in sorted(rt.junctions):
+        if sid.startswith("!"):
+            continue
+        seen = last_ms.get(sid)
+        age_s = (now_ms - seen) / 1e3 if seen else None
+        depth = int(backlog.get(sid, 0))
+        if depth > 0:
+            status = "backlogged"          # source alive, engine behind
+        elif seen is None:
+            status = "no-events" if st.enabled else "unknown"
+        elif age_s is not None and age_s > _WINDOW_S:
+            status = "idle"                # engine drained, source quiet
+        else:
+            status = "ok"
+        streams[sid] = {"last_event_age_s": age_s, "backlog": depth,
+                        "status": status}
+
+    drops, growths = _counter_sums(snap.get("counters", {}))
+    recompiles = sum(info["count"]
+                     for info in st.recompiles(rt).values())
+    report = {
+        "started": started,
+        "accepting_ingress": accepting,
+        "live": live,
+        "ready": started and accepting,
+        "threads": threads,
+        "streams": streams,
+        "buffered_emissions": rt.buffered_emissions(),
+        "rates_window_s": _WINDOW_S,
+        "dropped_per_s": round(_rate(rt, "dropped", drops), 6),
+        "cap_growths_per_s": round(_rate(rt, "cap_growths", growths), 6),
+        "recompiles_per_s": round(_rate(rt, "recompiles", recompiles), 6),
+        "totals": {"dropped": drops, "cap_growths": growths,
+                   "recompiles": recompiles},
+    }
+    return report
+
+
+def healthz(manager) -> Tuple[int, Dict]:
+    """(http_status, payload) for GET /healthz: 200 while every app's
+    engine threads live, 503 otherwise.  `ready` is reported separately —
+    route on it via /healthz/ready (503 while any app is deploying,
+    quiesced, or stopped)."""
+    apps = {}
+    live = True
+    ready = True
+    for name, rt in sorted(getattr(manager, "runtimes", {}).items()):
+        try:
+            rep = app_health(rt)
+        except Exception as exc:  # noqa: BLE001 — probe must not throw
+            rep = {"error": repr(exc), "live": False, "ready": False}
+        apps[name] = rep
+        live = live and bool(rep.get("live"))
+        ready = ready and bool(rep.get("ready"))
+    payload = {
+        "status": "ok" if live else "unhealthy",
+        "live": live,
+        "ready": ready,
+        "apps": apps,
+    }
+    return (200 if live else 503), payload
+
+
+def readiness(manager) -> Tuple[int, Dict]:
+    """(http_status, payload) for GET /healthz/ready: 200 only when every
+    deployed app is started and accepting ingress."""
+    code, payload = healthz(manager)
+    ok = payload["ready"] and payload["live"]
+    return (200 if ok else 503), {"ready": ok,
+                                  "live": payload["live"],
+                                  "apps": payload["apps"]}
+
+
+def liveness(manager) -> Tuple[int, Dict]:
+    """(http_status, payload) for GET /healthz/live."""
+    code, payload = healthz(manager)
+    return code, {"live": payload["live"]}

@@ -52,6 +52,9 @@ def signature_of(args) -> str:
     return s
 
 
+_suppress_tls = threading.local()
+
+
 class RecompileRegistry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -59,7 +62,31 @@ class RecompileRegistry:
         self._sigs: Dict[str, deque] = {}
         self._last_ms: Dict[str, int] = {}
 
+    @staticmethod
+    def suppressed() -> bool:
+        """True while this thread is inside a diagnostic re-trace (EXPLAIN
+        lowering a step for cost analysis) — those traces are not real
+        recompiles and must not inflate the per-owner counters."""
+        return getattr(_suppress_tls, "on", False)
+
+    @staticmethod
+    def suppress():
+        """Context manager marking this thread's traces as diagnostic."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            prev = getattr(_suppress_tls, "on", False)
+            _suppress_tls.on = True
+            try:
+                yield
+            finally:
+                _suppress_tls.on = prev
+        return _cm()
+
     def record(self, owner: str, args) -> None:
+        if getattr(_suppress_tls, "on", False):
+            return
         sig = signature_of(args)
         with self._lock:
             self._counts[owner] = self._counts.get(owner, 0) + 1

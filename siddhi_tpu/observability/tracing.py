@@ -29,6 +29,34 @@ from typing import Dict, List, Optional
 _tls = threading.local()
 _ids = itertools.count(1)
 
+# span-meta caps: DETAIL tracing on queries with large pattern metadata
+# must not grow ring-buffer entries unboundedly — values clamp to a
+# bounded repr and a span keeps at most _MAX_META_KEYS entries
+_MAX_META_KEYS = 16
+_MAX_META_CHARS = 200
+_MAX_SPANS = 512
+
+
+def _clamp_value(v):
+    if v is None or isinstance(v, (bool, int, float)):
+        return v
+    s = v if isinstance(v, str) else repr(v)
+    if len(s) > _MAX_META_CHARS:
+        return s[:_MAX_META_CHARS] + f"...(+{len(s) - _MAX_META_CHARS})"
+    return s
+
+
+def _clamp_meta(meta: Dict) -> Dict:
+    if not meta:
+        return meta
+    out = {}
+    for i, (k, v) in enumerate(meta.items()):
+        if i >= _MAX_META_KEYS:
+            out["meta_truncated"] = len(meta) - _MAX_META_KEYS
+            break
+        out[str(k)[:64]] = _clamp_value(v)
+    return out
+
 
 class Span:
     __slots__ = ("stage", "start_ns", "end_ns", "meta")
@@ -62,15 +90,22 @@ class BatchTrace:
 
     def add_span(self, stage: str, start_ns: int, end_ns: int,
                  meta: Dict) -> None:
-        self.spans.append(Span(stage, start_ns, end_ns, meta))
+        # bounded entries: meta values clamp to a bounded repr and a
+        # runaway dispatch (re-ingestion loop) can't make one trace hold
+        # unlimited spans
+        if len(self.spans) >= _MAX_SPANS:
+            return
+        self.spans.append(Span(stage, start_ns, end_ns, _clamp_meta(meta)))
 
     def queries(self) -> List[str]:
-        return sorted({s.meta["query"] for s in self.spans
+        return sorted({s.meta["query"] for s in tuple(self.spans)
                        if "query" in s.meta})
 
     def to_dict(self) -> Dict:
         spans = []
-        for s in self.spans:
+        # snapshot the list: a trace being finished on another thread
+        # must not interleave half-written span entries into the dump
+        for s in tuple(self.spans):
             d = s.to_dict()
             d["offset_us"] = (s.start_ns - self.start_ns) / 1e3
             spans.append(d)
@@ -136,16 +171,18 @@ class PipelineTracer:
     def dump(self, query: Optional[str] = None,
              limit: int = 64) -> List[Dict]:
         """Newest-first trace dicts, optionally only those that touched
-        `query` (matched against span `query=` metadata)."""
-        with self._lock:
-            traces = list(self._ring)
+        `query` (matched against span `query=` metadata).  The dict
+        conversion runs under the ring lock so a dump taken under churn
+        is one consistent snapshot — concurrent finish() appends (which
+        also take the lock) can never interleave into it."""
         out = []
-        for tr in reversed(traces):
-            if query is not None and query not in tr.queries():
-                continue
-            out.append(tr.to_dict())
-            if len(out) >= limit:
-                break
+        with self._lock:
+            for tr in reversed(self._ring):
+                if query is not None and query not in tr.queries():
+                    continue
+                out.append(tr.to_dict())
+                if len(out) >= limit:
+                    break
         return out
 
     def clear(self) -> None:

@@ -22,6 +22,22 @@ Endpoints (JSON in/out):
                                                traces touching <query>
                                                (searched across apps)
   GET    /siddhi-apps/<name>/trace/<query>  -> same, one app
+  GET    /trace.json                        -> the trace ring as Chrome
+                                               trace-event JSON — opens
+                                               directly in Perfetto /
+                                               chrome://tracing
+  GET    /siddhi-apps/<name>/explain/<query> -> EXPLAIN: operator tree +
+                                               per-step XLA cost analysis,
+                                               state bytes, fusion
+                                               eligibility (?deep=0 skips
+                                               the compile for memory
+                                               analysis)
+  GET    /healthz                           -> liveness+readiness verdicts
+                                               (200 live / 503 not); also
+                                               /healthz/live, /healthz/ready
+  POST   /profiler/start  body={"log_dir"?} -> start a guarded jax.profiler
+                                               session (409 if running)
+  POST   /profiler/stop                     -> stop it (409 if not running)
   GET    /health                            -> {"status": "ok"}
 """
 from __future__ import annotations
@@ -33,6 +49,13 @@ from typing import Optional
 
 from .core.runtime import SiddhiManager
 from .exceptions import SiddhiError
+
+
+def _qparam(query_str: str, name: str) -> Optional[str]:
+    """First value of a URL query parameter, or None."""
+    from urllib.parse import parse_qs
+    vals = parse_qs(query_str).get(name)
+    return vals[0] if vals else None
 
 
 class SiddhiRestService:
@@ -70,9 +93,41 @@ class SiddhiRestService:
 
             def do_GET(self):
                 try:
-                    parts = [p for p in self.path.split("/") if p]
+                    path, _, query_str = self.path.partition("?")
+                    parts = [p for p in path.split("/") if p]
                     if parts == ["health"]:
                         self._json(200, {"status": "ok"})
+                    elif parts and parts[0] == "healthz":
+                        # readiness vs. liveness are distinct verdicts:
+                        # /healthz/live restarts pods, /healthz/ready
+                        # gates traffic (observability/health.py)
+                        from .observability import health as _health
+                        if parts == ["healthz", "live"]:
+                            code, payload = _health.liveness(svc.manager)
+                        elif parts == ["healthz", "ready"]:
+                            code, payload = _health.readiness(svc.manager)
+                        else:
+                            code, payload = _health.healthz(svc.manager)
+                        self._json(code, payload)
+                    elif parts == ["trace.json"]:
+                        # Chrome trace-event JSON of the pipeline-trace
+                        # ring — loads directly in Perfetto
+                        from .observability.chrome_trace import \
+                            chrome_trace
+                        q = _qparam(query_str, "query")
+                        self._json(200, chrome_trace(
+                            svc.manager.runtimes, q))
+                    elif len(parts) == 4 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "explain":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                        elif parts[3] not in rt.query_runtimes:
+                            self._json(404, {"error": "no such query"})
+                        else:
+                            deep = _qparam(query_str, "deep") != "0"
+                            self._json(200, rt.explain(parts[3],
+                                                       deep=deep))
                     elif parts == ["metrics"]:
                         # Prometheus scrape endpoint (text format 0.0.4);
                         # never touches the device — see observability/
@@ -114,6 +169,24 @@ class SiddhiRestService:
             def do_POST(self):
                 try:
                     parts = [p for p in self.path.split("/") if p]
+                    if len(parts) == 2 and parts[0] == "profiler":
+                        # guarded jax.profiler session for device-level
+                        # deep dives; one at a time, never implicit
+                        from .observability.chrome_trace import (
+                            start_profiler, stop_profiler)
+                        try:
+                            if parts[1] == "start":
+                                req = json.loads(self._body() or b"{}")
+                                self._json(200, start_profiler(
+                                    req.get("log_dir",
+                                            "/tmp/siddhi_tpu_profile")))
+                            elif parts[1] == "stop":
+                                self._json(200, stop_profiler())
+                            else:
+                                self._json(404, {"error": "unknown path"})
+                        except RuntimeError as exc:
+                            self._json(409, {"error": str(exc)})
+                        return
                     if parts == ["siddhi-apps"]:
                         ql = self._body().decode()
                         from .compiler import SiddhiCompiler

@@ -34,6 +34,9 @@ class StatisticsManager:
         self.include = [p.strip() for p in include.split(",") if p.strip()]
         self._lock = threading.Lock()
         self._stream_in: Dict[str, int] = {}
+        # wall-clock ms of the last batch seen per stream — the /healthz
+        # last-event-age probe reads this instead of touching junctions
+        self._stream_last_ms: Dict[str, int] = {}
         self._query_events: Dict[str, int] = {}
         self._query_hist: Dict[str, LogHistogram] = {}
         self._junction_hist: Dict[str, LogHistogram] = {}
@@ -62,6 +65,7 @@ class StatisticsManager:
         with self._lock:
             self._stream_in[stream_id] = \
                 self._stream_in.get(stream_id, 0) + n
+            self._stream_last_ms[stream_id] = int(time.time() * 1000)
 
     def query_latency(self, name: str, n: int, elapsed_ns: int) -> None:
         hist_of(self._query_hist, name, self._lock).record(elapsed_ns)
@@ -131,6 +135,7 @@ class StatisticsManager:
             return {
                 "uptime_s": max(time.time() - self._start, 1e-9),
                 "stream_in": dict(self._stream_in),
+                "stream_last_ms": dict(self._stream_last_ms),
                 "query_events": dict(self._query_events),
                 "query_hist": dict(self._query_hist),
                 "junction_hist": dict(self._junction_hist),
@@ -217,6 +222,7 @@ class StatisticsManager:
     def reset(self) -> None:
         with self._lock:
             self._stream_in.clear()
+            self._stream_last_ms.clear()
             self._query_events.clear()
             self._query_hist.clear()
             self._junction_hist.clear()
@@ -257,15 +263,39 @@ class ConsoleReporter:
         if t is not None and t.is_alive():
             t.join(timeout=2.0)
 
+    @staticmethod
+    def _quantile_lines(rep: Dict) -> list:
+        """Compact per-query tail-latency lines for the periodic report:
+        p50/p95/p99/max from the log2 histograms (averages hide recompile
+        stalls — the TPU failure mode), with the drop and cap-growth
+        counters that flag capped emissions right where the operator is
+        already looking."""
+        ctr = rep.get("counters", {})
+        lines = []
+        for name, q in sorted(rep.get("queries", {}).items()):
+            if "p50_us" not in q:
+                continue
+            lines.append(
+                f"query {name}: n={q['events']} "
+                f"p50={q['p50_us']:.0f}us p95={q['p95_us']:.0f}us "
+                f"p99={q['p99_us']:.0f}us "
+                f"max={q['max_latency_ms']:.1f}ms "
+                f"drops={ctr.get(name + '.dropped', 0)} "
+                f"cap_growths={ctr.get(name + '.cap_growths', 0)}")
+        return lines
+
     def _run(self) -> None:
         import json
         while not self._stop.wait(self.interval_s):
             try:
-                line = json.dumps(self.app.statistics(), default=str)
-                if self.out is not None:
-                    self.out(line)
-                else:
-                    print(f"[siddhi-stats] {line}", flush=True)
+                rep = self.app.statistics()
+                out = self.out if self.out is not None else \
+                    (lambda s: print(f"[siddhi-stats] {s}", flush=True))
+                # first line stays machine-readable JSON (scrapers parse
+                # it); the quantile summary lines follow for humans
+                out(json.dumps(rep, default=str))
+                for line in self._quantile_lines(rep):
+                    out(line)
             except Exception as exc:  # noqa: BLE001 — reporter must not die
                 # rate-limited warning instead of a silent swallow: a
                 # reporter that dies quietly looks like a healthy app with
