@@ -1,0 +1,96 @@
+"""Lint smoke test: run the static analyzer over the whole sample app
+corpus (`samples/apps/*.siddhi`) asserting zero ERROR findings, exercise
+the CLI exit-code contract on a deliberately hazardous app, then deploy
+an app behind the REST service and assert `GET /siddhi-apps/<app>/lint`,
+`runtime.analyze()`, and the findings echoed into EXPLAIN all agree.
+Run via `make lint-smoke` (smoke-test family of the static-analysis
+layer; see README "Static analysis")."""
+import glob
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+sys.path.insert(0, ".")
+
+from siddhi_tpu.service import SiddhiRestService   # noqa: E402
+from siddhi_tpu.tools.lint import main as lint_main  # noqa: E402
+
+BAD_APP = """
+define stream S (sym string, v long);
+@info(name='leaky') @fuse(batches='4')
+from every e1=S -> e2=S[v > e1.v and v > 1.5]
+select e1.sym as sym
+insert into Out;
+"""
+
+REST_APP = """@app:name('LintApp')
+define stream Trades (symbol string, price double, volume long);
+@info(name='tw') @fuse(batches='8')
+from Trades#window.time(10 sec)
+select symbol, avg(price) as ap
+group by symbol insert into Avgs;
+"""
+
+
+def main() -> int:
+    # 1. the shipped corpus lints clean (exit 0, zero ERROR findings)
+    apps = sorted(glob.glob(os.path.join("samples", "apps", "*.siddhi")))
+    assert apps, "no sample apps found (run from the repo root)"
+    rc = lint_main(apps)
+    assert rc == 0, f"sample corpus should lint clean, exit={rc}"
+
+    # 2. exit-code contract on a hazardous app: clean at the default
+    # --fail-on error, failing at --fail-on warn
+    with tempfile.NamedTemporaryFile("w", suffix=".siddhi",
+                                     delete=False) as fh:
+        fh.write(BAD_APP)
+        bad = fh.name
+    try:
+        assert lint_main([bad]) == 0, "WARN findings must not fail " \
+            "the default error threshold"
+        assert lint_main([bad, "--fail-on", "warn"]) == 1, \
+            "--fail-on warn must fail on STATE001/FUSE001"
+        assert lint_main(["/nonexistent.siddhi"]) == 2
+    finally:
+        os.unlink(bad)
+
+    # 3. REST surface: deployed app's lint reflects its compiled plans
+    svc = SiddhiRestService().start()
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        req = urllib.request.Request(f"{base}/siddhi-apps",
+                                     data=REST_APP.encode(),
+                                     method="POST")
+        assert urllib.request.urlopen(req).status == 201, "deploy failed"
+        rep = json.loads(urllib.request.urlopen(
+            f"{base}/siddhi-apps/LintApp/lint").read().decode())
+        rules = {f["rule"] for f in rep["findings"]}
+        assert "FUSE001" in rules, f"@fuse on a time window must be " \
+            f"flagged, got {rules}"
+        fuse = next(f for f in rep["findings"] if f["rule"] == "FUSE001")
+        assert "timer" in fuse["message"], fuse
+
+        rt = svc.manager.runtimes["LintApp"]
+        assert rt.analyze()["findings"] == rep["findings"], \
+            "REST and runtime.analyze() must agree"
+        exp = rt.explain("tw", deep=False)
+        assert "FUSE001" in {f["rule"] for f in exp["findings"]}, \
+            "explain must echo the lint findings"
+        hz = json.loads(urllib.request.urlopen(
+            f"{base}/healthz").read().decode())
+        excl = hz["apps"]["LintApp"]["fusion_exclusions"]
+        assert "tw" in excl and excl["tw"] == \
+            exp["fusion"]["exclusion_reason"], \
+            "healthz and explain must share the exclusion reason"
+        print(f"lint-smoke OK: {len(apps)} corpus apps clean, "
+              f"exit-code contract holds, REST/analyze/explain/healthz "
+              f"agree on {fuse['message']!r}")
+        return 0
+    finally:
+        svc.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""Static plan analyzer (`siddhi-lint`): rule-based TPU-hazard detection
+over the parsed AST and planned-query dataclasses, without executing or
+tracing anything.
+
+Reference (what): the reference validates apps structurally at parse
+time (SiddhiAppValidator) but has no hazard lint; everything
+TPU-specific in this engine — unbounded pattern state, fusion-ineligible
+@fuse, emission-cap truncation, device-state blowup — previously
+surfaced only at runtime through the observability layer.  TPU design
+(how): the plan IS static here (state shapes, caps, and step wiring are
+all decided before the first event), so a pre-deploy pass can read the
+same plan facts explain() reports and flag the hazard before CI ships
+the app.
+
+Surfaces: `python -m siddhi_tpu.tools.lint app.siddhi`,
+`runtime.analyze()`, `GET /siddhi-apps/<app>/lint`, and findings echoed
+into `explain()` reports.
+"""
+from .driver import analyze, report
+from .findings import ERROR, INFO, SEVERITIES, WARN, Finding, counts, \
+    severity_rank
+from .registry import RULES, LintConfig, Rule, catalog, rule
+
+__all__ = [
+    "analyze", "report", "Finding", "counts", "severity_rank",
+    "INFO", "WARN", "ERROR", "SEVERITIES",
+    "RULES", "Rule", "rule", "catalog", "LintConfig",
+]

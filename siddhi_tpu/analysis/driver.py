@@ -1,0 +1,64 @@
+"""`analyze()` driver: source text / parsed app / live runtime → findings.
+
+The driver builds one AnalysisContext and runs the rule registry over
+it.  It never executes, traces, or compiles anything: the static path
+is a pure AST walk; the runtime path reads plan attributes and
+shape/dtype metadata (see facts.py).  `tests/test_lint.py` enforces
+this by monkeypatching `jax.jit` and `jax.device_get` over a full run.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+from ..query_api.app import SiddhiApp
+from .facts import AnalysisContext, facts_from_app, facts_from_runtime
+from .findings import Finding, counts
+from .registry import LintConfig, run_rules
+
+# load the built-in rule set into the registry on first import
+from . import rules as _rules  # noqa: F401  (import-for-side-effect)
+
+
+def analyze(target: Union[str, SiddhiApp, object],
+            config: Optional[LintConfig] = None,
+            source_name: Optional[str] = None) -> List[Finding]:
+    """Run every enabled lint rule over `target` and return findings,
+    most severe first.
+
+    target: SiddhiQL source text, a parsed SiddhiApp, or a live
+    SiddhiAppRuntime.  Source/app analysis derives plan facts
+    statically; a runtime contributes its actual compiled-plan facts
+    (real emission caps, measured state bytes, mesh-aware fusion
+    exclusions) — still without executing or tracing anything.
+    """
+    config = config or LintConfig()
+    runtime = None
+    if isinstance(target, str):
+        from ..compiler import SiddhiCompiler
+        app = SiddhiCompiler.parse(target)
+        queries = facts_from_app(app)
+    elif isinstance(target, SiddhiApp):
+        app = target
+        queries = facts_from_app(app)
+    elif hasattr(target, "query_runtimes") and hasattr(target, "app"):
+        runtime = target
+        app = target.app
+        queries = facts_from_runtime(target)
+    else:
+        raise TypeError(
+            "analyze() takes SiddhiQL source, a SiddhiApp, or a "
+            f"SiddhiAppRuntime, not {type(target).__name__}")
+    ctx = AnalysisContext(
+        app=app, queries=queries, config=config,
+        source_name=source_name or (app.name and f"<{app.name}>")
+        or "<app>",
+        runtime=runtime)
+    return run_rules(ctx, config)
+
+
+def report(findings: List[Finding]) -> dict:
+    """JSON-able report: the REST surface and `--format json` share it."""
+    return {
+        "findings": [f.to_dict() for f in findings],
+        "counts": counts(findings),
+    }

@@ -1,0 +1,447 @@
+"""Per-query plan facts for the static analyzer.
+
+Two builders produce the same `QueryFacts` shape:
+
+- `facts_from_app(app)` — pure AST walk plus a *static mini-planner*
+  that predicts the facts the real planner would compute (window
+  processor class and its `needs_timer`, key/slot capacities, the
+  emission-cap sentinel, shape×dtype state-byte estimates) without
+  constructing a runtime or touching jax.  Fusion-exclusion reasons are
+  NOT re-derived: a shim `planned` carrying the statically-known
+  properties is fed through the real `core.fusion.ineligible_reason`,
+  so lint reports the exact string the wiring would log at first
+  dispatch.
+
+- `facts_from_runtime(rt)` — reads the *actual* planned-query
+  dataclasses of a live SiddhiAppRuntime: `describe()` plan facts,
+  `core.plan_facts.fusion_exclusion`, and the metadata-only
+  `observability.memory` accounting.  Attribute and shape/dtype reads
+  only — analysis never executes, traces, or fetches (the lint guard
+  test monkeypatches `jax.jit`/`jax.device_get` over a full run).
+
+Query naming mirrors `SiddhiAppRuntime._query_name` exactly (`@info`
+name, else `query<i>` numbered across top-level queries and partition
+bodies), so findings join against explain/metrics/healthz by name.
+"""
+from __future__ import annotations
+
+import dataclasses
+import types
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..query_api.app import SiddhiApp
+from ..query_api.definition import AbstractDefinition
+from ..query_api.query import (
+    AbsentStreamStateElement,
+    CountStateElement,
+    EveryStateElement,
+    JoinInputStream,
+    LogicalStateElement,
+    NextStateElement,
+    Partition,
+    Query,
+    RangePartitionType,
+    StateInputStream,
+    StreamStateElement,
+    Window,
+)
+
+# mirrors of the planner/runtime defaults (planner.plan_single_query,
+# runtime._add_query/_add_partition) — the static estimates must predict
+# what those paths would build
+_BATCH_CAPACITY = 512
+_WINDOW_HINT = 2048
+_PARTITION_WINDOW_HINT = 128
+_PARTITION_KEYS = 4096
+_NFA_SLOTS = 8
+# columnar buffer overhead per row beyond the payload columns:
+# ts i64 + seq i64 + gslot i32 + alive bool (core/window.py empty_buffer)
+_ROW_OVERHEAD = 8 + 8 + 4 + 1
+
+
+@dataclasses.dataclass
+class QueryFacts:
+    """What the analyzer knows about one query, from either builder."""
+
+    name: str
+    query: Query
+    kind: str                           # plain | pattern | join
+    origin: str = "static"              # static | planned
+    partition: Optional[Partition] = None
+    needs_timer: bool = False
+    keyed_window: bool = False
+    fuse_requested: int = 0
+    fusion_exclusion: Optional[str] = None
+    # rendered emission cap (None = uncapped / capacity-bounded)
+    emission_cap: Optional[int] = None
+    emission_cap_explicit: bool = False
+    # per-query device state, bytes (shape×dtype arithmetic)
+    state_bytes: Optional[int] = None
+    state_bytes_origin: str = "estimated"   # estimated | measured
+    key_capacity: int = 1
+    nfa_slots: int = _NFA_SLOTS
+    # join sides: (left rows, right rows) worst-case resident window rows
+    join_side_rows: Optional[Tuple[int, int]] = None
+
+    def pos(self) -> Optional[Tuple[int, int]]:
+        return getattr(self.query, "pos", None)
+
+
+@dataclasses.dataclass
+class AnalysisContext:
+    """Everything a rule may look at."""
+
+    app: SiddhiApp
+    queries: List[QueryFacts]
+    config: Any = None                  # registry.LintConfig
+    source_name: str = "<app>"
+    runtime: Any = None                 # live SiddhiAppRuntime, or None
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers (used by facts builders AND rules)
+# ---------------------------------------------------------------------------
+
+def iter_named_queries(app: SiddhiApp):
+    """(name, query, partition|None) with runtime-identical naming."""
+    qi = 0
+
+    def name_of(q: Query) -> str:
+        info = q.get_annotation("info")
+        if info:
+            n = info.element("name")
+            if n:
+                return n
+        return f"query{qi + 1}"
+
+    for element in app.execution_element_list:
+        if isinstance(element, Query):
+            yield name_of(element), element, None
+            qi += 1
+        elif isinstance(element, Partition):
+            for q in element.query_list:
+                yield name_of(q), q, element
+                qi += 1
+
+
+def window_handler(sis) -> Optional[Window]:
+    for h in getattr(sis, "stream_handlers", ()):
+        if isinstance(h, Window):
+            return h
+    return None
+
+
+def pattern_atoms(el):
+    """Flat list of the stream/absent atoms of a state-element tree."""
+    out = []
+
+    def rec(e):
+        if isinstance(e, (StreamStateElement, AbsentStreamStateElement)):
+            out.append(e)
+        elif isinstance(e, CountStateElement):
+            rec(e.stream_state_element)
+        elif isinstance(e, LogicalStateElement):
+            rec(e.stream_state_element_1)
+            rec(e.stream_state_element_2)
+        elif isinstance(e, NextStateElement):
+            rec(e.state_element)
+            rec(e.next_state_element)
+        elif isinstance(e, EveryStateElement):
+            rec(e.state_element)
+
+    rec(el)
+    return out
+
+
+def window_needs_timer(win: Optional[Window]) -> bool:
+    """needs_timer of the processor class the planner would pick —
+    resolved from the live WINDOW_TYPES registry, never re-listed here."""
+    if win is None:
+        return False
+    from ..core.window import WINDOW_TYPES
+    full = (win.namespace + ":" if win.namespace else "") + win.name
+    cls = WINDOW_TYPES.get(full)
+    return bool(getattr(cls, "needs_timer", False)) if cls else False
+
+
+def _row_bytes(sdef: Optional[AbstractDefinition]) -> int:
+    """Bytes per buffered window row: payload columns (device dtypes via
+    event.dtype_of — STRING is an interned i32, DOUBLE an f32 on TPU)
+    plus the fixed Buffer bookkeeping columns."""
+    from ..core import event as ev
+    n = _ROW_OVERHEAD
+    for a in getattr(sdef, "attribute_list", ()):
+        try:
+            n += int(np.dtype(ev.dtype_of(a.type)).itemsize)
+        except Exception:  # noqa: BLE001 — OBJECT columns etc.
+            n += 8
+    return n
+
+
+def window_capacity(win: Optional[Window], hint: int) -> int:
+    """Resident-row capacity the planner would give this window: the
+    first non-time integer parameter (length/lengthBatch/sort/... row
+    counts), else the capacity hint time-based windows are built with."""
+    if win is None:
+        return _BATCH_CAPACITY
+    from ..query_api.expression import Constant
+    for p in win.parameters:
+        if isinstance(p, Constant) and p.type in ("INT", "LONG") and \
+                not getattr(p, "is_time", False):
+            return max(1, int(p.value))
+    return hint
+
+
+def capacity_annotation(q: Query, part: Optional[Partition]
+                        ) -> Dict[str, int]:
+    """@capacity(keys=, slots=, window=) merged across the query and its
+    partition (runtime._add_partition scans both)."""
+    out: Dict[str, int] = {}
+    anns = list(q.annotations)
+    if part is not None:
+        anns += list(part.annotations)
+        for pq in part.query_list:
+            anns += list(pq.annotations)
+    for ann in anns:
+        if ann.name.lower() == "capacity":
+            for k in ("keys", "slots", "window"):
+                v = ann.element(k)
+                if v is not None:
+                    out[k] = int(v)
+    return out
+
+
+def fuse_requested(app: SiddhiApp, q: Query) -> int:
+    """Static mirror of runtime._fuse_enabled: @fuse on the query, any
+    input stream definition, or @app:fuse.  Returns K (0 = off)."""
+    ann = q.get_annotation("fuse")
+    if ann is None:
+        ist = q.input_stream
+        sids = getattr(ist, "all_stream_ids", None) or \
+            [getattr(ist, "stream_id", None)]
+        for sid in sids:
+            sdef = app.stream_definition_map.get(sid)
+            if sdef is not None and \
+                    sdef.get_annotation("fuse") is not None:
+                ann = sdef.get_annotation("fuse")
+                break
+    if ann is None:
+        ann = app.get_annotation("app:fuse")
+    if ann is None:
+        return 0
+    k = ann.element("batches", ann.element(None, 8)) or 8
+    return max(1, int(k))
+
+
+def emit_annotation_rows(q: Query) -> Optional[int]:
+    ann = q.get_annotation("emit")
+    if ann is None:
+        return None
+    v = ann.element("rows")
+    return int(v) if v is not None else None
+
+
+def query_kind(q: Query) -> str:
+    if isinstance(q.input_stream, JoinInputStream):
+        return "join"
+    if isinstance(q.input_stream, StateInputStream):
+        return "pattern"
+    return "plain"
+
+
+# ---------------------------------------------------------------------------
+# static path
+# ---------------------------------------------------------------------------
+
+def _static_exclusion(app: SiddhiApp, q: Query, kind: str,
+                      part: Optional[Partition],
+                      needs_timer: bool, keyed: bool) -> Optional[str]:
+    """Feed statically-known plan properties through the REAL
+    core.fusion.ineligible_reason via a shim `planned`, so the string
+    lint prints is the one the wiring would log.  Mesh sharding is a
+    deploy-time property (unknowable from source), so the static path
+    assumes unsharded — the runtime path reports the sharded reasons."""
+    from ..core import fusion
+    ist = q.input_stream
+    present = object()      # stands in for "this step/body exists"
+    if kind == "plain":
+        range_part = part is not None and any(
+            isinstance(pt, RangePartitionType)
+            for pt in part.partition_type_map.values())
+        planned = types.SimpleNamespace(
+            needs_timer=needs_timer, keyed_window=keyed,
+            partition_key_fn=present if range_part else None,
+            raw_step=present)
+    elif kind == "pattern":
+        has_absent = any(
+            isinstance(a, AbsentStreamStateElement)
+            for a in pattern_atoms(ist.state_element))
+        planned = types.SimpleNamespace(
+            timer_step=present if has_absent else None,
+            partition_positions={"_": [0]} if part is not None else None,
+            mesh=None, step_bodies=present)
+    else:
+        planned = types.SimpleNamespace(
+            needs_timer=needs_timer,
+            step_left=present, raw_left=present,
+            step_right=present, raw_right=present)
+    try:
+        return fusion.ineligible_reason(
+            types.SimpleNamespace(planned=planned), kind)
+    except Exception:  # noqa: BLE001 — a shim gap must not kill lint
+        return None
+
+
+def _static_state_bytes(app: SiddhiApp, q: Query, kind: str,
+                        part: Optional[Partition], caps: Dict[str, int],
+                        keys: int) -> Optional[int]:
+    """Shape×dtype estimate of the device state the planner would
+    allocate (windows and NFA slot blocks; group-by slabs are bounded
+    and small by comparison)."""
+    defs = app.stream_definition_map
+
+    def stream_def(sid):
+        return defs.get(sid) or app.window_definition_map.get(sid)
+
+    hint = caps.get(
+        "window",
+        _PARTITION_WINDOW_HINT if part is not None else _WINDOW_HINT)
+    if kind == "plain":
+        win = window_handler(q.input_stream)
+        if win is None:
+            return None
+        rows = window_capacity(win, hint)
+        per_key = rows * _row_bytes(stream_def(q.input_stream.stream_id))
+        return per_key * (keys if part is not None else 1)
+    if kind == "join":
+        total = 0
+        for sis in (q.input_stream.left_input_stream,
+                    q.input_stream.right_input_stream):
+            win = window_handler(sis)
+            if win is not None:
+                total += window_capacity(win, _WINDOW_HINT) * \
+                    _row_bytes(stream_def(sis.stream_id))
+        return total or None
+    # pattern: per-key NFA slot block — `slots` pending matches per key,
+    # each capturing one row per pattern state
+    atoms = pattern_atoms(q.input_stream.state_element)
+    slots = caps.get("slots", _NFA_SLOTS)
+    per_state = max(
+        (_row_bytes(stream_def(a.basic_single_input_stream.stream_id))
+         for a in atoms), default=_ROW_OVERHEAD)
+    return (keys if part is not None else 1) * slots * \
+        max(1, len(atoms)) * per_state
+
+
+def facts_from_app(app: SiddhiApp) -> List[QueryFacts]:
+    out: List[QueryFacts] = []
+    for name, q, part in iter_named_queries(app):
+        kind = query_kind(q)
+        caps = capacity_annotation(q, part)
+        keys = caps.get("keys", _PARTITION_KEYS)
+        win = None
+        if kind == "plain":
+            win = window_handler(q.input_stream)
+            needs_timer = window_needs_timer(win)
+            session_keyed = win is not None and win.name == "session" \
+                and len(win.parameters) >= 2
+            keyed = session_keyed or (part is not None and win is not None)
+        elif kind == "join":
+            needs_timer = any(
+                window_needs_timer(window_handler(s))
+                for s in (q.input_stream.left_input_stream,
+                          q.input_stream.right_input_stream))
+            keyed = False
+        else:
+            needs_timer = any(
+                isinstance(a, AbsentStreamStateElement)
+                for a in pattern_atoms(q.input_stream.state_element))
+            keyed = False
+
+        from ..core.plan_facts import UNCAPPED_SENTINEL, render_cap
+        emit_rows = emit_annotation_rows(q)
+        cap = None
+        explicit = emit_rows is not None
+        if kind == "pattern":
+            cap = render_cap(
+                emit_rows if explicit
+                else (8 if part is not None else UNCAPPED_SENTINEL))
+        elif kind == "join":
+            cap = render_cap(emit_rows) if explicit else None
+
+        k = fuse_requested(app, q)
+        f = QueryFacts(
+            name=name, query=q, kind=kind, origin="static",
+            partition=part, needs_timer=needs_timer, keyed_window=keyed,
+            fuse_requested=k,
+            fusion_exclusion=_static_exclusion(
+                app, q, kind, part, needs_timer, keyed) if k else None,
+            emission_cap=cap, emission_cap_explicit=explicit,
+            state_bytes=_static_state_bytes(app, q, kind, part, caps,
+                                            keys),
+            state_bytes_origin="estimated",
+            key_capacity=keys if (part is not None or keyed) else 1,
+            nfa_slots=caps.get("slots", _NFA_SLOTS),
+        )
+        if kind == "join":
+            defs = app.stream_definition_map
+            sides = []
+            for sis in (q.input_stream.left_input_stream,
+                        q.input_stream.right_input_stream):
+                w = window_handler(sis)
+                sides.append(window_capacity(w, _WINDOW_HINT)
+                             if w is not None else _BATCH_CAPACITY)
+            f.join_side_rows = (sides[0], sides[1])
+        out.append(f)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# planned (live runtime) path
+# ---------------------------------------------------------------------------
+
+def facts_from_runtime(rt) -> List[QueryFacts]:
+    """QueryFacts from a live runtime's compiled plans.  Reads
+    `describe()` dicts, plan attributes, and metadata-only state-byte
+    accounting — never executes, traces, or fetches device data."""
+    from ..core.plan_facts import fusion_exclusion, render_cap
+    from ..observability.memory import query_component_bytes
+
+    static_by_name = {f.name: f for f in facts_from_app(rt.app)}
+    out: List[QueryFacts] = []
+    for name, qr in sorted(rt.query_runtimes.items()):
+        q = getattr(qr, "_query_ast", None)
+        kind = getattr(qr, "_kind", None) or "plain"
+        p = qr.planned
+        try:
+            desc = p.describe()
+        except Exception:  # noqa: BLE001 — diagnostics must not throw
+            desc = {}
+        comp = query_component_bytes(qr)
+        sf = static_by_name.get(name)
+        fb = getattr(qr, "_fuse", None)
+        f = QueryFacts(
+            name=name,
+            query=q if q is not None else Query(),
+            kind=kind, origin="planned",
+            partition=sf.partition if sf is not None else None,
+            needs_timer=bool(desc.get("needs_timer",
+                                      getattr(p, "needs_timer", False))),
+            keyed_window=bool(getattr(p, "keyed_window", False)),
+            fuse_requested=(fb.k if fb is not None
+                            else getattr(qr, "_fuse_requested", 0)),
+            fusion_exclusion=fusion_exclusion(qr),
+            emission_cap=render_cap(getattr(p, "compact_rows", None)),
+            emission_cap_explicit=bool(getattr(p, "emit_explicit",
+                                               False)),
+            state_bytes=sum(comp.values()) if comp else None,
+            state_bytes_origin="measured",
+            key_capacity=int(getattr(p, "key_capacity", 0) or 1),
+            nfa_slots=int(getattr(p, "slots", _NFA_SLOTS) or _NFA_SLOTS),
+        )
+        if sf is not None and sf.join_side_rows is not None:
+            f.join_side_rows = sf.join_side_rows
+        out.append(f)
+    return out

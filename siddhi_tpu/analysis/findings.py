@@ -1,0 +1,87 @@
+"""Finding model for the static plan analyzer.
+
+A Finding is one diagnostic: a stable rule ID (`STATE001`), a severity,
+a human message, an optional source location (`app.siddhi:3:9` — from
+the parser's position threading), the query/component it concerns, and a
+fix hint.  Findings are plain data — JSON-able for the REST surface and
+renderable as one text line for the CLI.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+INFO = "INFO"
+WARN = "WARN"
+ERROR = "ERROR"
+SEVERITIES = (INFO, WARN, ERROR)
+
+_RANK = {s: i for i, s in enumerate(SEVERITIES)}
+
+
+def severity_rank(severity: str) -> int:
+    """INFO=0 < WARN=1 < ERROR=2; unknown severities rank as ERROR so a
+    typo'd override fails closed, not open."""
+    return _RANK.get(str(severity).upper(), _RANK[ERROR])
+
+
+@dataclasses.dataclass
+class Finding:
+    rule_id: str
+    severity: str                       # INFO | WARN | ERROR
+    message: str
+    query: Optional[str] = None         # query / component name
+    pos: Optional[Tuple[int, int]] = None   # (line, col), 1-based
+    source: Optional[str] = None        # file name or '<app>'
+    hint: Optional[str] = None
+
+    def location(self) -> str:
+        """`app.siddhi:3:9` (falls back to the bare source name when the
+        AST node carried no position)."""
+        src = self.source or "<app>"
+        if self.pos:
+            return f"{src}:{self.pos[0]}:{self.pos[1]}"
+        return src
+
+    def to_dict(self) -> Dict:
+        d: Dict = {
+            "rule": self.rule_id,
+            "severity": self.severity,
+            "message": self.message,
+            "location": self.location(),
+        }
+        if self.query is not None:
+            d["query"] = self.query
+        if self.pos is not None:
+            d["line"], d["col"] = int(self.pos[0]), int(self.pos[1])
+        if self.hint:
+            d["hint"] = self.hint
+        return d
+
+    def render(self) -> str:
+        """One CLI text line:
+        `app.siddhi:3:9: WARN STATE001 [query] message (fix: hint)`."""
+        parts = [f"{self.location()}: {self.severity} {self.rule_id}"]
+        if self.query:
+            parts.append(f"[{self.query}]")
+        parts.append(self.message)
+        line = " ".join(parts)
+        if self.hint:
+            line += f" (fix: {self.hint})"
+        return line
+
+    def sort_key(self):
+        """Most severe first, then source order, then rule id — the
+        driver sorts with this so text, JSON, and golden outputs are
+        deterministic."""
+        return (-severity_rank(self.severity),
+                self.pos or (1 << 30, 0),
+                self.rule_id,
+                self.query or "")
+
+
+def counts(findings) -> Dict[str, int]:
+    out = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        out[f.severity] = out.get(f.severity, 0) + 1
+    return out

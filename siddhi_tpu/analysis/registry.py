@@ -1,0 +1,96 @@
+"""Rule registry for the static plan analyzer.
+
+Each rule is registered once with a stable ID, a default severity, and
+catalog metadata (title / rationale / fix hint) — `tools/docgen.py`
+renders the rule catalog straight from this registry, so docs can never
+drift from the shipped rule set.  Per-run enable/severity overrides ride
+a LintConfig instead of mutating the registry.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Optional, Set
+
+from .findings import SEVERITIES, Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str            # default severity (INFO | WARN | ERROR)
+    title: str
+    rationale: str           # why this is a TPU/production hazard
+    hint: str                # generic fix hint (findings may specialize)
+    check: Callable          # (ctx) -> Iterable[Finding]
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(id: str, severity: str, title: str, rationale: str, hint: str):
+    """Decorator registering a check function as a lint rule.  The check
+    receives an AnalysisContext and yields Findings; the driver stamps
+    rule id / severity (with config overrides) onto whatever it yields."""
+    if severity not in SEVERITIES:
+        raise ValueError(f"bad severity {severity!r} for rule {id!r}")
+
+    def deco(fn: Callable) -> Callable:
+        if id in RULES:
+            raise ValueError(f"duplicate rule id {id!r}")
+        RULES[id] = Rule(id=id, severity=severity, title=title,
+                         rationale=rationale, hint=hint, check=fn)
+        return fn
+    return deco
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Per-run analyzer configuration.
+
+    disabled: rule IDs to skip entirely.
+    severity_overrides: {rule_id: severity} — e.g. promote MEM001 to
+        ERROR in CI, demote DEAD002 to INFO on legacy apps.
+    state_budget_bytes: MEM001 threshold — estimated per-query device
+        state above this fires (default 128 MiB: a few queries of that
+        size exhaust a 16 GB HBM chip once batches/emissions join them).
+    """
+
+    disabled: Set[str] = dataclasses.field(default_factory=set)
+    severity_overrides: Dict[str, str] = \
+        dataclasses.field(default_factory=dict)
+    state_budget_bytes: int = 128 * 1024 * 1024
+
+    def severity_of(self, r: Rule) -> str:
+        return self.severity_overrides.get(r.id, r.severity)
+
+    def enabled_rules(self) -> List[Rule]:
+        return [RULES[k] for k in sorted(RULES) if k not in self.disabled]
+
+
+def catalog() -> List[Dict]:
+    """Stable-ordered rule catalog for docgen and `lint --rules`."""
+    return [
+        {"id": r.id, "severity": r.severity, "title": r.title,
+         "rationale": r.rationale, "hint": r.hint}
+        for _, r in sorted(RULES.items())
+    ]
+
+
+def run_rules(ctx, config: Optional[LintConfig] = None) -> List[Finding]:
+    """Run every enabled rule over one AnalysisContext and return the
+    stamped, deterministically-sorted findings."""
+    config = config or LintConfig()
+    out: List[Finding] = []
+    for r in config.enabled_rules():
+        sev = config.severity_of(r)
+        produced: Iterable[Finding] = r.check(ctx) or ()
+        for f in produced:
+            f.rule_id = r.id
+            f.severity = sev
+            if f.source is None:
+                f.source = ctx.source_name
+            if f.hint is None:
+                f.hint = r.hint
+            out.append(f)
+    out.sort(key=lambda f: f.sort_key())
+    return out

@@ -1,0 +1,427 @@
+"""Built-in lint rules: TPU hazards detectable before an app ever runs.
+
+Every rule is grounded in a runtime hazard this engine actually has —
+the rationale strings name the mechanism.  Severity policy: ERROR is
+reserved for "this will break or silently lose data as written"; WARN
+for "this degrades or explodes under production traffic"; INFO for
+"you should know, but it may be intentional".  A clean production app
+should lint with zero ERRORs; the shipped samples do.
+
+Rule IDs are stable API: dashboards, CI configs, and severity overrides
+key on them.  Never renumber — retire IDs instead.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+from ..query_api.query import (
+    EveryStateElement,
+    InsertIntoStream,
+    Partition,
+    Query,
+    ReturnStream,
+    ValuePartitionType,
+)
+from .facts import (
+    _BATCH_CAPACITY,
+    AnalysisContext,
+    iter_named_queries,
+    pattern_atoms,
+    query_kind,
+)
+from .findings import Finding
+from .registry import rule
+
+
+def _f(message: str, query: Optional[str] = None, node=None,
+       hint: Optional[str] = None) -> Finding:
+    """Finding skeleton — the driver stamps rule id / severity /
+    source; `node` contributes its parser position when it has one."""
+    return Finding(rule_id="", severity="", message=message, query=query,
+                   pos=getattr(node, "pos", None), hint=hint)
+
+
+def _mb(n: int) -> str:
+    return f"{n / (1024 * 1024):.1f} MiB"
+
+
+# ---------------------------------------------------------------------------
+# state growth
+# ---------------------------------------------------------------------------
+
+@rule("STATE001", "WARN",
+      "unbounded pattern state (`every` without `within`)",
+      "An `every`-repeated pattern with no `within` bound keeps every "
+      "pending partial match alive forever; the NFA slot block fills and "
+      "new matches evict old ones unpredictably under sustained traffic.",
+      "add `within <time>` to the pattern so stale partial matches "
+      "expire")
+def _every_without_within(ctx: AnalysisContext) -> Iterator[Finding]:
+    for f in ctx.queries:
+        if f.kind != "pattern":
+            continue
+        ist = f.query.input_stream
+        if getattr(ist, "within_time", None) is not None:
+            continue
+        every = None
+
+        def find_every(el):
+            nonlocal every
+            if isinstance(el, EveryStateElement) and every is None:
+                every = el
+            for attr in ("state_element", "next_state_element",
+                         "stream_state_element",
+                         "stream_state_element_1",
+                         "stream_state_element_2"):
+                sub = getattr(el, attr, None)
+                if sub is not None:
+                    find_every(sub)
+
+        find_every(ist.state_element)
+        if every is not None:
+            yield _f("`every` pattern has no `within` bound — pending "
+                     "match state accumulates without expiry "
+                     f"({f.nfa_slots} NFA slots/key, eviction under "
+                     "overflow)", query=f.name,
+                     node=every if getattr(every, "pos", None)
+                     else f.query)
+
+
+@rule("STATE002", "INFO",
+      "pattern emission block is effectively uncapped",
+      "Non-partitioned patterns default to the 1<<30 'uncapped' "
+      "compact_rows sentinel: the device emission block is sized by "
+      "worst-case match fan-out, so a pathological batch can emit an "
+      "arbitrarily large block in one dispatch.",
+      "set `@emit(rows='N')` to bound the per-dispatch emission block")
+def _uncapped_pattern_emission(ctx: AnalysisContext) -> Iterator[Finding]:
+    for f in ctx.queries:
+        if f.kind == "pattern" and f.emission_cap is None and \
+                not f.emission_cap_explicit:
+            yield _f("pattern emission cap is the uncapped sentinel — "
+                     "worst-case match fan-out sizes the emission block",
+                     query=f.name, node=f.query)
+
+
+@rule("MEM001", "WARN",
+      "query state exceeds the device-memory budget",
+      "Window buffers, keyed-window slabs, and NFA slot blocks are "
+      "dense device arrays sized at plan time (shape × dtype); a few "
+      "oversized queries exhaust HBM before the first event arrives.",
+      "shrink the window / `@capacity(keys=…, slots=…, window=…)`, or "
+      "raise the lint budget if the deployment really has the HBM")
+def _state_over_budget(ctx: AnalysisContext) -> Iterator[Finding]:
+    budget = getattr(ctx.config, "state_budget_bytes",
+                     128 * 1024 * 1024)
+    for f in ctx.queries:
+        if f.state_bytes is not None and f.state_bytes > budget:
+            yield _f(f"{f.state_bytes_origin} device state "
+                     f"{_mb(f.state_bytes)} exceeds the "
+                     f"{_mb(budget)} budget", query=f.name,
+                     node=f.query)
+
+
+# ---------------------------------------------------------------------------
+# fusion / dispatch
+# ---------------------------------------------------------------------------
+
+@rule("FUSE001", "WARN",
+      "@fuse requested but the wiring will exclude it",
+      "A @fuse(batches=K) on a timer-bearing, keyed, sharded, or "
+      "partitioned query is silently ignored at wiring time — the "
+      "operator expects K× dispatch amortization and gets none.  The "
+      "runtime only logs the exclusion at deploy; lint surfaces it "
+      "before.",
+      "remove the @fuse annotation, or restructure the query onto a "
+      "fusable path")
+def _fuse_excluded(ctx: AnalysisContext) -> Iterator[Finding]:
+    for f in ctx.queries:
+        if f.fuse_requested and f.fusion_exclusion:
+            yield _f(f"@fuse(batches={f.fuse_requested}) will be "
+                     f"ignored: {f.fusion_exclusion}", query=f.name,
+                     node=f.query)
+
+
+# ---------------------------------------------------------------------------
+# emission caps
+# ---------------------------------------------------------------------------
+
+@rule("JOIN001", "WARN",
+      "explicit join emission cap can overflow under worst-case "
+      "cross-product",
+      "An explicit @emit(rows='N') on a join warns-and-drops on "
+      "overflow instead of growing; a batch joining against a full "
+      "window can produce batch×window rows, silently truncated to N.",
+      "raise @emit(rows=…) to cover batch_capacity × window rows, or "
+      "drop the annotation and let the cap grow adaptively")
+def _join_cap_overflow(ctx: AnalysisContext) -> Iterator[Finding]:
+    for f in ctx.queries:
+        if f.kind != "join" or not f.emission_cap_explicit or \
+                f.emission_cap is None or f.join_side_rows is None:
+            continue
+        left, right = f.join_side_rows
+        worst = _BATCH_CAPACITY * max(left, right)
+        if f.emission_cap < worst:
+            yield _f(f"explicit emission cap {f.emission_cap} rows < "
+                     f"worst-case cross-product {worst} rows "
+                     f"({_BATCH_CAPACITY}-row batch × "
+                     f"{max(left, right)}-row window); overflow rows "
+                     "are dropped", query=f.name, node=f.query)
+
+
+# ---------------------------------------------------------------------------
+# dataflow
+# ---------------------------------------------------------------------------
+
+def _stream_reads(app) -> set:
+    reads = set()
+    for _, q, _part in iter_named_queries(app):
+        kind = query_kind(q)
+        if kind == "plain":
+            reads.add(q.input_stream.stream_id)
+        elif kind == "join":
+            reads.add(q.input_stream.left_input_stream.stream_id)
+            reads.add(q.input_stream.right_input_stream.stream_id)
+        else:
+            for a in pattern_atoms(q.input_stream.state_element):
+                reads.add(a.basic_single_input_stream.stream_id)
+    for agg in app.aggregation_definition_map.values():
+        sis = agg.basic_single_input_stream
+        if sis is not None:
+            reads.add(sis.stream_id)
+    return reads
+
+
+def _stream_writes(app) -> set:
+    writes = set(app.trigger_definition_map)
+    for _, q, _part in iter_named_queries(app):
+        out = q.output_stream
+        if out is not None and out.target_id:
+            writes.add(out.target_id)
+    return writes
+
+
+@rule("DEAD001", "WARN",
+      "stream defined but never referenced",
+      "A stream no query reads and nothing writes is dead weight: its "
+      "junction is wired, its schema interned, and a misspelled stream "
+      "name elsewhere usually hides behind it.",
+      "delete the definition, or fix the query that should be using it")
+def _dead_stream(ctx: AnalysisContext) -> Iterator[Finding]:
+    app = ctx.app
+    reads = _stream_reads(app)
+    writes = _stream_writes(app)
+    for sid, sdef in app.stream_definition_map.items():
+        if sid.startswith(("!", "#")) or sid in app.trigger_definition_map:
+            continue
+        if sdef.get_annotation("source") is not None or \
+                sdef.get_annotation("sink") is not None:
+            continue
+        if sid not in reads and sid not in writes:
+            yield _f(f"stream {sid!r} is never read or written by any "
+                     "query, trigger, source, or sink", query=None,
+                     node=sdef)
+
+
+@rule("DEAD002", "INFO",
+      "query output feeds nothing visible statically",
+      "The query inserts into a stream that no downstream query reads "
+      "and no @sink consumes.  Runtime callbacks may consume it — but "
+      "if none is attached, every device step and emission fetch for "
+      "this query is wasted work.",
+      "add a downstream query or @sink, attach a runtime callback, or "
+      "remove the query")
+def _dead_output(ctx: AnalysisContext) -> Iterator[Finding]:
+    app = ctx.app
+    reads = _stream_reads(app)
+    for f in ctx.queries:
+        out = f.query.output_stream
+        if not isinstance(out, InsertIntoStream) or \
+                isinstance(out, ReturnStream):
+            continue
+        tgt = out.target_id
+        if not tgt or tgt in app.table_definition_map or \
+                tgt in app.window_definition_map:
+            continue                 # tables/windows are stateful sinks
+        sdef = app.stream_definition_map.get(tgt)
+        if sdef is not None and sdef.get_annotation("sink") is not None:
+            continue
+        if tgt not in reads:
+            yield _f(f"output stream {tgt!r} has no downstream query or "
+                     "@sink (a runtime callback may still consume it)",
+                     query=f.name, node=out)
+
+
+# ---------------------------------------------------------------------------
+# partitioning
+# ---------------------------------------------------------------------------
+
+@rule("PART001", "WARN",
+      "partition key has unbounded cardinality",
+      "Partition keys map to a finite device key slab (default 4096 "
+      "slots).  A continuous-valued (float/double) key makes nearly "
+      "every event a new key: the slab exhausts, purge churn replaces "
+      "useful state, and per-key isolation degrades to noise.",
+      "partition by a bounded-cardinality attribute (id, symbol, "
+      "category), or bucket the value upstream")
+def _float_partition_key(ctx: AnalysisContext) -> Iterator[Finding]:
+    from ..query_api.expression import Variable
+    for element in ctx.app.execution_element_list:
+        if not isinstance(element, Partition):
+            continue
+        for sid, pt in element.partition_type_map.items():
+            if not isinstance(pt, ValuePartitionType) or \
+                    not isinstance(pt.expression, Variable):
+                continue
+            sdef = ctx.app.stream_definition_map.get(sid)
+            if sdef is None:
+                continue
+            try:
+                atype = sdef.attribute_type(
+                    pt.expression.attribute_name)
+            except KeyError:
+                continue
+            if atype in ("FLOAT", "DOUBLE"):
+                yield _f(f"partition key {sid}.{pt.expression.attribute_name} "
+                         f"is {atype} — continuous values exhaust the "
+                         "finite partition key slab", query=None,
+                         node=element)
+
+
+# ---------------------------------------------------------------------------
+# expressions
+# ---------------------------------------------------------------------------
+
+def _filter_compares(app, q: Query):
+    """(Compare node, owning stream def) for every filter expression in
+    the query's input chains, plus the selector's having clause."""
+    from ..query_api.expression import Compare, walk
+    from ..query_api.query import Filter
+
+    def sources(iq):
+        kind = query_kind(iq)
+        if kind == "plain":
+            yield iq.input_stream
+        elif kind == "join":
+            yield iq.input_stream.left_input_stream
+            yield iq.input_stream.right_input_stream
+        else:
+            for a in pattern_atoms(iq.input_stream.state_element):
+                yield a.basic_single_input_stream
+
+    for sis in sources(q):
+        sdef = app.stream_definition_map.get(sis.stream_id) or \
+            app.window_definition_map.get(sis.stream_id) or \
+            app.table_definition_map.get(sis.stream_id)
+        for h in getattr(sis, "stream_handlers", ()):
+            if isinstance(h, Filter):
+                for node in walk(h.expression):
+                    if isinstance(node, Compare):
+                        yield node, sdef
+    if q.selector is not None and q.selector.having_expression is not None:
+        for node in walk(q.selector.having_expression):
+            from ..query_api.expression import Compare as _C
+            if isinstance(node, _C):
+                yield node, None
+
+
+@rule("TYPE001", "WARN",
+      "lossy type coercion in filter comparison",
+      "Comparing a LONG attribute against a float/double literal "
+      "coerces i64 to floating point on device; LONG values above 2^53 "
+      "(and above 2^24 where DOUBLE lowers to f32 on TPU) compare "
+      "wrongly — timestamps and ids are exactly the values that hit "
+      "this.",
+      "use an integer literal, or cast/scale the attribute explicitly")
+def _lossy_filter_compare(ctx: AnalysisContext) -> Iterator[Finding]:
+    from ..query_api.expression import Constant, Variable
+
+    def attr_type(var, sdef):
+        for d in ((ctx.app.stream_definition_map.get(var.stream_id),)
+                  if var.stream_id else (sdef,)):
+            if d is None:
+                continue
+            try:
+                return d.attribute_type(var.attribute_name)
+            except (KeyError, AttributeError):
+                continue
+        # pattern event refs (e1.price) resolve against the handler's
+        # own stream definition
+        if var.stream_id and sdef is not None:
+            try:
+                return sdef.attribute_type(var.attribute_name)
+            except (KeyError, AttributeError):
+                pass
+        return None
+
+    for f in ctx.queries:
+        for cmp_node, sdef in _filter_compares(ctx.app, f.query):
+            for a, b in ((cmp_node.left, cmp_node.right),
+                         (cmp_node.right, cmp_node.left)):
+                if isinstance(a, Variable) and isinstance(b, Constant) \
+                        and b.type in ("FLOAT", "DOUBLE") and \
+                        attr_type(a, sdef) == "LONG":
+                    from ..observability.explain import render_expr
+                    yield _f("LONG attribute "
+                             f"{a.attribute_name!r} compared against "
+                             f"{b.type} literal {b.value!r} — i64→float "
+                             "coercion loses precision "
+                             f"({render_expr(cmp_node)})", query=f.name,
+                             node=cmp_node if getattr(cmp_node, "pos",
+                                                      None)
+                             else f.query)
+                    break
+
+
+# ---------------------------------------------------------------------------
+# rate limiting
+# ---------------------------------------------------------------------------
+
+@rule("RATE001", "WARN",
+      "rate limit interacts with batch emission to drop events",
+      "The rate limiter samples the emission stream AFTER device "
+      "compaction and batch stacking: an explicit @emit cap truncates "
+      "rows before first/last selection sees them, and under @fuse the "
+      "limiter's clock only advances at dispatch — up to K-1 batches "
+      "late for time/snapshot limiters.",
+      "drop the explicit @emit cap, or un-fuse the query, or accept "
+      "the documented loss semantics")
+def _ratelimit_batch_interaction(ctx: AnalysisContext
+                                 ) -> Iterator[Finding]:
+    for f in ctx.queries:
+        rate = f.query.output_rate
+        if rate is None:
+            continue
+        if f.emission_cap_explicit and f.emission_cap is not None:
+            yield _f(f"explicit @emit(rows={f.emission_cap}) drops "
+                     "overflow rows before the "
+                     f"`output {rate.behavior.lower()} every …` limiter "
+                     "samples them", query=f.name, node=rate)
+        elif f.fuse_requested and rate.type in ("TIME", "SNAPSHOT"):
+            yield _f(f"@fuse(batches={f.fuse_requested}) delays "
+                     "emission up to "
+                     f"{f.fuse_requested - 1} batches behind the "
+                     f"{rate.type.lower()}-based rate limiter's clock",
+                     query=f.name, node=rate)
+
+
+# ---------------------------------------------------------------------------
+# deployment hygiene
+# ---------------------------------------------------------------------------
+
+@rule("APP001", "INFO",
+      "app has no @app:name",
+      "The REST service keys deployments by app name and rejects "
+      "duplicates; every unnamed app collides on the default "
+      "'SiddhiApp', so at most one can ever be deployed.",
+      "add @app:name('…') at the top of the app")
+def _unnamed_app(ctx: AnalysisContext) -> Iterator[Finding]:
+    if not ctx.app.name:
+        yield _f("app is unnamed — REST deployments collide on the "
+                 "default name 'SiddhiApp'")
+
+
+ALL_RULE_IDS: List[str] = [
+    "STATE001", "STATE002", "MEM001", "FUSE001", "JOIN001",
+    "DEAD001", "DEAD002", "PART001", "TYPE001", "RATE001", "APP001",
+]

@@ -49,7 +49,6 @@ from ..query_api.query import (
     LogicalStateElement,
     NextStateElement,
     OnDemandQuery,
-    OrderByAttribute,
     OutputAttribute,
     OutputRate,
     Partition,
@@ -98,6 +97,19 @@ class Parser:
     def __init__(self, text: str):
         self.toks = tokenize(text)
         self.pos = 0
+
+    def _at(self, node, tok: Token):
+        """Attach the source position of `tok` to an AST node as
+        `node.pos = (line, col)` — the static analyzer cites findings as
+        `app.siddhi:line:col` from these, and they ride along for any
+        later diagnostic.  Never overwrites a position set deeper in the
+        parse (the first token of a subtree wins)."""
+        if getattr(node, "pos", None) is None:
+            try:
+                node.pos = (tok.line, tok.col)
+            except AttributeError:   # slotted/frozen node: skip silently
+                pass
+        return node
 
     # ---- token helpers -----------------------------------------------------
     def peek(self, off: int = 0) -> Token:
@@ -188,11 +200,11 @@ class Parser:
 
     # ---- annotations -------------------------------------------------------
     def parse_annotation(self) -> Annotation:
-        self.expect_punct("@")
+        t0 = self.expect_punct("@")
         name = self.expect_name()
         if self.eat_punct(":"):
             name = f"{name}:{self.expect_name()}"
-        ann = Annotation(name)
+        ann = self._at(Annotation(name), t0)
         if self.eat_punct("("):
             while not self.at_punct(")"):
                 if self.at_punct("@"):
@@ -240,21 +252,21 @@ class Parser:
 
     # ---- definitions -------------------------------------------------------
     def _parse_definition(self, app: SiddhiApp, anns: List[Annotation]):
-        self.expect_kw("define")
+        t0 = self.expect_kw("define")
         kind = self.next()
         k = kind.lower
         if k == "stream":
-            d = StreamDefinition(self._parse_source_name())
+            d = self._at(StreamDefinition(self._parse_source_name()), t0)
             self._parse_attr_list(d)
             d.annotations = anns
             app.define_stream(d)
         elif k == "table":
-            d = TableDefinition(self._parse_source_name())
+            d = self._at(TableDefinition(self._parse_source_name()), t0)
             self._parse_attr_list(d)
             d.annotations = anns
             app.define_table(d)
         elif k == "window":
-            d = WindowDefinition(self._parse_source_name())
+            d = self._at(WindowDefinition(self._parse_source_name()), t0)
             self._parse_attr_list(d)
             d.window = self._parse_window_function()
             if self.eat_kw("output"):
@@ -262,7 +274,7 @@ class Parser:
             d.annotations = anns
             app.define_window(d)
         elif k == "trigger":
-            d = TriggerDefinition(self.expect_name())
+            d = self._at(TriggerDefinition(self.expect_name()), t0)
             self.expect_kw("at")
             if self.eat_kw("every"):
                 d.at_every = self._parse_time_value()
@@ -276,7 +288,7 @@ class Parser:
             d.annotations = anns
             app.define_trigger(d)
         elif k == "function":
-            d = FunctionDefinition()
+            d = self._at(FunctionDefinition(), t0)
             d.id = self.expect_name()
             self.expect_punct("[")
             d.language = self.expect_name()
@@ -286,7 +298,7 @@ class Parser:
             d.body = self._parse_script_body()
             app.define_function(d)
         elif k == "aggregation":
-            d = self._parse_aggregation_definition(anns)
+            d = self._at(self._parse_aggregation_definition(anns), t0)
             app.define_aggregation(d)
         else:
             raise SiddhiParserException(
@@ -314,8 +326,9 @@ class Parser:
         self.expect_punct(")")
 
     def _parse_window_function(self) -> Window:
+        t0 = self.peek()
         ns, name, params = self._parse_function_call()
-        return Window(ns, name, params)
+        return self._at(Window(ns, name, params), t0)
 
     def _parse_script_body(self) -> str:
         """The tokenizer captures { ... } bodies verbatim as one SCRIPT
@@ -362,13 +375,19 @@ class Parser:
     # ---- queries -----------------------------------------------------------
     def parse_query(self) -> Query:
         q = Query()
-        self.expect_kw("from")
-        q.input_stream = self._parse_query_input()
+        t0 = self.expect_kw("from")
+        self._at(q, t0)
+        q.input_stream = self._at(self._parse_query_input(), t0)
         if self.at_kw("select"):
-            q.selector = self._parse_selector()
+            tsel = self.peek()
+            q.selector = self._at(self._parse_selector(), tsel)
         if self.at_kw("output"):
-            q.output_rate = self._parse_output_rate()
+            trate = self.peek()
+            q.output_rate = self._at(self._parse_output_rate(), trate)
+        tout = self.peek()
         self._parse_query_output(q)
+        if q.output_stream is not None:
+            self._at(q.output_stream, tout)
         return q
 
     def _classify_input(self) -> str:
@@ -428,11 +447,12 @@ class Parser:
         # optional window + post handlers
         while True:
             if self.at_punct("#") and self.at_kw("window", off=1):
-                self.next()
+                t0 = self.next()
                 self.expect_kw("window")
                 self.expect_punct(".")
                 ns, name, params = self._parse_function_call()
-                s.stream_handlers.append(Window(ns, name, params))
+                s.stream_handlers.append(
+                    self._at(Window(ns, name, params), t0))
             elif self.at_punct("#") or self.at_punct("["):
                 self._parse_stream_handler(s)
             else:
@@ -442,10 +462,11 @@ class Parser:
         return s
 
     def _parse_basic_source(self) -> SingleInputStream:
+        t0 = self.peek()
         is_inner = bool(self.eat_punct("#"))
         is_fault = False if is_inner else bool(self.eat_punct("!"))
         sid = self.expect_name()
-        s = SingleInputStream(sid, None, is_inner, is_fault)
+        s = self._at(SingleInputStream(sid, None, is_inner, is_fault), t0)
         while self.at_punct("[") or (
                 self.at_punct("#") and not self.at_kw("window", off=1)):
             self._parse_stream_handler(s)
@@ -465,10 +486,11 @@ class Parser:
             s.filter(expr)
             return
         if self.at_kw("window"):
-            self.expect_kw("window")
+            t0 = self.expect_kw("window")
             self.expect_punct(".")
             ns, name, params = self._parse_function_call()
-            s.stream_handlers.append(Window(ns, name, params))
+            s.stream_handlers.append(
+                self._at(Window(ns, name, params), t0))
             return
         ns, name, params = self._parse_function_call()
         s.function(name, *params, namespace=ns)
@@ -538,11 +560,12 @@ class Parser:
     def _parse_join_source(self) -> SingleInputStream:
         s = self._parse_basic_source()
         if self.at_punct("#") and self.at_kw("window", off=1):
-            self.next()
+            t0 = self.next()
             self.expect_kw("window")
             self.expect_punct(".")
             ns, name, params = self._parse_function_call()
-            s.stream_handlers.append(Window(ns, name, params))
+            s.stream_handlers.append(
+                self._at(Window(ns, name, params), t0))
         if self.eat_kw("as"):
             s.stream_reference_id = self.expect_name()
         return s
@@ -568,12 +591,14 @@ class Parser:
         return root
 
     def _parse_state_element(self, sep: str):
+        t0 = self.peek()
         if self.eat_kw("every"):
             if self.eat_punct("("):
                 inner = self._parse_state_chain(sep)
                 self.expect_punct(")")
-                return EveryStateElement(inner)
-            return EveryStateElement(self._parse_state_unit(sep))
+                return self._at(EveryStateElement(inner), t0)
+            return self._at(EveryStateElement(self._parse_state_unit(sep)),
+                            t0)
         if self.at_punct("("):
             self.next()
             inner = self._parse_state_chain(sep)
@@ -590,12 +615,13 @@ class Parser:
         return left
 
     def _parse_stateful_source(self, sep: str):
+        t0 = self.peek()
         if self.eat_kw("not"):
             src = self._parse_basic_source()
             waiting = None
             if self.eat_kw("for"):
                 waiting = self._parse_time_value()
-            return AbsentStreamStateElement(src, waiting)
+            return self._at(AbsentStreamStateElement(src, waiting), t0)
         # (event '=')? basic_source (<m:n> | * | + | ?)?
         ref = None
         if self.peek().kind == "ID" and self.at_punct("=", off=1):
@@ -603,7 +629,7 @@ class Parser:
             self.expect_punct("=")
         src = self._parse_basic_source()
         src.stream_reference_id = ref
-        sse = StreamStateElement(src)
+        sse = self._at(StreamStateElement(src), t0)
         if self.eat_punct("<"):
             lo_t = self.next()
             if lo_t.kind != "INT":
@@ -787,10 +813,10 @@ class Parser:
 
     # -- partitions -------------------------------------------------------------
     def parse_partition(self) -> Partition:
-        self.expect_kw("partition")
+        t0 = self.expect_kw("partition")
         self.expect_kw("with")
         self.expect_punct("(")
-        p = Partition()
+        p = self._at(Partition(), t0)
         while True:
             save = self.pos
             expr = self.parse_expression()
@@ -888,7 +914,8 @@ class Parser:
 
     # ---- expressions ---------------------------------------------------------
     def parse_expression(self) -> Expression:
-        return self._parse_or()
+        t0 = self.peek()
+        return self._at(self._parse_or(), t0)
 
     def _parse_or(self) -> Expression:
         left = self._parse_and()
@@ -914,16 +941,18 @@ class Parser:
     def _parse_equality(self) -> Expression:
         left = self._parse_relational()
         while self.at_punct("==") or self.at_punct("!="):
-            op = self.next().text
-            left = Compare(left, op, self._parse_relational())
+            t = self.next()
+            left = self._at(Compare(left, t.text,
+                                    self._parse_relational()), t)
         return left
 
     def _parse_relational(self) -> Expression:
         left = self._parse_additive()
         while (self.at_punct(">=") or self.at_punct("<=")
                or self.at_punct(">") or self.at_punct("<")):
-            op = self.next().text
-            left = Compare(left, op, self._parse_additive())
+            t = self.next()
+            left = self._at(Compare(left, t.text,
+                                    self._parse_additive()), t)
         return left
 
     def _parse_additive(self) -> Expression:

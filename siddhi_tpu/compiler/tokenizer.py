@@ -10,7 +10,7 @@ annotations, and multi-char operators -> == != <= >= ... .
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional
+from typing import List
 
 
 from ..exceptions import SiddhiParserException as _BaseParserException

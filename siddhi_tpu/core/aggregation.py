@@ -39,7 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..query_api.expression import Constant, Expression, Variable
+from ..query_api.expression import Constant, Variable
 from . import event as ev
 from .executor import CompileError, Scope, compile_expression
 from .steputil import jit_step

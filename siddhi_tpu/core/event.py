@@ -18,7 +18,6 @@ Design:
 """
 from __future__ import annotations
 
-import dataclasses
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -26,7 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..query_api.definition import AbstractDefinition, Attribute
+from ..query_api.definition import AbstractDefinition
 
 # Event kinds (reference: ComplexEvent.Type CURRENT/EXPIRED/TIMER/RESET)
 CURRENT = 0

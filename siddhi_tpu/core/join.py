@@ -17,23 +17,14 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..query_api.definition import StreamDefinition
-from ..query_api.query import JoinInputStream, Query, SingleInputStream, Window
+from ..query_api.query import JoinInputStream, Query, SingleInputStream
 from . import event as ev
 from .executor import CompileError, CompiledExpr, Scope, compile_expression
 from .selector import SelectorExec
 from .steputil import jit_step
-from .window import (
-    NO_WAKEUP,
-    Buffer,
-    NoWindow,
-    Rows,
-    WindowProcessor,
-    create_window,
-    empty_buffer,
-)
+from .window import Buffer, NoWindow, Rows, WindowProcessor, create_window
 
 
 @dataclasses.dataclass

@@ -14,7 +14,7 @@ incremental, so they reduce with plain segmented numpy ops.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import numpy as np
 
@@ -384,11 +384,7 @@ def _insert_constant(rt, oq) -> List[ev.Event]:
 
 def _apply_write(rt, oq, sel_events, store_schema, key) -> None:
     """UPDATE / DELETE / UPDATE_OR_INSERT / INSERT with a FROM store."""
-    from ..query_api.query import (
-        DeleteStream,
-        UpdateOrInsertStream,
-        UpdateStream,
-    )
+    from ..query_api.query import DeleteStream, UpdateOrInsertStream
     out_stream = oq.output_stream
     tgt = out_stream.target_id
     table = rt.tables[tgt]

@@ -26,9 +26,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, NamedTuple, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
-from jax import lax
 
 from ..query_api.expression import Expression
 from ..query_api.query import (

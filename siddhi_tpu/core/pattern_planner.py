@@ -9,17 +9,17 @@ sequential-per-key NFA advance.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax import lax
 
 from ..query_api.definition import StreamDefinition
 from ..query_api.query import Query, StateInputStream
 from . import event as ev
-from .executor import CompileError, Scope
+from . import plan_facts
+from .executor import CompileError
 from .pattern import PatternExec, PatternSpec, linearize, oh_take
 from .pattern_block import block_eligible, make_block_step
 from .selector import SelectorExec
@@ -167,9 +167,11 @@ class PlannedPatternQuery:
     # identical per-batch program (core/fusion.py); None on the mesh path
     step_bodies: Optional[Dict[str, Callable]] = None
 
-    # the 1<<30 compact_rows default means "effectively uncapped" for
-    # non-partitioned patterns (a per-key cap with K=1 would cap the batch)
-    _UNCAPPED = 1 << 30
+    # the compact_rows default means "effectively uncapped" for
+    # non-partitioned patterns (a per-key cap with K=1 would cap the
+    # batch); the sentinel value and its rendering are shared with lint /
+    # explain / healthz through core/plan_facts.py
+    _UNCAPPED = plan_facts.UNCAPPED_SENTINEL
 
     def describe(self) -> Dict:
         """Compiled-plan facts for EXPLAIN (observability/explain.py):
@@ -189,10 +191,7 @@ class PlannedPatternQuery:
             "dense_slot_fast_path": self.dense_steps is not None,
             "timer_step": self.timer_step is not None,
         }
-        if self.compact_rows >= self._UNCAPPED:
-            d["emission_cap_rows"] = None   # uncapped (K=1 layout)
-        else:
-            d["emission_cap_rows"] = int(self.compact_rows)
+        d["emission_cap_rows"] = plan_facts.render_cap(self.compact_rows)
         d["emission_cap_explicit"] = bool(self.emit_explicit)
         if self.mesh is not None:
             d["sharded_over_devices"] = int(self.mesh.devices.size)
@@ -222,7 +221,7 @@ def plan_pattern_query(
     # adaptive growth after an implicit-cap overflow (state shapes do not
     # depend on the cap, so only the step functions rebuild).
     compact_rows = compact_rows_override or (
-        8 if partition_positions else (1 << 30))
+        8 if partition_positions else plan_facts.UNCAPPED_SENTINEL)
     emit_explicit = False
     for ann in query.annotations:
         if ann.name.lower() == "emit":

@@ -1,0 +1,63 @@
+"""Shared compiled-plan fact helpers: uncapped-sentinel rendering and
+fusion-exclusion reasons.
+
+Three surfaces report the same two plan facts — whether a query's
+emission cap is real or the 1<<30 "effectively uncapped" sentinel, and
+why a requested `@fuse` was skipped at wiring time: the static analyzer
+(`siddhi_tpu/analysis`), EXPLAIN (`observability/explain.py`), and
+`/healthz` (`observability/health.py`).  Each used to re-derive them
+locally (the sentinel rendering lived only in explain; the exclusion
+reason only in a wiring-time log line), so the renderings could drift.
+This module is the single source of truth all three import.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+# pattern_planner's compact_rows default for non-partitioned patterns:
+# "effectively uncapped" (a per-key cap with K=1 would cap the batch).
+# Every surface that renders an emission cap must treat values at or
+# above this sentinel as "no cap", never as a 1073741824-row budget.
+UNCAPPED_SENTINEL = 1 << 30
+
+
+def render_cap(rows: Optional[int]) -> Optional[int]:
+    """Human-facing emission cap: None when absent or at/above the
+    uncapped sentinel, else the concrete row count."""
+    if rows is None:
+        return None
+    rows = int(rows)
+    return None if rows >= UNCAPPED_SENTINEL else rows
+
+
+def fusion_exclusion(qr) -> Optional[str]:
+    """The concrete reason @fuse was requested but skipped for this query
+    runtime, or None (fusing, eligible, or never requested).
+
+    Prefers the reason stored at wiring time (runtime._maybe_fuse) and
+    falls back to recomputing from the plan's static properties, so a
+    runtime restored from a snapshot still reports it.  Attribute reads
+    only — safe on the scrape path."""
+    why = getattr(qr, "_fuse_excluded", None)
+    if why is not None:
+        return why
+    if getattr(qr, "_fuse_requested", 0) and \
+            getattr(qr, "_fuse", None) is None:
+        from . import fusion
+        try:
+            return fusion.ineligible_reason(
+                qr, getattr(qr, "_kind", "plain"))
+        except Exception:  # noqa: BLE001 — diagnostics must not throw
+            return "unknown (plan facts unavailable)"
+    return None
+
+
+def fusion_exclusions(rt) -> Dict[str, str]:
+    """{query: exclusion reason} for every runtime of an app whose @fuse
+    request was skipped at wiring time (empty when none were)."""
+    out: Dict[str, str] = {}
+    for name, qr in list(getattr(rt, "query_runtimes", {}).items()):
+        why = fusion_exclusion(qr)
+        if why is not None:
+            out[name] = why
+    return out

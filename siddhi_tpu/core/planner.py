@@ -14,7 +14,6 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..query_api.definition import StreamDefinition
 from ..query_api.query import (
@@ -29,13 +28,7 @@ from .executor import CompileError, Scope, compile_expression
 from .steputil import jit_step, pcast, shard_map
 from .keyslots import SlotAllocator
 from .selector import SelectorExec
-from .window import (
-    NO_WAKEUP,
-    NoWindow,
-    Rows,
-    WindowProcessor,
-    create_window,
-)
+from .window import NoWindow, Rows, WindowProcessor, create_window
 
 
 @dataclasses.dataclass

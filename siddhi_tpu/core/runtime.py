@@ -17,7 +17,7 @@ import logging
 import pickle
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
@@ -2379,7 +2379,6 @@ class SiddhiAppRuntime:
                 us = getattr(out_stream, "update_set", None)
                 if us is None and not isinstance(out_stream, DeleteStream):
                     # default set: overwrite all same-named columns
-                    from ..query_api.query import UpdateSet, Variable
                     for n in table.schema.names:
                         if n in scope_schema.names:
                             from ..query_api.expression import Variable as V
@@ -3137,6 +3136,19 @@ class SiddhiAppRuntime:
         sliding-window drop/recompile rates (observability/health.py)."""
         from ..observability.health import app_health
         return app_health(self)
+
+    def analyze(self, config=None) -> Dict:
+        """Static lint findings for this app from its ACTUAL compiled
+        plans (real emission caps, measured state bytes, mesh-aware
+        fusion exclusions) — attribute and metadata reads only, never
+        executes or traces (siddhi_tpu/analysis).  Also served as
+        `GET /siddhi-apps/<app>/lint` and echoed into explain()."""
+        from ..analysis import analyze as _analyze, report as _report
+        findings = _analyze(self, config=config,
+                            source_name=f"<{self.name}>")
+        rep = _report(findings)
+        rep["app"] = self.name
+        return rep
 
     def set_statistics_level(self, level: str) -> None:
         self.stats.level = level.upper()

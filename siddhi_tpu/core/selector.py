@@ -20,7 +20,6 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -30,9 +29,16 @@ from ..query_api.expression import (
     Constant,
     Expression,
     Variable,
-    _Binary,
-    Add, Subtract, Multiply, Divide, Mod,
-    And, Or, Not, IsNull, In,
+    Add,
+    Subtract,
+    Multiply,
+    Divide,
+    Mod,
+    And,
+    Or,
+    Not,
+    IsNull,
+    In,
 )
 from ..query_api.query import Selector
 from . import event as ev
@@ -42,7 +48,6 @@ from .executor import (
     CompiledExpr,
     Scope,
     compile_expression,
-    promote,
 )
 from .window import Rows
 

@@ -11,7 +11,7 @@ fusion.
 from __future__ import annotations
 
 import logging
-from typing import Callable, Dict, List, Tuple
+from typing import Dict
 
 import jax
 import jax.numpy as jnp

@@ -16,16 +16,15 @@ per-event TreeMap probes become one fused comparison kernel.
 from __future__ import annotations
 
 import threading
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..query_api.definition import TableDefinition
-from ..query_api.expression import Expression, Variable
-from ..query_api.query import UpdateSet
+from ..query_api.expression import Expression
 from . import event as ev
-from .executor import CompileError, CompiledExpr, Scope, compile_expression
+from .executor import CompiledExpr, Scope, compile_expression
 from .keyslots import SlotAllocator
 from .table_index import AttributeIndex, IndexPlan, split_index_condition
 from .steputil import jit_step

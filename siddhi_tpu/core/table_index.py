@@ -21,7 +21,7 @@ the batched analogue of the reference's TreeMap.subMap scan.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 

@@ -21,7 +21,6 @@ event — O(C+B) vector work that XLA fuses well.
 """
 from __future__ import annotations
 
-import dataclasses
 from typing import Any, List, NamedTuple, Optional, Tuple
 
 import jax

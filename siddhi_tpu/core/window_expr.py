@@ -17,7 +17,7 @@ through a `lax.scan` carrying only the eviction front.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, List
+from typing import Any
 
 import jax
 import jax.numpy as jnp

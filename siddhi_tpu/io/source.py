@@ -6,9 +6,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, Optional
 
-from ..core import event as ev
 from . import broker as _broker
 from .mappers import SOURCE_MAPPERS, SourceMapper
 

@@ -292,13 +292,12 @@ def _fusion_node(qr, kind: str) -> Dict:
 
 
 def _emission_node(qr, kind: str) -> Dict:
+    from ..core.plan_facts import render_cap
     p = qr.planned
     node: Dict[str, Any] = {}
     cap = getattr(p, "compact_rows", None)
     if cap is not None:
-        uncapped = getattr(p, "_UNCAPPED", None)
-        node["cap_rows"] = None if uncapped is not None and \
-            cap >= uncapped else int(cap)
+        node["cap_rows"] = render_cap(cap)
         node["cap_explicit"] = bool(getattr(p, "emit_explicit", True))
     bc = getattr(p, "batch_capacity", None)
     if bc is not None:
@@ -392,8 +391,21 @@ def explain_query(rt, query_name: str, deep: bool = True) -> Dict:
         "fusion": _fusion_node(qr, kind),
         "recompiles": RECOMPILES.snapshot(
             [query_name, f"fused:{query_name}"]),
+        "findings": _lint_findings(rt, query_name),
     }
     return report
+
+
+def _lint_findings(rt, query_name: Optional[str]) -> List[Dict]:
+    """Static-analyzer findings echoed into the EXPLAIN report: app-wide
+    findings plus the named query's (attribute/metadata reads only — no
+    compile, safe even for shallow explain)."""
+    try:
+        from ..analysis import analyze
+        return [f.to_dict() for f in analyze(rt)
+                if query_name is None or f.query in (None, query_name)]
+    except Exception:  # noqa: BLE001 — diagnostics must not throw
+        return []
 
 
 def explain_app(rt, deep: bool = False) -> Dict:

@@ -129,6 +129,15 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
     drops, growths = _counter_sums(snap.get("counters", {}))
     recompiles = sum(info["count"]
                      for info in st.recompiles(rt).values())
+    # queries whose @fuse request was skipped at wiring time, with the
+    # concrete reason — an operator watching /healthz for throughput
+    # should see "your fusion never engaged" here, not in a log line
+    # (shared helper: core/plan_facts.py, same strings as explain/lint)
+    from ..core.plan_facts import fusion_exclusions
+    try:
+        excluded = fusion_exclusions(rt)
+    except Exception:  # noqa: BLE001 — probe must not throw
+        excluded = {}
     report = {
         "started": started,
         "accepting_ingress": accepting,
@@ -143,6 +152,7 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         "recompiles_per_s": round(_rate(rt, "recompiles", recompiles), 6),
         "totals": {"dropped": drops, "cap_growths": growths,
                    "recompiles": recompiles},
+        "fusion_exclusions": excluded,
     }
     return report
 

@@ -17,7 +17,7 @@ reference's reservoirs make the same kind of approximation by sampling).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 NBUCKETS = 64  # covers 1ns .. ~292 years in powers of two
 

@@ -4,7 +4,7 @@ Reference: modules/siddhi-query-api/.../SiddhiApp.java
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Union
+from typing import Dict, List, Optional
 
 from .definition import (
     AbstractDefinition,
@@ -16,7 +16,7 @@ from .definition import (
     TriggerDefinition,
     WindowDefinition,
 )
-from .query import ExecutionElement, OnDemandQuery, Partition, Query
+from .query import ExecutionElement, Partition, Query
 
 
 class SiddhiApp:

@@ -7,7 +7,7 @@ AggregationDefinition.java, FunctionDefinition.java, Attribute.java).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 
 class Attribute:

@@ -8,7 +8,7 @@ re-designed as plain Python dataclasses that compile to JAX column ops
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Optional, Sequence, Tuple
+from typing import Any, List, Optional
 
 
 class Expression:

@@ -32,6 +32,11 @@ Endpoints (JSON in/out):
                                                eligibility (?deep=0 skips
                                                the compile for memory
                                                analysis)
+  GET    /siddhi-apps/<name>/lint           -> static analyzer findings
+                                               for the deployed app, from
+                                               its actual compiled plans
+                                               (siddhi_tpu/analysis; never
+                                               traces or fetches)
   GET    /healthz                           -> liveness+readiness verdicts
                                                (200 live / 503 not); also
                                                /healthz/live, /healthz/ready
@@ -128,6 +133,13 @@ class SiddhiRestService:
                             deep = _qparam(query_str, "deep") != "0"
                             self._json(200, rt.explain(parts[3],
                                                        deep=deep))
+                    elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "lint":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                        else:
+                            self._json(200, rt.analyze())
                     elif parts == ["metrics"]:
                         # Prometheus scrape endpoint (text format 0.0.4);
                         # never touches the device — see observability/

@@ -70,6 +70,15 @@ def collect() -> Dict[str, List[Tuple[str, str]]]:
     out["stores"] = sorted(
         (name, _first_paragraph(cls.__doc__))
         for name, cls in store_registry().items())
+    # lint rule catalog straight from the analyzer's registry — the doc
+    # and the shipped rule set cannot drift (ID, severity, rationale,
+    # fix hint all come from the same Rule dataclass)
+    from ..analysis import catalog as lint_catalog
+    out["lint-rules"] = [
+        (r["id"],
+         f"**{r['severity']}** — {r['title']}. {r['rationale']} "
+         f"*Fix:* {r['hint']}")
+        for r in lint_catalog()]
     return out
 
 

@@ -1,0 +1,117 @@
+"""siddhi-lint CLI: static TPU-hazard analysis of SiddhiQL app files.
+
+    python -m siddhi_tpu.tools.lint app.siddhi [more.siddhi ...]
+        [--format text|json] [--fail-on info|warn|error]
+        [--disable RULE[,RULE...]] [--state-budget BYTES] [--rules]
+
+Exit-code contract (stable — CI scripts key on it):
+    0   no finding at or above the --fail-on severity (default: error)
+    1   at least one finding at or above the threshold
+    2   usage error, unreadable file, or SiddhiQL parse error
+
+Analysis is purely static (parse + plan-fact derivation): linting a
+broken-at-runtime app never constructs a runtime, traces, or compiles.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from ..analysis import (
+    LintConfig,
+    analyze,
+    catalog,
+    counts,
+    report,
+    severity_rank,
+)
+from ..compiler.tokenizer import SiddhiParserException
+
+_FAIL_LEVELS = {"info": "INFO", "warn": "WARN", "error": "ERROR"}
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m siddhi_tpu.tools.lint",
+        description="Static plan analyzer: catches TPU hazards "
+                    "(unbounded state, ignored @fuse, cap overflow, "
+                    "dead dataflow) before an app ever runs.")
+    p.add_argument("files", nargs="*", metavar="app.siddhi",
+                   help="SiddhiQL app files to analyze")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--fail-on", choices=tuple(_FAIL_LEVELS),
+                   default="error",
+                   help="exit 1 when any finding is at or above this "
+                        "severity (default: error)")
+    p.add_argument("--disable", default="",
+                   help="comma-separated rule IDs to skip")
+    p.add_argument("--state-budget", type=int, default=None,
+                   metavar="BYTES",
+                   help="MEM001 device-state budget in bytes "
+                        "(default: 128 MiB)")
+    p.add_argument("--rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def _print_rules(fmt: str) -> None:
+    cat = catalog()
+    if fmt == "json":
+        print(json.dumps(cat, indent=2))
+        return
+    for r in cat:
+        print(f"{r['id']}  {r['severity']:5s} {r['title']}")
+        print(f"    why: {r['rationale']}")
+        print(f"    fix: {r['hint']}")
+
+
+def main(argv: List[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.rules:
+        _print_rules(args.format)
+        return 0
+    if not args.files:
+        print("error: no app files given (see --help)", file=sys.stderr)
+        return 2
+
+    config = LintConfig(
+        disabled={r.strip() for r in args.disable.split(",")
+                  if r.strip()})
+    if args.state_budget is not None:
+        config.state_budget_bytes = args.state_budget
+    threshold = severity_rank(_FAIL_LEVELS[args.fail_on])
+
+    failed = False
+    json_out = {}
+    for path in args.files:
+        try:
+            with open(path, "r") as fh:
+                source = fh.read()
+        except OSError as exc:
+            print(f"error: cannot read {path}: {exc}", file=sys.stderr)
+            return 2
+        try:
+            findings = analyze(source, config=config, source_name=path)
+        except SiddhiParserException as exc:
+            print(f"{path}: PARSE ERROR {exc}", file=sys.stderr)
+            return 2
+        if any(severity_rank(f.severity) >= threshold
+               for f in findings):
+            failed = True
+        if args.format == "json":
+            json_out[path] = report(findings)
+        else:
+            for f in findings:
+                print(f.render())
+            c = counts(findings)
+            print(f"{path}: {c['ERROR']} error(s), {c['WARN']} "
+                  f"warning(s), {c['INFO']} info")
+    if args.format == "json":
+        print(json.dumps(json_out, indent=2, sort_keys=True))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,7 +9,7 @@ next-fire computation covers the expression subset the test corpus uses:
 from __future__ import annotations
 
 import datetime
-from typing import List, Optional
+from typing import Optional
 
 _FIELD_RANGES = [
     (0, 59),   # second

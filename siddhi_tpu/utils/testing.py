@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import sys
 import time
-from typing import Callable, Optional
+from typing import Callable
 
 
 def print_event(timestamp, in_events, out_events=None, out=None) -> None:

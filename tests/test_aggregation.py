@@ -1,7 +1,5 @@
 """Incremental aggregation (reference: CORE/aggregation/* and
 TEST/aggregation/AggregationTestCase behavioral patterns)."""
-import numpy as np
-import pytest
 
 from siddhi_tpu import SiddhiManager
 

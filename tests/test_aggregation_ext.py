@@ -114,7 +114,6 @@ aggregate by ts every seconds, minutes;
 
 
 def test_store_flush_and_rebuild():
-    from siddhi_tpu.io.store import InMemoryRecordStore
     m = SiddhiManager()
     rt = m.create_siddhi_app_runtime(STORE_QL)
     rt.start()

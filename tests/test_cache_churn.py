@@ -3,7 +3,6 @@ TEST/query/table/cache/{CacheFIFOTestCase, CacheLRUTestCase,
 CacheLFUTestCase, CacheMissTestCase, DeleteFromTableWithCacheTestCase,
 UpdateOrInsertTableWithCacheTestCase} — correctness must hold while the
 bounded cache continuously evicts)."""
-import numpy as np
 import pytest
 
 from siddhi_tpu import SiddhiManager

@@ -13,7 +13,6 @@ import logging
 
 import jax
 import numpy as np
-import pytest
 
 
 @contextlib.contextmanager

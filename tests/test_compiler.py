@@ -7,7 +7,6 @@ from siddhi_tpu.compiler.tokenizer import SiddhiParserException
 from siddhi_tpu.query_api import (
     AbsentStreamStateElement,
     Compare,
-    Constant,
     CountStateElement,
     EveryStateElement,
     JoinInputStream,
@@ -19,8 +18,6 @@ from siddhi_tpu.query_api import (
     StateInputStream,
     StreamStateElement,
     ValuePartitionType,
-    Variable,
-    Window,
 )
 
 

@@ -4,7 +4,6 @@ playback-clock behaviors (reference shape: FilterTestCase*, ratelimit ×3
 classes, window classes, TEST/store)."""
 import pytest
 
-from siddhi_tpu import SiddhiManager
 
 
 def _run(manager, ql, sends, query="q", stream="S", want="current"):
@@ -307,7 +306,6 @@ def test_fault_stream_routes_errors(manager):
 # -- debugger / utilities -----------------------------------------------------
 
 def test_debugger_breakpoint_next_play(manager):
-    import threading
     rt = manager.create_siddhi_app_runtime("""
     define stream S (v int);
     @info(name='q') from S select v * 2 as w insert into Out;

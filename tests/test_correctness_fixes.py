@@ -5,8 +5,10 @@ import numpy as np
 import pytest
 
 from siddhi_tpu import SiddhiManager
-from siddhi_tpu.exceptions import (ConnectionUnavailableException,
-                                   MatchOverflowError, PersistenceError)
+from siddhi_tpu.exceptions import (
+    ConnectionUnavailableException,
+    PersistenceError,
+)
 from siddhi_tpu.utils.persistence import InMemoryIncrementalPersistenceStore
 
 

@@ -6,7 +6,6 @@ import re
 import urllib.error
 import urllib.request
 
-import numpy as np
 import pytest
 
 import jax

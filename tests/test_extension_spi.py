@@ -5,7 +5,6 @@ types; here each kind has a decorator + setExtension inference)."""
 import jax.numpy as jnp
 import pytest
 
-from siddhi_tpu import SiddhiManager
 from siddhi_tpu.core.extension import (
     AttributeAggregator,
     attribute_aggregator,
@@ -301,7 +300,6 @@ def test_docgen_covers_new_kinds():
 # ---------------------------------------------------------------------------
 
 def test_custom_incremental_aggregator(manager):
-    import numpy as np
     from siddhi_tpu.core.extension import (
         IncrementalAttributeAggregator,
         incremental_attribute_aggregator,

@@ -5,7 +5,6 @@ Modeled on the reference's behavioral test pattern
 assert counts/payloads)."""
 import pytest
 
-from siddhi_tpu import Event, SiddhiManager
 from siddhi_tpu.query_api import (
     Expression as E,
     InputStream,

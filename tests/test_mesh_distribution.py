@@ -8,7 +8,6 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
-from siddhi_tpu import SiddhiManager
 from siddhi_tpu.core import event as ev
 
 

@@ -135,7 +135,6 @@ def test_composite_annotation_elements(manager):
 def test_manager_set_extension():
     """SiddhiManager.setExtension registers custom extensions with kind
     inference (reference: SiddhiManager.java:213)."""
-    import jax.numpy as jnp
 
     from siddhi_tpu.core.executor import CompiledExpr
 

@@ -1,9 +1,7 @@
 """Bidirectional named-window joins (reference: Window.java:145-184 — a
 named window in a join both exposes its buffer for probing AND triggers the
 join with events flowing through it; WindowWindowProcessor adapter)."""
-import pytest
 
-from siddhi_tpu import SiddhiManager
 
 
 def _mk(manager, ql, query="q"):

@@ -5,11 +5,8 @@ false on null, math executors propagate null, aggregators skip null).
 TPU design: in-band reserved values (INT/LONG minimum, float NaN) ride the
 columns; every host decode boundary maps them back to None (core/event.py
 null_value/null_mask)."""
-import math
 
-import pytest
 
-from siddhi_tpu import SiddhiManager
 
 
 def _run(manager, ql, sends, query="q", stream="S"):

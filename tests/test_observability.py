@@ -82,7 +82,9 @@ def test_report_has_latency_quantiles(manager):
     q = rep["queries"]["q"]
     assert q["events"] == 20
     assert 0 < q["p50_us"] <= q["p95_us"] <= q["p99_us"]
-    assert q["p99_us"] <= q["max_latency_ms"] * 1000
+    # tiny epsilon: p99 can equal max exactly, and max_ns/1e6*1000
+    # rounds differently than max_ns/1e3 at the last float ulp
+    assert q["p99_us"] <= q["max_latency_ms"] * 1000 * (1 + 1e-9)
     assert q["avg_latency_us"] > 0
     # junction-hop histogram rides along at BASIC
     assert rep["junctions"]["S"]["count"] == 20

@@ -1,7 +1,6 @@
 """On-demand query plan LRU cache (reference: SiddhiAppRuntimeImpl.java
 :304-367 keeps up to 50 compiled OnDemandQueryRuntimes keyed by query
 string; a repeated store query must not re-parse or re-plan)."""
-import pytest
 
 from siddhi_tpu import SiddhiManager
 

@@ -3,9 +3,7 @@ debugger (reference: TEST/stream/OnErrorTestCase patterns,
 TEST/managment/PersistenceTestCase, StatisticsTestCase,
 TEST/debugger/SiddhiDebuggerTestCase)."""
 import threading
-import time
 
-import pytest
 
 from siddhi_tpu import SiddhiManager
 from siddhi_tpu.core.extension import scalar_function

@@ -1,6 +1,5 @@
 """Ops-layer corpus: config, statistics, exceptions, persistence stores,
 extension registry (reference shape: TEST/managment/* + config tests)."""
-import os
 
 import pytest
 

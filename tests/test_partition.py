@@ -1,7 +1,6 @@
 """Partition tests (modeled on TEST/query/partition/PartitionTestCase1)."""
 import pytest
 
-from siddhi_tpu import SiddhiManager
 
 
 def run_app(manager, ql, sends, query="query1"):

@@ -105,7 +105,6 @@ end;
 
 
 def test_purge_recycles_pattern_slots(manager):
-    from siddhi_tpu.exceptions import CapacityExceededError
     rt = manager.create_siddhi_app_runtime(PURGE_QL)
     got = []
     rt.add_callback("p", lambda ts, i, o: got.extend(

@@ -2,7 +2,6 @@
 TEST/query/sequence/* behavioral cases)."""
 import pytest
 
-from siddhi_tpu import SiddhiManager
 
 
 def run_app(manager, ql, sends, query="query1"):

@@ -1,6 +1,5 @@
 """Golden pattern/sequence corpus (reference shape: TEST/query/pattern/* —
 Complex/Count/Every/Logical/Within and absent variants, plus sequences)."""
-import pytest
 
 from siddhi_tpu import SiddhiManager
 

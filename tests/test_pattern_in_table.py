@@ -2,9 +2,7 @@
 CORE/executor/condition/InConditionExpressionExecutor evaluated inside
 StreamPreStateProcessor conditions).  The table's column snapshot ships
 into the jitted NFA step per batch; the probe is one dense compare."""
-import pytest
 
-from siddhi_tpu import SiddhiManager
 
 
 def _mk(manager, ql, query="q"):

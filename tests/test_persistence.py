@@ -1,7 +1,6 @@
 """Snapshot/persist/restore: full + incremental chains, filesystem stores,
 async persistor, table state (reference: PersistenceTestCase,
 IncrementalPersistenceTestCase)."""
-import numpy as np
 import pytest
 
 from siddhi_tpu import SiddhiManager

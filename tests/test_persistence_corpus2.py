@@ -2,7 +2,6 @@
 combined app (window + table + pattern + named window together), restore
 idempotence, and revision selection (reference shape:
 TEST/managment/PersistenceTestCase multi-element cases)."""
-import pytest
 
 from siddhi_tpu import SiddhiManager
 from siddhi_tpu.utils.persistence import InMemoryPersistenceStore

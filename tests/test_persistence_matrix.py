@@ -1,7 +1,6 @@
 """Persist -> restore-into-fresh-runtime matrix across query classes
 (reference: TEST/managment/PersistenceTestCase's per-feature restore
 cases: windows, aggregations, sessions, tables mid-stream)."""
-import pytest
 
 from siddhi_tpu import SiddhiManager
 from siddhi_tpu.utils.persistence import FileSystemPersistenceStore

@@ -2,9 +2,7 @@
 batch N+1 overlaps the device step of batch N on the producer thread (the
 Disruptor-role alternative to @async that adds no thread — the win on a
 single-core host feeding an accelerator)."""
-import pytest
 
-from siddhi_tpu import SiddhiManager
 
 
 def test_pipeline_defers_one_batch_then_flushes(manager):

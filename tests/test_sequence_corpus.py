@@ -2,7 +2,6 @@
 SequenceTestCase.java, 33 cases — comma-separated sequences where each
 state must match the IMMEDIATELY next event, with Kleene */+/?, logical
 partners, and indexed counting captures)."""
-import pytest
 
 from siddhi_tpu import SiddhiManager
 

@@ -1,7 +1,6 @@
 """session(gap, key, allowed.latency) — late-arrival grace (reference:
 SessionWindowTestCase.java testSessionWindow14/17-20 shapes over
 SessionWindowProcessor.java's previous-session machinery)."""
-import pytest
 
 from siddhi_tpu import SiddhiManager
 

@@ -1,6 +1,5 @@
 """@store record tables, the RecordTable SPI, and cache policies
 (reference: AbstractRecordTable, CacheTable FIFO/LRU/LFU, TestStore)."""
-import numpy as np
 import pytest
 
 from siddhi_tpu import SiddhiManager

@@ -2,7 +2,6 @@
 TEST/query/join/JoinTestCase behavioral cases)."""
 import pytest
 
-from siddhi_tpu import SiddhiManager
 
 
 def collect(rt, name):

@@ -3,7 +3,6 @@ TEST/query/table/PrimaryKeyTableTestCase.java's 40 cases +
 IndexTableTestCase.java's 33 — every condition form against keyed tables:
 point/range probes, compound conditions, `in` membership, updates/deletes
 on PK, and non-indexed fallbacks giving identical results)."""
-import numpy as np
 import pytest
 
 from siddhi_tpu import SiddhiManager

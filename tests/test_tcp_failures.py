@@ -5,7 +5,6 @@ next publish; receiver boots late, lazy dial + source connect-retry bridge
 the gap; a sender with no receiver surfaces the failure to the app's error
 path instead of crashing the producer."""
 import socket
-import threading
 import time
 
 import pytest

@@ -4,7 +4,6 @@ import time
 
 import pytest
 
-from siddhi_tpu import SiddhiManager
 from siddhi_tpu.query_api import (
     Expression as E,
     InputStream,

@@ -1,7 +1,6 @@
 """Extended window types (reference: TEST/query/window/
 {ExternalTimeWindow,ExternalTimeBatchWindow,TimeLengthWindow,DelayWindow,
 SortWindow,SessionWindow,FrequentWindow}TestCase behavioral assertions)."""
-import pytest
 
 from siddhi_tpu import SiddhiManager
 
