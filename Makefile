@@ -3,10 +3,10 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test lint lint-apps lint-smoke dryrun bench metrics-smoke \
-	fuse-smoke explain-smoke all
+	fuse-smoke explain-smoke chaos-smoke all
 
 all: lint lint-apps test dryrun metrics-smoke fuse-smoke explain-smoke \
-	lint-smoke
+	lint-smoke chaos-smoke
 
 # static gate on our own code: ruff (rule set in pyproject.toml) when
 # available, with compileall kept as the syntax floor for samples and
@@ -55,3 +55,10 @@ fuse-smoke:
 # siddhi_state_bytes family scrapes (observability v2 layer)
 explain-smoke:
 	$(CPU_ENV) $(PY) samples/explain_smoke.py
+
+# deterministic fault injection end-to-end: retry zero-loss, error
+# store + REST replay exactly-once, breaker -> degraded /healthz, and
+# torn-snapshot restore fallback (resilience layer, README "Fault
+# tolerance")
+chaos-smoke:
+	$(CPU_ENV) $(PY) samples/chaos_smoke.py

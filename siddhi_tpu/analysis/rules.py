@@ -421,7 +421,52 @@ def _unnamed_app(ctx: AnalysisContext) -> Iterator[Finding]:
                  "default name 'SiddhiApp'")
 
 
+# ---------------------------------------------------------------------------
+# I/O resilience
+# ---------------------------------------------------------------------------
+
+@rule("SINK001", "WARN",
+      "@sink on a high-rate stream silently drops failed events",
+      "The default @sink(on.error='log') policy logs a transport "
+      "failure and DROPS the affected events.  On a stream fed at "
+      "engine rate (a query output or an @async ingress) a short "
+      "broker/socket outage silently loses a window of output with "
+      "nothing but a log line to show for it — and no fault stream is "
+      "defined to catch them either.",
+      "set @sink(on.error='retry') (buffered redelivery), 'store' "
+      "(error store + replay), 'wait' (backpressure), or 'stream' + a "
+      "`!stream` consumer, or add @OnError(action='STREAM') to the "
+      "stream")
+def _sink_silent_drop(ctx: AnalysisContext) -> Iterator[Finding]:
+    app = ctx.app
+    writes = _stream_writes(app)
+    for sid, sdef in app.stream_definition_map.items():
+        if sid.startswith(("!", "#")):
+            continue
+        # high-rate: events arrive at engine rate (query output) or
+        # through an async ingress ring, not hand-fed test traffic
+        if sid not in writes and sdef.get_annotation("async") is None:
+            continue
+        on_err = sdef.get_annotation("OnError")
+        if on_err is not None and \
+                str(on_err.element("action", "LOG")).upper() == "STREAM":
+            continue
+        for ann in sdef.annotations:
+            if ann.name.lower() != "sink":
+                continue
+            policy = str(ann.element("on.error", "log")).lower()
+            if policy != "log":
+                continue
+            stype = ann.element("type") or ann.element(None)
+            yield _f(f"@sink(type={str(stype)!r}) on high-rate stream "
+                     f"{sid!r} uses the default on.error='log' and no "
+                     "fault stream is defined — a transport outage "
+                     "silently drops every event published during it",
+                     query=None, node=ann)
+
+
 ALL_RULE_IDS: List[str] = [
     "STATE001", "STATE002", "MEM001", "FUSE001", "JOIN001",
     "DEAD001", "DEAD002", "PART001", "TYPE001", "RATE001", "APP001",
+    "SINK001",
 ]

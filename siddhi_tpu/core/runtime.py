@@ -1565,6 +1565,13 @@ class StreamJunction:
                     for e in events]
                 self.app._route(fault_id, fault_events)
                 return
+        if self.on_error == "STORE" and self.app is not None:
+            # @OnError(action='STORE'): capture the failed events for
+            # inspection/replay (reference: ErrorStore.saveOnError)
+            store = getattr(self.app, "error_store", None)
+            if store is not None and events:
+                store.store(self.stream_id, events, exc, origin="junction")
+                return
         logging.getLogger("siddhi_tpu").error(
             "error processing %r events: %s", self.stream_id, exc)
         listener = getattr(self.app, "exception_listener", None)
@@ -1574,9 +1581,14 @@ class StreamJunction:
     def _handle_error_staged(self, staged: ev.StagedBatch, exc: Exception,
                              now: int) -> None:
         """Columnar-path twin of _handle_error: rows decode to host events
-        only when a fault stream actually consumes them."""
-        if self.on_error == "STREAM" and self.app is not None and \
-                ("!" + self.stream_id) in self.app.junctions:
+        only when a fault stream or the error store actually consumes
+        them."""
+        wants_events = (
+            self.on_error == "STREAM" and self.app is not None and
+            ("!" + self.stream_id) in self.app.junctions) or (
+            self.on_error == "STORE" and
+            getattr(self.app, "error_store", None) is not None)
+        if wants_events:
             idx = np.nonzero(staged.valid)[0]
             events = []
             for i in idx.tolist():
@@ -2067,6 +2079,19 @@ class SiddhiAppRuntime:
             self._stats_reporter = ConsoleReporter(self, iv / 1000.0)
         self.exception_listener = None
 
+        # error store: failed events captured by @OnError(action='STORE')
+        # and @sink(on.error='store'), replayable via replay_errors()/
+        # REST (reference: core.util.error.handler ErrorStore).  SPI:
+        # assign a custom ErrorStore before start().
+        from ..io.errorstore import InMemoryErrorStore
+        es_ann = app.get_annotation("app:errorStore")
+        self.error_store = InMemoryErrorStore(
+            capacity=int(es_ann.element("capacity", 1024))
+            if es_ann is not None else 1024)
+        # snapshot revisions skipped as corrupt/unreadable during
+        # restore_last_revision (siddhi_restore_fallbacks_total)
+        self.restore_fallbacks = 0
+
         # schemas & junctions
         self.schemas: Dict[str, ev.Schema] = {}
         self.junctions: Dict[str, StreamJunction] = {}
@@ -2190,14 +2215,23 @@ class SiddhiAppRuntime:
         self.junctions[sdef.id] = StreamJunction(
             schema, stream_id=sdef.id, on_error=on_error, app=self)
         if on_error == "STREAM" and not sdef.id.startswith("!"):
-            # auto-define the `!stream` fault stream: original attrs +
-            # `_error` (reference: FaultStreamEventConverter)
-            fdef = StreamDefinition("!" + sdef.id)
-            for a in sdef.attribute_list:
-                fdef.attribute(a.name, a.type)
-            fdef.attribute("_error", "STRING")
-            self.app.stream_definition_map[fdef.id] = fdef
-            self._define_stream_runtime(fdef)
+            self._ensure_fault_stream(sdef.id)
+
+    def _ensure_fault_stream(self, stream_id: str) -> None:
+        """Auto-define the `!stream` fault stream: original attrs +
+        `_error` (reference: FaultStreamEventConverter).  Used by
+        @OnError(action='STREAM') and @sink(on.error='stream') — both
+        route failures into the same junction."""
+        fault_id = "!" + stream_id
+        if fault_id in self.junctions or stream_id.startswith("!"):
+            return
+        sdef = self.app.stream_definition_map[stream_id]
+        fdef = StreamDefinition(fault_id)
+        for a in sdef.attribute_list:
+            fdef.attribute(a.name, a.type)
+        fdef.attribute("_error", "STRING")
+        self.app.stream_definition_map[fdef.id] = fdef
+        self._define_stream_runtime(fdef)
 
     def _query_name(self, q: Query, i: int) -> str:
         info = q.get_annotation("info")
@@ -2961,6 +2995,35 @@ class SiddhiAppRuntime:
             raise DefinitionNotExistError(f"undefined stream {stream_id!r}")
         return InputHandler(stream_id, self)
 
+    def replay_errors(self, ids=None, stream_id: Optional[str] = None
+                      ) -> Dict[str, int]:
+        """Re-inject error-store entries through the normal InputHandler
+        path, original timestamps preserved (reference: the error
+        store's replay admin API).  Entries leave the store BEFORE
+        injection — exactly-once handoff; if re-processing fails again
+        the failure path captures them as fresh entries.  Returns
+        {"entries": n, "events": m, "skipped": k}."""
+        taken = self.error_store.take(ids=ids, stream_id=stream_id)
+        n_entries = n_events = skipped = 0
+        for entry in taken:
+            if entry.stream_id not in self.junctions:
+                # stream vanished (app edit between capture and replay):
+                # keep the events instead of silently losing them
+                self.error_store.store(
+                    entry.stream_id, entry.events,
+                    RuntimeError(f"replay skipped: stream "
+                                 f"{entry.stream_id!r} no longer exists"),
+                    origin=entry.origin)
+                skipped += 1
+                continue
+            h = self.get_input_handler(entry.stream_id)
+            for e in entry.events:
+                h.send(e)
+            n_entries += 1
+            n_events += len(entry.events)
+        return {"entries": n_entries, "events": n_events,
+                "skipped": skipped}
+
     def add_batch_callback(self, query_name: str, cb) -> None:
         """High-throughput query callback receiving columnar numpy batches
         (ts, kind, valid, cols dict) without per-event decoding."""
@@ -3610,24 +3673,56 @@ class SiddhiManager:
             rt.restore(blob)
 
     def restore_last_revision(self) -> None:
+        """Restore every app from its newest INTACT revision.  A corrupt
+        or unreadable revision (torn write, CRC mismatch, truncation —
+        see utils/persistence.seal/unseal) is skipped with a warning and
+        the previous revision is tried, bumping the app's
+        `restore_fallbacks` counter (siddhi_restore_fallbacks_total);
+        CannotRestoreStateError is raised only when revisions exist but
+        NONE of them restores."""
+        import logging
         from ..utils.persistence import IncrementalPersistenceStore
+        _log = logging.getLogger("siddhi_tpu")
         self.wait_for_persistence()
         store = self.persistence_store
         for name, rt in self.runtimes.items():
             if isinstance(store, IncrementalPersistenceStore):
-                chain = store.load_chain(name)
+                try:
+                    chain = store.load_chain(name)
+                except Exception as exc:  # noqa: BLE001 — corrupt base
+                    rt.restore_fallbacks += 1
+                    _log.error(
+                        "incremental chain for %s unrestorable (%r); "
+                        "state NOT restored", name, exc)
+                    continue
                 if chain is None:
                     continue
                 base, incs = chain
                 rt.restore(base)
                 for inc in incs:
                     rt.restore_increment(inc)
-            else:
-                rev = store.get_last_revision(name)
-                if rev is not None:
+                continue
+            revs = store.get_revisions(name)
+            if not revs:
+                continue
+            restored = False
+            for rev in reversed(revs):
+                try:
                     blob = store.load(name, rev)
-                    if blob is not None:
-                        rt.restore(blob)
+                    if blob is None:
+                        continue
+                    rt.restore(blob)
+                    restored = True
+                    break
+                except Exception as exc:  # noqa: BLE001 — fall back
+                    rt.restore_fallbacks += 1
+                    _log.warning(
+                        "revision %r of %s unrestorable (%r); falling "
+                        "back to the previous revision", rev, name, exc)
+            if not restored:
+                raise CannotRestoreStateError(
+                    f"no intact revision among {len(revs)} stored for "
+                    f"app {name!r}")
 
     def shutdown(self) -> None:
         for rt in self.runtimes.values():

@@ -91,10 +91,24 @@ class CannotRestoreStateError(PersistenceError):
     CannotRestoreSiddhiAppStateException)."""
 
 
+class CorruptSnapshotError(PersistenceError):
+    """A stored snapshot failed its CRC32 integrity check (torn write,
+    truncation, or bit rot).  restore_last_revision() treats this as
+    "skip to the previous good revision", never as fatal."""
+
+
 # -- I/O ----------------------------------------------------------------------
-class ConnectionUnavailableException(SiddhiError):
+class ConnectionUnavailableError(SiddhiError):
     """Source/sink/store backing system unreachable (reference:
-    CORE/exception/ConnectionUnavailableException)."""
+    CORE/exception/ConnectionUnavailableException).  Transports raise
+    THIS (not bare OSError/ValueError) for connectivity failures so the
+    resilience layer (io/resilience.py) can distinguish a retryable
+    transport outage from an application bug."""
+
+
+# historical name, kept importable: pre-resilience code and extensions
+# caught the Java-style spelling
+ConnectionUnavailableException = ConnectionUnavailableError
 
 
 class MappingFailedError(SiddhiAppRuntimeError):

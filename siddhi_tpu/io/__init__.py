@@ -4,7 +4,9 @@ Reference: CORE/stream/input/source/*, CORE/stream/output/sink/*,
 CORE/util/transport/InMemoryBroker.java.
 """
 from .broker import InMemoryBroker
+from .errorstore import ErrorStore, InMemoryErrorStore
 from .mappers import SINK_MAPPERS, SOURCE_MAPPERS
+from .resilience import BackoffPolicy, SinkConnection
 from .sink import SinkRuntime, register_sink_type
 from .source import SourceRuntime, register_source_type
 from . import tcp as _tcp  # registers the 'tcp' source/sink transport pair
@@ -17,4 +19,8 @@ __all__ = [
     "SINK_MAPPERS",
     "register_source_type",
     "register_sink_type",
+    "BackoffPolicy",
+    "SinkConnection",
+    "ErrorStore",
+    "InMemoryErrorStore",
 ]

@@ -1,0 +1,132 @@
+"""Error store: failed events captured for inspection and replay.
+
+Reference (what): the reference's `ErrorStore` SPI
+(core.util.error.handler) captures events whose processing or publish
+failed — `@OnError(action='STORE')` and `@sink(on.error='store')` both
+feed it — and an admin API lists and replays them through the normal
+input path.
+
+TPU design (how): an in-memory, bounded, SPI-extensible store.  Entries
+keep decoded host events (never device buffers), so storing is cheap
+relative to the failure that produced it and replay re-enters through
+`InputHandler.send` exactly like live traffic.  Capacity is bounded
+with an explicit drop counter — an outage that overflows the store
+must surface as a number, not an OOM.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _py(v: Any) -> Any:
+    """JSON-safe host value (numpy scalars -> python)."""
+    return v.item() if hasattr(v, "item") else v
+
+
+class ErroredEvent:
+    """One failure capture: the events of one failed publish/processing
+    attempt plus the error that rejected them."""
+
+    __slots__ = ("id", "stream_id", "origin", "error", "ts_ms", "events")
+
+    def __init__(self, id: int, stream_id: str, origin: str, error: str,
+                 ts_ms: int, events: List):
+        self.id = id
+        self.stream_id = stream_id
+        self.origin = origin          # 'sink' | 'junction'
+        self.error = error
+        self.ts_ms = ts_ms
+        self.events = events          # List[core.event.Event]
+
+    def to_dict(self) -> Dict:
+        return {
+            "id": self.id,
+            "stream": self.stream_id,
+            "origin": self.origin,
+            "error": self.error,
+            "ts_ms": self.ts_ms,
+            "events": [
+                {"timestamp": _py(e.timestamp),
+                 "data": [_py(v) for v in e.data]}
+                for e in self.events],
+        }
+
+
+class ErrorStore:
+    """SPI: capture failed events, list them, hand them out for replay.
+    Subclass to persist elsewhere (DB, queue); register per runtime via
+    `runtime.error_store = MyStore(...)` before start()."""
+
+    def store(self, stream_id: str, events: List, error: Exception,
+              origin: str = "sink") -> None:
+        raise NotImplementedError
+
+    def entries(self, stream_id: Optional[str] = None) -> List[ErroredEvent]:
+        raise NotImplementedError
+
+    def take(self, ids: Optional[List[int]] = None,
+             stream_id: Optional[str] = None) -> List[ErroredEvent]:
+        """Remove and return matching entries (replay's exactly-once
+        handoff: entries leave the store BEFORE re-injection)."""
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class InMemoryErrorStore(ErrorStore):
+    """Bounded FIFO store.  At capacity the OLDEST entry is evicted
+    (and counted) — under a sustained outage the operator replays the
+    tail of the failure window, which is the actionable part."""
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = max(1, int(capacity))
+        self._entries: List[ErroredEvent] = []
+        self._lock = threading.Lock()
+        self._next_id = 1
+        self.dropped_total = 0
+        self.stored_total = 0
+        self.replayed_total = 0
+
+    def store(self, stream_id, events, error, origin="sink"):
+        if not events:
+            return
+        with self._lock:
+            e = ErroredEvent(self._next_id, stream_id, origin, repr(error),
+                             int(time.time() * 1000), list(events))
+            self._next_id += 1
+            self._entries.append(e)
+            self.stored_total += len(events)
+            while len(self._entries) > self.capacity:
+                evicted = self._entries.pop(0)
+                self.dropped_total += len(evicted.events)
+
+    def entries(self, stream_id=None):
+        with self._lock:
+            return [e for e in self._entries
+                    if stream_id is None or e.stream_id == stream_id]
+
+    def take(self, ids=None, stream_id=None):
+        with self._lock:
+            want = set(ids) if ids is not None else None
+            taken, kept = [], []
+            for e in self._entries:
+                match = (want is None or e.id in want) and \
+                    (stream_id is None or e.stream_id == stream_id)
+                (taken if match else kept).append(e)
+            self._entries = kept
+            self.replayed_total += sum(len(e.events) for e in taken)
+            return taken
+
+    def stats(self):
+        with self._lock:
+            return {
+                "buffered": sum(len(e.events) for e in self._entries),
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "stored": self.stored_total,
+                "dropped": self.dropped_total,
+                "replayed": self.replayed_total,
+            }

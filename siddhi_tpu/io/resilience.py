@@ -1,0 +1,347 @@
+"""Sink/source resilience: backoff, connection state machine, breaker.
+
+Reference (what): the reference treats I/O failure as a first-class
+state machine — `ConnectionUnavailableException` triggers
+backoff-driven reconnect loops (Source.connectWithRetry :155-169 +
+BackoffRetryCounter), and `@sink(on.error=...)` selects a per-transport
+recovery policy (Sink.onError: RETRY blocks-and-redials, WAIT
+backpressures the caller, LOG drops loudly, STREAM routes into the
+`!stream` fault stream).
+
+TPU design (how): the engine fronts a remote accelerator, so a sink
+stall must never stall the dispatch path longer than the caller asked
+for.  One `SinkConnection` wraps each transport with a
+CONNECTED/RETRYING/BROKEN state machine:
+
+- **CONNECTED**: publishes go straight to the transport.
+- **RETRYING** (`on.error='retry'`): failed + subsequent payloads land
+  in a bounded in-flight buffer while a background thread redials with
+  exponential backoff + jitter, then re-publishes the buffer in order
+  (zero loss when the transport recovers within the buffer bound).
+- **BROKEN**: after `breaker.failures` consecutive failures the circuit
+  trips; load is shed immediately (no buffering, no blocking) until a
+  half-open probe — the next reconnect attempt, paced at the probe
+  interval — succeeds.
+
+`on.error='wait'` retries on the CALLER's thread with the same backoff
+up to a deadline (backpressure, reference WAIT semantics); 'log',
+'stream', and 'store' attempt once and let SinkRuntime route the failed
+events (log-and-drop, `!stream` fault path, error store).
+
+Clock and sleep are injectable so tests drive the machine with a fake
+clock — CI never depends on real backoff sleeps.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional
+
+from ..exceptions import ConnectionUnavailableError
+
+log = logging.getLogger("siddhi_tpu")
+
+# connection states (stable API: health/metrics expose these strings)
+CONNECTED = "CONNECTED"
+RETRYING = "RETRYING"
+BROKEN = "BROKEN"
+
+_STATE_GAUGE = {CONNECTED: 0, RETRYING: 1, BROKEN: 2}
+
+# sink on.error policies (reference: Sink.OnErrorAction + error store)
+SINK_POLICIES = ("log", "retry", "wait", "stream", "store")
+
+
+def state_gauge(state: str) -> int:
+    """Numeric encoding for the siddhi_sink_breaker_state gauge."""
+    return _STATE_GAUGE.get(state, 1)
+
+
+class BackoffPolicy:
+    """Exponential backoff with full-jitter cap (reference:
+    BackoffRetryCounter's geometric sequence; jitter added so a fleet of
+    reconnecting sinks doesn't thundering-herd a recovering broker)."""
+
+    def __init__(self, initial_s: float = 0.1, multiplier: float = 2.0,
+                 max_s: float = 5.0, jitter: float = 0.25,
+                 rng: Optional[random.Random] = None):
+        self.initial_s = max(1e-4, float(initial_s))
+        self.multiplier = max(1.0, float(multiplier))
+        self.max_s = max(self.initial_s, float(max_s))
+        self.jitter = min(1.0, max(0.0, float(jitter)))
+        self.rng = rng or random.Random()
+
+    def delay(self, attempt: int) -> float:
+        """Delay before retry number `attempt` (0-based), jittered."""
+        base = min(self.initial_s * self.multiplier ** max(0, attempt),
+                   self.max_s)
+        if not self.jitter:
+            return base
+        return base * (1.0 - self.jitter * self.rng.random())
+
+    @classmethod
+    def from_options(cls, options: Dict[str, Any],
+                     rng: Optional[random.Random] = None) -> "BackoffPolicy":
+        """Build from @sink/@source annotation options (ms-denominated,
+        matching the reference's *.ms config keys):
+        retry.initial.ms / retry.multiplier / retry.max.ms /
+        retry.jitter."""
+        return cls(
+            initial_s=float(options.get("retry.initial.ms", 100)) / 1e3,
+            multiplier=float(options.get("retry.multiplier", 2.0)),
+            max_s=float(options.get("retry.max.ms", 5000)) / 1e3,
+            jitter=float(options.get("retry.jitter", 0.25)),
+            rng=rng)
+
+
+class SinkConnection:
+    """State machine wrapping ONE transport Sink (one per @destination).
+
+    Only `ConnectionUnavailableError` drives the machine — an
+    application bug raised by a transport must not trip the breaker or
+    start redial loops.  All mutation happens under `_lock`; `state`,
+    `retries_total`, and `dropped_total` are read lock-free by the
+    metrics/health scrape path."""
+
+    def __init__(self, sink, stream_id: str = "", policy: str = "log",
+                 backoff: Optional[BackoffPolicy] = None,
+                 buffer_size: int = 1024, breaker_failures: int = 5,
+                 wait_timeout_s: float = 30.0,
+                 probe_interval_s: Optional[float] = None,
+                 on_drop: Optional[Callable[[Any, str], None]] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        if policy not in SINK_POLICIES:
+            raise ValueError(
+                f"unknown on.error policy {policy!r}; one of "
+                f"{SINK_POLICIES}")
+        self.sink = sink
+        self.stream_id = stream_id
+        self.policy = policy
+        self.backoff = backoff or BackoffPolicy()
+        self.buffer_size = max(1, int(buffer_size))
+        self.breaker_failures = max(1, int(breaker_failures))
+        self.wait_timeout_s = float(wait_timeout_s)
+        self.probe_interval_s = float(
+            probe_interval_s if probe_interval_s is not None
+            else self.backoff.max_s)
+        self.on_drop = on_drop
+        self._clock = clock
+
+        self.state = CONNECTED
+        self.retries_total = 0
+        self.dropped_total = 0
+        self.published_total = 0
+        self._consecutive = 0
+        self._next_probe = 0.0
+        self._buffer: deque = deque()
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------------
+    def connect(self) -> None:
+        self._stop.clear()
+        try:
+            self.sink.connect()
+            self.state = CONNECTED
+        except ConnectionUnavailableError as exc:
+            # start degraded: retry policy redials in the background,
+            # the rest reconnect lazily on the next publish
+            log.warning("sink for %r failed to connect (%r); will retry",
+                        self.stream_id, exc)
+            with self._lock:
+                self.state = RETRYING
+                if self.policy == "retry":
+                    self._ensure_worker()
+
+    def close(self) -> None:
+        self._stop.set()
+        w = self._worker
+        if w is not None:
+            w.join(timeout=2.0)
+        with self._lock:
+            n = len(self._buffer)
+            self._buffer.clear()
+        if n:
+            self._count_drop(None, "shutdown", n)
+        try:
+            self.sink.disconnect()
+        except Exception:  # noqa: BLE001 — teardown best-effort
+            pass
+
+    def buffered(self) -> int:
+        with self._lock:
+            return len(self._buffer)
+
+    # -- publish ---------------------------------------------------------------
+    def publish(self, payload: Any) -> None:
+        """Publish one payload under this connection's policy.  Raises
+        ConnectionUnavailableError only when the policy hands the
+        failure back to the caller: 'log'/'stream'/'store' after their
+        single attempt (SinkRuntime routes the events), 'wait' after
+        its deadline, and any policy while the breaker is open."""
+        if self.policy == "retry":
+            self._publish_retry(payload)
+            return
+        if self.state == BROKEN and self._clock() < self._next_probe:
+            raise ConnectionUnavailableError(
+                f"sink for {self.stream_id!r} circuit open "
+                f"({self._consecutive} consecutive failures); next "
+                f"half-open probe in "
+                f"{self._next_probe - self._clock():.2f}s")
+        try:
+            self._attempt(payload)
+        except ConnectionUnavailableError:
+            if self.policy == "wait":
+                self._publish_wait(payload)
+            else:
+                raise
+
+    def _attempt(self, payload: Any) -> None:
+        """One transport attempt; success/failure drives the machine."""
+        try:
+            self.sink.publish(payload)
+        except ConnectionUnavailableError:
+            self._on_failure()
+            raise
+        with self._lock:
+            self.published_total += 1
+            self._consecutive = 0
+            self.state = CONNECTED
+
+    def _on_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            if self._consecutive >= self.breaker_failures:
+                if self.state != BROKEN:
+                    log.error(
+                        "sink for %r: circuit BROKEN after %d consecutive "
+                        "failures; shedding load (half-open probe every "
+                        "%.1fs)", self.stream_id, self._consecutive,
+                        self.probe_interval_s)
+                self.state = BROKEN
+                self._next_probe = self._clock() + self.probe_interval_s
+            elif self.state == CONNECTED:
+                self.state = RETRYING
+
+    # -- wait policy (caller-thread backpressure) ------------------------------
+    def _sleep(self, delay: float) -> bool:
+        """Interruptible sleep; True = shutting down.  Tests monkeypatch
+        this (or `_clock`) with a fake clock for determinism."""
+        return self._stop.wait(delay)
+
+    def _publish_wait(self, payload: Any) -> None:
+        deadline = self._clock() + self.wait_timeout_s
+        attempt = 0
+        while True:
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                raise ConnectionUnavailableError(
+                    f"sink for {self.stream_id!r} unavailable after "
+                    f"blocking {self.wait_timeout_s:.1f}s "
+                    f"(on.error='wait' deadline)")
+            if self._sleep(min(self.backoff.delay(attempt), remaining)):
+                raise ConnectionUnavailableError(
+                    f"sink for {self.stream_id!r} shut down while a "
+                    "publish was blocked in on.error='wait'")
+            with self._lock:
+                self.retries_total += 1
+            try:
+                self._reconnect()
+                self._attempt(payload)
+                return
+            except ConnectionUnavailableError:
+                attempt += 1
+
+    # -- retry policy (background redial + ordered replay) ---------------------
+    def _publish_retry(self, payload: Any) -> None:
+        with self._lock:
+            if self.state == BROKEN:
+                # shed unless the half-open probe is due; the probe is
+                # the worker's next redial, so just wake it via buffer
+                if self._clock() < self._next_probe:
+                    self._count_drop(payload, "breaker-open", 1)
+                    return
+                self._buffer_or_drop(payload)
+                self._ensure_worker()
+                return
+            if self.state == RETRYING:
+                # keep publish order: never overtake buffered payloads
+                self._buffer_or_drop(payload)
+                self._ensure_worker()
+                return
+        try:
+            self._attempt(payload)
+        except ConnectionUnavailableError:
+            with self._lock:
+                self._buffer_or_drop(payload)
+                self._ensure_worker()
+
+    def _buffer_or_drop(self, payload: Any) -> None:
+        if len(self._buffer) >= self.buffer_size:
+            self._count_drop(payload, "buffer-full", 1)
+            return
+        self._buffer.append(payload)
+
+    def _count_drop(self, payload: Any, reason: str, n: int) -> None:
+        self.dropped_total += n
+        if self.on_drop is not None:
+            try:
+                self.on_drop(payload, reason)
+            except Exception:  # noqa: BLE001 — drop hook must not throw
+                pass
+        else:
+            log.warning("sink for %r dropped %d payload(s): %s",
+                        self.stream_id, n, reason)
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._worker = threading.Thread(
+            target=self._redial_loop, daemon=True,
+            name=f"sink-retry-{self.stream_id}")
+        self._worker.start()
+
+    def _reconnect(self) -> None:
+        """Drop the (presumed dead) transport session and dial fresh."""
+        try:
+            self.sink.disconnect()
+        except Exception:  # noqa: BLE001 — dead transports throw freely
+            pass
+        self.sink.connect()
+
+    def _redial_loop(self) -> None:
+        attempt = 0
+        while not self._stop.is_set():
+            delay = self.probe_interval_s if self.state == BROKEN \
+                else self.backoff.delay(attempt)
+            if self._sleep(delay):
+                return
+            with self._lock:
+                self.retries_total += 1
+            try:
+                self._reconnect()
+                # replay the in-flight buffer IN ORDER; a failure mid-
+                # drain leaves the remainder buffered for the next round
+                while True:
+                    with self._lock:
+                        if not self._buffer:
+                            break
+                        head = self._buffer[0]
+                    self.sink.publish(head)
+                    with self._lock:
+                        self._buffer.popleft()
+                        self.published_total += 1
+                with self._lock:
+                    self._consecutive = 0
+                    if self.state != CONNECTED:
+                        log.info("sink for %r reconnected after %d "
+                                 "redial(s)", self.stream_id, attempt + 1)
+                    self.state = CONNECTED
+                return
+            except ConnectionUnavailableError:
+                attempt += 1
+                self._on_failure()

@@ -9,8 +9,10 @@ import time
 from typing import Any, Dict, List, Optional
 
 from ..core import event as ev
+from ..exceptions import ConnectionUnavailableError
 from .broker import InMemoryBroker
 from .mappers import SINK_MAPPERS, SinkMapper
+from .resilience import SINK_POLICIES, BackoffPolicy, SinkConnection
 
 log = logging.getLogger("siddhi_tpu")
 
@@ -39,7 +41,12 @@ class Sink:
 
 class InMemorySink(Sink):
     def publish(self, payload):
-        InMemoryBroker.publish(self.options.get("topic"), payload)
+        try:
+            InMemoryBroker.publish(self.options.get("topic"), payload)
+        except Exception as exc:  # noqa: BLE001 — typed transport failure
+            raise ConnectionUnavailableError(
+                f"inMemory broker delivery on topic "
+                f"{self.options.get('topic')!r} failed: {exc!r}") from exc
 
 
 class LogSink(Sink):
@@ -47,7 +54,11 @@ class LogSink(Sink):
 
     def publish(self, payload):
         prefix = self.options.get("prefix", "")
-        log.info("%s%s", prefix + (" : " if prefix else ""), payload)
+        try:
+            log.info("%s%s", prefix + (" : " if prefix else ""), payload)
+        except Exception as exc:  # noqa: BLE001 — typed transport failure
+            raise ConnectionUnavailableError(
+                f"log sink emit failed: {exc!r}") from exc
 
 
 def _stable_hash(v) -> int:
@@ -128,7 +139,17 @@ class SinkRuntime:
     `@sink(..., @distribution(strategy='roundRobin'|'partitioned',
     partitionKey='attr', @destination(topic='t1'), @destination(topic='t2')))`
     publishes across destinations (reference: DistributedTransport + its
-    RoundRobin/Partitioned strategies)."""
+    RoundRobin/Partitioned strategies).
+
+    `@sink(on.error='log'|'retry'|'wait'|'stream'|'store')` selects the
+    failure policy (reference: Sink.OnErrorAction + the error store).
+    Every transport is wrapped in a `SinkConnection` state machine
+    (io/resilience.py) — retry/wait mechanics and the circuit breaker
+    live there; 'stream' routes failed events into the `!stream` fault
+    stream, 'store' hands them to `runtime.error_store`.  Tunables ride
+    the annotation: retry.initial.ms / retry.multiplier / retry.max.ms /
+    retry.jitter / retry.seed / buffer.size / breaker.failures /
+    wait.timeout.ms / probe.interval.ms."""
 
     def __init__(self, stream_id: str, ann, app):
         self.stream_id = stream_id
@@ -141,6 +162,16 @@ class SinkRuntime:
                 f"unknown sink type {stype!r}; registered: "
                 f"{sorted(SINK_TYPES)}")
         self.options = ann.named_elements()
+        self.on_error = str(self.options.get("on.error", "log")).lower()
+        if self.on_error not in SINK_POLICIES:
+            raise ValueError(
+                f"@sink on {stream_id!r}: unknown on.error="
+                f"{self.on_error!r}; one of {SINK_POLICIES}")
+        self.failed_total = 0
+        if self.on_error == "stream":
+            # the fault stream must exist before traffic flows, exactly
+            # as @OnError(action='STREAM') would have defined it
+            app._ensure_fault_stream(stream_id)
         map_ann = dist_ann = None
         for sub in ann.annotations:
             n = sub.name.lower()
@@ -184,14 +215,31 @@ class SinkRuntime:
                 "sink", str(stype))
             s.init(self.options)
             self.sinks.append(s)
+        self.connections: List[SinkConnection] = [
+            self._wrap(s) for s in self.sinks]
+
+    def _wrap(self, s: Sink) -> SinkConnection:
+        opts = self.options
+        import random
+        seed = opts.get("retry.seed")
+        rng = random.Random(int(seed)) if seed is not None else None
+        probe = opts.get("probe.interval.ms")
+        return SinkConnection(
+            s, stream_id=self.stream_id, policy=self.on_error,
+            backoff=BackoffPolicy.from_options(opts, rng=rng),
+            buffer_size=int(opts.get("buffer.size", 1024)),
+            breaker_failures=int(opts.get("breaker.failures", 5)),
+            wait_timeout_s=float(opts.get("wait.timeout.ms", 30000)) / 1e3,
+            probe_interval_s=float(probe) / 1e3 if probe is not None
+            else None)
 
     def start(self) -> None:
-        for s in self.sinks:
-            s.connect()
+        for c in self.connections:
+            c.connect()
 
     def stop(self) -> None:
-        for s in self.sinks:
-            s.disconnect()
+        for c in self.connections:
+            c.close()
 
     # StreamCallback entry
     def __call__(self, events: List[ev.Event]) -> None:
@@ -213,17 +261,72 @@ class SinkRuntime:
 
     def _flush(self, events: List[ev.Event]) -> None:
         payloads = self.mapper.map(events)
-        if self.strategy is None or len(self.sinks) == 1:
-            for p in payloads:
-                self.sinks[0].publish(p)
-            return
-        if len(payloads) == len(events):
-            pairs = zip(events, payloads)
+        if self.strategy is None or len(self.connections) == 1:
+            pairs = zip(events, payloads) \
+                if len(payloads) == len(events) \
+                else ((None, p) for p in payloads)
+            pairs = [(e, p, self.connections[0]) for e, p in pairs]
         else:
-            # a custom mapper emitted N payloads per event: every payload
-            # still publishes; event-based strategies (partitioned) get
-            # event=None and must reject it rather than drop data
-            pairs = ((None, p) for p in payloads)
-        for e, p in pairs:
-            self.sinks[self.strategy.destination(e, p)
-                       % len(self.sinks)].publish(p)
+            if len(payloads) == len(events):
+                raw = zip(events, payloads)
+            else:
+                # a custom mapper emitted N payloads per event: every
+                # payload still publishes; event-based strategies
+                # (partitioned) get event=None and must reject it rather
+                # than drop data
+                raw = ((None, p) for p in payloads)
+            pairs = [(e, p, self.connections[
+                self.strategy.destination(e, p) % len(self.connections)])
+                for e, p in raw]
+        # per-payload isolation: one failing payload must never silently
+        # drop the remainder of the batch (the pre-resilience _flush
+        # raised out of the loop and lost every later payload)
+        failed = []
+        first_app_exc = None
+        for e, p, conn in pairs:
+            try:
+                conn.publish(p)
+            except ConnectionUnavailableError as exc:
+                failed.append((e, exc, conn))
+            except Exception as exc:  # noqa: BLE001 — app-level bug
+                log.error("sink for %r: publish raised a non-transport "
+                          "error (payload isolated, batch continues): %r",
+                          self.stream_id, exc)
+                first_app_exc = first_app_exc or exc
+        if failed:
+            self._handle_failed(failed)
+        if first_app_exc is not None:
+            # surfaced AFTER the whole batch published, so the junction's
+            # fault routing sees it without costing the other payloads
+            raise first_app_exc
+
+    def _handle_failed(self, failed) -> None:
+        """Route events whose transport publish terminally failed, per
+        on.error: 'stream' -> `!stream` fault path, 'store' -> error
+        store, else log-and-count.  ('retry' buffers inside the
+        connection and only lands here on breaker shed/buffer overflow
+        of the direct path; 'wait' lands here after its deadline.)"""
+        self.failed_total += len(failed)
+        evs = [e for e, _, _ in failed if e is not None]
+        exc = failed[0][1]
+        if self.on_error == "stream":
+            fault_id = "!" + self.stream_id
+            junction = self.app.junctions.get(fault_id)
+            if junction is not None and evs:
+                fault_events = []
+                for e, x, _ in failed:
+                    if e is not None:
+                        fault_events.append(
+                            ev.Event(e.timestamp, list(e.data) + [repr(x)]))
+                self.app._route(fault_id, fault_events)
+                return
+        elif self.on_error == "store":
+            store = getattr(self.app, "error_store", None)
+            if store is not None and evs:
+                store.store(self.stream_id, evs, exc, origin="sink")
+                return
+        for _, _, conn in failed:
+            conn.dropped_total += 1
+        log.error("sink for %r dropped %d event(s) after transport "
+                  "failure (on.error=%r): %r", self.stream_id,
+                  len(failed), self.on_error, exc)

@@ -5,11 +5,11 @@ connectWithRetry :155-169, BackoffRetryCounter, InMemorySource.java:63).
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, Callable, Dict, Optional
 
 from . import broker as _broker
 from .mappers import SOURCE_MAPPERS, SourceMapper
+from .resilience import BackoffPolicy
 
 
 class Source:
@@ -65,10 +65,15 @@ def register_source_type(name: str, cls: type) -> None:
 
 class SourceRuntime:
     """Wires one @source annotation: transport -> mapper -> stream junction.
-    Connection failures retry with exponential backoff on a daemon thread
-    (reference: Source.connectWithRetry + BackoffRetryCounter)."""
-
-    RETRY_SEQUENCE = (0.1, 0.25, 0.5, 1.0, 2.0, 5.0)
+    Connection failures retry with exponential backoff + jitter on a
+    daemon thread (reference: Source.connectWithRetry +
+    BackoffRetryCounter; policy shared with sinks via
+    io/resilience.BackoffPolicy).  While disconnected the transport's
+    pause() hook is held down so a half-dead source doesn't spin
+    delivering into a stream it can no longer feed coherently; resume()
+    fires after the reconnect.  Tunables ride the annotation:
+    retry.initial.ms / retry.multiplier / retry.max.ms / retry.jitter /
+    retry.attempts."""
 
     def __init__(self, stream_id: str, ann, app):
         self.stream_id = stream_id
@@ -76,6 +81,7 @@ class SourceRuntime:
         self.paused = False
         self._pause_cv = threading.Condition()
         self._connected = False
+        self._retry_stop = threading.Event()
         self._retry_thread: Optional[threading.Thread] = None
 
         stype = ann.element("type") or ann.element(None)
@@ -96,6 +102,8 @@ class SourceRuntime:
             raise ValueError(f"unknown source map type {mtype!r}")
         schema = app.schemas[stream_id]
         self.mapper: SourceMapper = SOURCE_MAPPERS[mtype](schema, map_ann)
+        self.backoff = BackoffPolicy.from_options(self.options)
+        self.retry_attempts = int(self.options.get("retry.attempts", 6))
         self.source: Source = SOURCE_TYPES[stype]()
         self.source.config_reader = app.config_manager.generate_config_reader(
             "source", str(stype))
@@ -103,6 +111,7 @@ class SourceRuntime:
 
     # -- lifecycle -------------------------------------------------------------
     def start(self) -> None:
+        self._retry_stop.clear()
         try:
             self.source.connect()
             self._connected = True
@@ -113,19 +122,39 @@ class SourceRuntime:
             self._retry_thread.start()
 
     def _connect_with_retry(self) -> None:
-        for delay in self.RETRY_SEQUENCE:
-            time.sleep(delay)
-            try:
-                self.source.connect()
-                self._connected = True
-                return
-            except Exception:  # noqa: BLE001
-                continue
-        import logging
-        logging.getLogger("siddhi_tpu").error(
-            "source for %r failed to connect after retries", self.stream_id)
+        # hold the TRANSPORT's pause hook (not the runtime gate — that
+        # one belongs to persist's quiesce) so a disconnected source
+        # doesn't spin-deliver while its backing system is down
+        try:
+            self.source.pause()
+        except Exception:  # noqa: BLE001 — hook is best-effort
+            pass
+        try:
+            for attempt in range(self.retry_attempts):
+                if self._retry_stop.wait(self.backoff.delay(attempt)):
+                    return
+                try:
+                    self.source.connect()
+                    self._connected = True
+                    return
+                except Exception:  # noqa: BLE001
+                    continue
+            import logging
+            logging.getLogger("siddhi_tpu").error(
+                "source for %r failed to connect after %d retries",
+                self.stream_id, self.retry_attempts)
+        finally:
+            if self._connected:
+                try:
+                    self.source.resume()
+                except Exception:  # noqa: BLE001 — hook is best-effort
+                    pass
 
     def stop(self) -> None:
+        self._retry_stop.set()
+        if self._retry_thread is not None:
+            self._retry_thread.join(timeout=2.0)
+            self._retry_thread = None
         self.source.disconnect()
         self._connected = False
 

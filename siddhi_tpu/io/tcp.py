@@ -33,6 +33,7 @@ import struct
 import threading
 from typing import Any, List, Optional
 
+from ..exceptions import ConnectionUnavailableError
 from .sink import Sink, register_sink_type
 from .source import Source, register_source_type
 
@@ -78,7 +79,14 @@ class TCPSource(Source):
     def connect(self) -> None:
         host = self.options.get("host", "0.0.0.0")
         port = int(self.options.get("port", 0))
-        self._srv = socket.create_server((host, port))
+        try:
+            self._srv = socket.create_server((host, port))
+        except OSError as exc:
+            # typed so SourceRuntime's backoff retry (and tests) can
+            # distinguish "port busy / interface down" from a code bug
+            raise ConnectionUnavailableError(
+                f"tcp source cannot listen on {host}:{port}: "
+                f"{exc!r}") from exc
         self._srv.settimeout(0.2)
         self.port = self._srv.getsockname()[1]   # resolved when port=0
         self._stop = threading.Event()
@@ -171,11 +179,21 @@ class TCPSink(Sink):
     def publish(self, payload: Any) -> None:
         with self._lock:
             try:
-                _send_frame(self._ensure(), payload)
-            except OSError:
-                # drop the broken connection; retry once on a fresh one
+                try:
+                    _send_frame(self._ensure(), payload)
+                except OSError:
+                    # drop the broken connection; retry once on a fresh one
+                    self._drop()
+                    _send_frame(self._ensure(), payload)
+            except OSError as exc:
+                # typed transport failure: SinkConnection's on.error
+                # policy machinery keys on ConnectionUnavailableError
                 self._drop()
-                _send_frame(self._ensure(), payload)
+                raise ConnectionUnavailableError(
+                    f"tcp sink to "
+                    f"{self.options.get('host', '127.0.0.1')}:"
+                    f"{self.options.get('port')} unreachable: "
+                    f"{exc!r}") from exc
 
     def _drop(self) -> None:
         if self._sock is not None:

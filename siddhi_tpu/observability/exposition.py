@@ -108,6 +108,23 @@ def render_prometheus(runtimes: Dict) -> str:
               "pattern slot blocks, selector slabs, tables, fuse "
               "stacks) — computed from cached shape/dtype metadata, "
               "never fetched")
+    s_ret = fam("siddhi_sink_retries_total", "counter",
+                "Reconnect/redial attempts per sink connection "
+                "(io/resilience.py state machine)")
+    s_brk = fam("siddhi_sink_breaker_state", "gauge",
+                "Sink connection state: 0=CONNECTED 1=RETRYING "
+                "2=BROKEN (circuit open, load shed)")
+    s_drp = fam("siddhi_sink_dropped_total", "counter",
+                "Events/payloads dropped at a sink (buffer overflow, "
+                "open breaker, or terminal on.error failure)")
+    s_buf = fam("siddhi_sink_buffered_payloads", "gauge",
+                "Payloads held in a sink's in-flight retry buffer")
+    e_st = fam("siddhi_errorstore_events", "gauge",
+               "Error-store events by state (buffered=waiting for "
+               "replay; stored/dropped/replayed are lifetime totals)")
+    r_fb = fam("siddhi_restore_fallbacks_total", "counter",
+               "Snapshot revisions skipped as corrupt/unreadable "
+               "during restore_last_revision")
 
     for app_name, rt in sorted(runtimes.items()):
         st = rt.stats
@@ -149,5 +166,25 @@ def render_prometheus(runtimes: Dict) -> str:
         for owner, comps in sorted(component_bytes(rt).items()):
             for comp, nb in sorted(comps.items()):
                 mem.sample(nb, app=app_name, query=owner, component=comp)
+        # sink resilience: plain attribute reads off each connection's
+        # state machine — no locks held, no device work
+        from ..io.resilience import state_gauge
+        for sk in getattr(rt, "sinks", ()):
+            for i, conn in enumerate(getattr(sk, "connections", ())):
+                lbl = dict(app=app_name, stream=sk.stream_id, dest=i)
+                s_ret.sample(conn.retries_total, **lbl)
+                s_brk.sample(state_gauge(conn.state), **lbl)
+                s_drp.sample(conn.dropped_total, **lbl)
+                s_buf.sample(conn.buffered(), **lbl)
+        es = getattr(rt, "error_store", None)
+        if es is not None:
+            try:
+                for state, v in sorted(es.stats().items()):
+                    if state in ("buffered", "stored", "dropped",
+                                 "replayed"):
+                        e_st.sample(v, app=app_name, state=state)
+            except Exception:  # noqa: BLE001 — custom SPI must not
+                pass           # break the scrape
+        r_fb.sample(getattr(rt, "restore_fallbacks", 0), app=app_name)
 
     return "\n".join(lines) + ("\n" if lines else "")

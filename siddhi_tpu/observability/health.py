@@ -126,6 +126,24 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         streams[sid] = {"last_event_age_s": age_s, "backlog": depth,
                         "status": status}
 
+    # sink connection states (io/resilience.py): a BROKEN circuit means
+    # events are being shed at the edge — the app still processes, so
+    # `ready` stays true, but the verdict detail flips to degraded and
+    # routing dashboards can alarm on it
+    from ..io.resilience import BROKEN
+    sinks: Dict[str, Dict] = {}
+    degraded = False
+    for sk in getattr(rt, "sinks", ()):
+        for i, conn in enumerate(getattr(sk, "connections", ())):
+            sinks[f"{sk.stream_id}[{i}]"] = {
+                "state": conn.state,
+                "retries": conn.retries_total,
+                "dropped": conn.dropped_total,
+                "buffered": conn.buffered(),
+            }
+            if conn.state == BROKEN:
+                degraded = True
+
     drops, growths = _counter_sums(snap.get("counters", {}))
     recompiles = sum(info["count"]
                      for info in st.recompiles(rt).values())
@@ -145,6 +163,8 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         "ready": started and accepting,
         "threads": threads,
         "streams": streams,
+        "sinks": sinks,
+        "degraded": degraded,
         "buffered_emissions": rt.buffered_emissions(),
         "rates_window_s": _WINDOW_S,
         "dropped_per_s": round(_rate(rt, "dropped", drops), 6),
@@ -165,6 +185,7 @@ def healthz(manager) -> Tuple[int, Dict]:
     apps = {}
     live = True
     ready = True
+    degraded = False
     for name, rt in sorted(getattr(manager, "runtimes", {}).items()):
         try:
             rep = app_health(rt)
@@ -173,10 +194,13 @@ def healthz(manager) -> Tuple[int, Dict]:
         apps[name] = rep
         live = live and bool(rep.get("live"))
         ready = ready and bool(rep.get("ready"))
+        degraded = degraded or bool(rep.get("degraded"))
     payload = {
-        "status": "ok" if live else "unhealthy",
+        "status": "degraded" if live and degraded
+        else ("ok" if live else "unhealthy"),
         "live": live,
         "ready": ready,
+        "degraded": degraded,
         "apps": apps,
     }
     return (200 if live else 503), payload

@@ -43,6 +43,13 @@ Endpoints (JSON in/out):
   POST   /profiler/start  body={"log_dir"?} -> start a guarded jax.profiler
                                                session (409 if running)
   POST   /profiler/stop                     -> stop it (409 if not running)
+  GET    /siddhi-apps/<name>/error-store    -> error-store stats + captured
+                                               entries (?stream=S filters;
+                                               ?limit=N caps entries)
+  POST   /siddhi-apps/<name>/error-store/replay
+                       body={"ids"?, "stream"?} -> re-inject captured
+                                               events through the normal
+                                               InputHandler path
   GET    /health                            -> {"status": "ok"}
 """
 from __future__ import annotations
@@ -140,6 +147,22 @@ class SiddhiRestService:
                             self._json(404, {"error": "no such app"})
                         else:
                             self._json(200, rt.analyze())
+                    elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "error-store":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                        else:
+                            stream = _qparam(query_str, "stream")
+                            limit = _qparam(query_str, "limit")
+                            entries = rt.error_store.entries(stream)
+                            if limit is not None:
+                                entries = entries[-int(limit):]
+                            self._json(200, {
+                                "app": parts[1],
+                                "stats": rt.error_store.stats(),
+                                "entries": [e.to_dict()
+                                            for e in entries]})
                     elif parts == ["metrics"]:
                         # Prometheus scrape endpoint (text format 0.0.4);
                         # never touches the device — see observability/
@@ -198,6 +221,19 @@ class SiddhiRestService:
                                 self._json(404, {"error": "unknown path"})
                         except RuntimeError as exc:
                             self._json(409, {"error": str(exc)})
+                        return
+                    if len(parts) == 4 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "error-store" \
+                            and parts[3] == "replay":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                            return
+                        req = json.loads(self._body() or b"{}")
+                        result = rt.replay_errors(
+                            ids=req.get("ids"),
+                            stream_id=req.get("stream"))
+                        self._json(200, result)
                         return
                     if parts == ["siddhi-apps"]:
                         ql = self._body().decode()

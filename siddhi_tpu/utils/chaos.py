@@ -1,0 +1,190 @@
+"""Chaos-injection transports: deterministic failure schedules for the
+resilience layer.
+
+Reference (what): checkpoint-based engines validate their recovery
+paths with injected faults (Flink's chaos/failure-rate restart tests;
+the reference's own TestFailingInMemorySink/Source pair used across
+OnErrorTestCase).  A robustness claim that was never exercised is a
+wish, not a feature.
+
+TPU design (how): `ChaosSink`/`ChaosSource` are REGISTERED transport
+types (`type='chaos'`), so any SiddhiQL app can script an outage:
+
+    @sink(type='chaos', id='s1', fail.publishes='3-5',
+          on.error='retry', retry.initial.ms='5')
+    define stream Out (k string, v int);
+
+Failure schedules are deterministic — `fail.publishes='3-5'` fails
+exactly publish attempts 3,4,5 (1-based, counted across retries) —
+and the optional `fail.rate` RNG is seeded, so a chaos run replays
+bit-identically in CI.  Instances register under their `id` option in
+`ChaosSink.instances` / `ChaosSource.instances` for test assertions.
+
+`FakeClock` drives the resilience state machine without real sleeps:
+inject it as `SinkConnection._clock`/`_sleep` (tests) so backoff and
+breaker probes advance on a virtual timeline.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..exceptions import ConnectionUnavailableError
+from ..io.broker import InMemoryBroker
+from ..io.sink import Sink, register_sink_type
+from ..io.source import Source, register_source_type
+
+
+class FakeClock:
+    """Virtual monotonic clock: `sleep` advances time instead of
+    waiting.  Wire into a SinkConnection as `conn._clock = clock;
+    conn._sleep = clock.sleep` to make backoff/breaker tests instant
+    and deterministic."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+        self.sleeps: List[float] = []
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+    def sleep(self, s: float) -> bool:
+        """SinkConnection._sleep signature: returns False (not
+        shutting down) after advancing the virtual clock."""
+        self.sleeps.append(s)
+        self.t += s
+        return False
+
+
+def parse_schedule(spec: Optional[str]) -> Tuple[Set[int], Optional[int]]:
+    """'3-5,9' -> ({3,4,5,9}, None); '4-' -> ({}, 4) meaning "from the
+    4th on".  1-based attempt indexes."""
+    fixed: Set[int] = set()
+    from_n: Optional[int] = None
+    if not spec:
+        return fixed, from_n
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if part.endswith("-"):
+            n = int(part[:-1])
+            from_n = n if from_n is None else min(from_n, n)
+        elif "-" in part:
+            a, b = part.split("-", 1)
+            fixed.update(range(int(a), int(b) + 1))
+        else:
+            fixed.add(int(part))
+    return fixed, from_n
+
+
+class _Schedule:
+    def __init__(self, spec: Optional[str], rate: float = 0.0,
+                 seed: int = 0):
+        self.fixed, self.from_n = parse_schedule(spec)
+        self.rate = float(rate)
+        self.rng = random.Random(seed)
+        self.n = 0
+
+    def fails_next(self) -> bool:
+        self.n += 1
+        if self.n in self.fixed:
+            return True
+        if self.from_n is not None and self.n >= self.from_n:
+            return True
+        return self.rate > 0 and self.rng.random() < self.rate
+
+
+class ChaosSink(Sink):
+    """Delivers to `ChaosSink.instances[id].delivered` (and optionally
+    an inMemory broker `topic`) unless the schedule says this publish
+    fails.  Options: id, fail.publishes, fail.connects, fail.rate,
+    seed, topic."""
+
+    instances: Dict[str, "ChaosSink"] = {}
+    _lock = threading.Lock()
+
+    def init(self, options):
+        super().init(options)
+        self.delivered: List[Any] = []
+        self.connects = 0
+        self.publish_attempts = 0
+        self.failures = 0
+        self._pub_sched = _Schedule(options.get("fail.publishes"),
+                                    float(options.get("fail.rate", 0.0)),
+                                    int(options.get("seed", 0)))
+        self._conn_sched = _Schedule(options.get("fail.connects"))
+        cid = options.get("id")
+        if cid is not None:
+            with self._lock:
+                ChaosSink.instances[str(cid)] = self
+
+    def connect(self):
+        self.connects += 1
+        if self._conn_sched.fails_next():
+            raise ConnectionUnavailableError(
+                f"chaos sink: connect #{self.connects} scheduled to fail")
+
+    def publish(self, payload):
+        self.publish_attempts += 1
+        if self._pub_sched.fails_next():
+            self.failures += 1
+            raise ConnectionUnavailableError(
+                f"chaos sink: publish #{self.publish_attempts} "
+                "scheduled to fail")
+        self.delivered.append(payload)
+        topic = self.options.get("topic")
+        if topic is not None:
+            InMemoryBroker.publish(topic, payload)
+
+
+class ChaosSource(Source):
+    """Fails its first `fail.connects` schedule entries, then connects;
+    payloads are pushed from tests via `instances[id].emit(payload)`.
+    pause()/resume() calls are recorded so tests can assert the
+    reconnect loop held the transport down."""
+
+    instances: Dict[str, "ChaosSource"] = {}
+    _lock = threading.Lock()
+
+    def init(self, options, deliver):
+        super().init(options, deliver)
+        self.connects = 0
+        self.connected = False
+        self.paused = 0
+        self.resumed = 0
+        self._conn_sched = _Schedule(options.get("fail.connects"))
+        cid = options.get("id")
+        if cid is not None:
+            with self._lock:
+                ChaosSource.instances[str(cid)] = self
+
+    def connect(self):
+        self.connects += 1
+        if self._conn_sched.fails_next():
+            raise ConnectionUnavailableError(
+                f"chaos source: connect #{self.connects} scheduled to "
+                "fail")
+        self.connected = True
+
+    def disconnect(self):
+        self.connected = False
+
+    def pause(self):
+        self.paused += 1
+
+    def resume(self):
+        self.resumed += 1
+
+    def emit(self, payload):
+        if not self.connected:
+            raise ConnectionUnavailableError("chaos source not connected")
+        self.deliver(payload)
+
+
+register_sink_type("chaos", ChaosSink)
+register_source_type("chaos", ChaosSource)

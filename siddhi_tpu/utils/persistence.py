@@ -4,13 +4,65 @@ FileSystemPersistenceStore}.java — FileSystemPersistenceStore.save :40).
 
 The snapshot payload here is the pickled state pytree produced by
 SiddhiAppRuntime.snapshot() — no stop-the-world object walk, just arrays.
+
+Crash safety: filesystem stores write atomically (temp file + fsync +
+rename — a crash mid-write leaves the previous revision intact, never a
+half-written file under the final name) and seal every blob with a
+CRC32 trailer.  `load` verifies the trailer and raises
+CorruptSnapshotError on a torn/truncated/rotted file;
+SiddhiManager.restore_last_revision catches that and falls back to the
+previous good revision instead of dying on restore.
 """
 from __future__ import annotations
 
 import os
 import threading
 import time
+import zlib
 from typing import Dict, List, Optional, Tuple
+
+from ..exceptions import CorruptSnapshotError
+
+# trailer = 4-byte big-endian CRC32 of the payload + 4-byte magic; the
+# magic distinguishes "sealed blob" from pre-seal legacy files (a pickle
+# stream never ends with these bytes)
+_CRC_MAGIC = b"SC01"
+
+
+def seal(blob: bytes) -> bytes:
+    """Append the CRC32 integrity trailer."""
+    return blob + zlib.crc32(blob).to_bytes(4, "big") + _CRC_MAGIC
+
+
+def unseal(blob: bytes, where: str = "snapshot",
+           strict: bool = True) -> bytes:
+    """Verify and strip the CRC trailer.  Raises CorruptSnapshotError on
+    a checksum mismatch, and — in strict mode (the filesystem stores,
+    which ALWAYS seal on save) — on a missing trailer, which means the
+    file was truncated mid-write.  strict=False passes unsealed blobs
+    through for stores that may hold pre-seal legacy revisions."""
+    if len(blob) >= 8 and blob[-4:] == _CRC_MAGIC:
+        body, crc = blob[:-8], int.from_bytes(blob[-8:-4], "big")
+        if zlib.crc32(body) != crc:
+            raise CorruptSnapshotError(
+                f"{where}: CRC32 mismatch — torn write or corruption")
+        return body
+    if strict:
+        raise CorruptSnapshotError(
+            f"{where}: integrity trailer missing — truncated or "
+            "pre-seal snapshot file")
+    return blob
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """Write-then-rename with fsync so a crash at any instant leaves
+    either the old file or the complete new one — never a torn blob."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 class PersistenceStore:
@@ -24,6 +76,13 @@ class PersistenceStore:
 
     def get_last_revision(self, app_name: str) -> Optional[str]:
         raise NotImplementedError
+
+    def get_revisions(self, app_name: str) -> List[str]:
+        """All revisions, oldest first.  Default covers stores that only
+        track the last one; restore fallback walks this list newest to
+        oldest past corrupt revisions."""
+        last = self.get_last_revision(app_name)
+        return [last] if last is not None else []
 
     def clear_all_revisions(self, app_name: str) -> None:
         raise NotImplementedError
@@ -47,6 +106,9 @@ class InMemoryPersistenceStore(PersistenceStore):
         revs = self._revisions.get(app_name)
         return revs[-1] if revs else None
 
+    def get_revisions(self, app_name):
+        return list(self._revisions.get(app_name, []))
+
     def clear_all_revisions(self, app_name):
         with self._lock:
             for r in self._revisions.pop(app_name, []):
@@ -65,23 +127,26 @@ class FileSystemPersistenceStore(PersistenceStore):
     def save(self, app_name, revision, snapshot):
         d = self._dir(app_name)
         os.makedirs(d, exist_ok=True)
-        with open(os.path.join(d, revision + ".snapshot"), "wb") as f:
-            f.write(snapshot)
+        atomic_write(os.path.join(d, revision + ".snapshot"),
+                     seal(snapshot))
 
     def load(self, app_name, revision):
         path = os.path.join(self._dir(app_name), revision + ".snapshot")
         if not os.path.exists(path):
             return None
         with open(path, "rb") as f:
-            return f.read()
+            return unseal(f.read(), where=path)
 
     def get_last_revision(self, app_name):
+        revs = self.get_revisions(app_name)
+        return revs[-1] if revs else None
+
+    def get_revisions(self, app_name):
         d = self._dir(app_name)
         if not os.path.isdir(d):
-            return None
-        revs = sorted(f[:-len(".snapshot")] for f in os.listdir(d)
+            return []
+        return sorted(f[:-len(".snapshot")] for f in os.listdir(d)
                       if f.endswith(".snapshot"))
-        return revs[-1] if revs else None
 
     def clear_all_revisions(self, app_name):
         d = self._dir(app_name)
@@ -156,26 +221,38 @@ class IncrementalFileSystemPersistenceStore(IncrementalPersistenceStore):
         d = self._dir(app_name)
         for f in os.listdir(d):          # new base invalidates old chain
             os.remove(os.path.join(d, f))
-        with open(os.path.join(d, f"base_{revision}.snapshot"), "wb") as f:
-            f.write(blob)
+        atomic_write(os.path.join(d, f"base_{revision}.snapshot"),
+                     seal(blob))
 
     def save_increment(self, app_name, revision, blob):
-        with open(os.path.join(self._dir(app_name),
-                               f"inc_{revision}.snapshot"), "wb") as f:
-            f.write(blob)
+        atomic_write(os.path.join(self._dir(app_name),
+                                  f"inc_{revision}.snapshot"), seal(blob))
 
     def load_chain(self, app_name):
+        """A corrupt BASE raises (there is nothing older to replay onto);
+        a corrupt INCREMENT truncates the chain there — the intact
+        prefix still restores, losing only the later deltas, which beats
+        losing the whole app state."""
         d = self._dir(app_name)
         bases = sorted(f for f in os.listdir(d) if f.startswith("base_"))
         if not bases:
             return None
-        with open(os.path.join(d, bases[-1]), "rb") as f:
-            base = f.read()
+        base_path = os.path.join(d, bases[-1])
+        with open(base_path, "rb") as f:
+            base = unseal(f.read(), where=base_path)
         incs = []
         for name in sorted(f for f in os.listdir(d)
                            if f.startswith("inc_")):
-            with open(os.path.join(d, name), "rb") as f:
-                incs.append(f.read())
+            path = os.path.join(d, name)
+            with open(path, "rb") as f:
+                try:
+                    incs.append(unseal(f.read(), where=path))
+                except CorruptSnapshotError as exc:
+                    import logging
+                    logging.getLogger("siddhi_tpu").warning(
+                        "increment chain for %s truncated at corrupt "
+                        "%s: %s", app_name, name, exc)
+                    break
         return base, incs
 
     def clear_all_revisions(self, app_name):

@@ -1,0 +1,698 @@
+"""Fault-tolerance layer: sink on.error policies under deterministic
+chaos injection, source connect-retry, error store + replay, crash-safe
+persistence, and the resilience observability surfaces.
+
+Determinism: chaos schedules are exact publish/connect indexes
+(utils/chaos.py), backoff in live tests uses millisecond delays (no
+real sleep > 50 ms), and clock-sensitive machinery (wait deadline,
+breaker probe) runs on a FakeClock."""
+import json
+import urllib.request
+
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.exceptions import (
+    ConnectionUnavailableError,
+    ConnectionUnavailableException,
+    CorruptSnapshotError,
+)
+from siddhi_tpu.io import InMemoryBroker
+from siddhi_tpu.io.errorstore import InMemoryErrorStore
+from siddhi_tpu.io.resilience import (
+    BROKEN,
+    CONNECTED,
+    BackoffPolicy,
+    SinkConnection,
+)
+from siddhi_tpu.utils.chaos import (
+    ChaosSink,
+    ChaosSource,
+    FakeClock,
+    parse_schedule,
+)
+from siddhi_tpu.utils.testing import wait_for_events
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    InMemoryBroker.clear()
+    ChaosSink.instances.clear()
+    ChaosSource.instances.clear()
+
+
+@pytest.fixture()
+def manager():
+    m = SiddhiManager()
+    yield m
+    m.shutdown()
+
+
+# fast, CI-safe backoff: every live-sleep test runs millisecond delays
+FAST = ("retry.initial.ms='2', retry.max.ms='10', retry.jitter='0', "
+        "retry.seed='7'")
+
+
+# ---------------------------------------------------------------------------
+# building blocks
+# ---------------------------------------------------------------------------
+
+def test_exception_alias():
+    # satellite: typed transport error, old Java-style spelling kept
+    assert ConnectionUnavailableException is ConnectionUnavailableError
+    from siddhi_tpu.exceptions import SiddhiError
+    assert issubclass(ConnectionUnavailableError, SiddhiError)
+
+
+def test_backoff_policy_sequence_and_cap():
+    b = BackoffPolicy(initial_s=0.1, multiplier=2.0, max_s=0.5, jitter=0.0)
+    assert [b.delay(i) for i in range(5)] == [0.1, 0.2, 0.4, 0.5, 0.5]
+
+
+def test_backoff_jitter_is_bounded_and_seeded():
+    import random
+    mk = lambda: BackoffPolicy(initial_s=1.0, multiplier=1.0, max_s=1.0,
+                               jitter=0.5, rng=random.Random(42))
+    a, b = mk(), mk()
+    da = [a.delay(0) for _ in range(20)]
+    assert da == [b.delay(0) for _ in range(20)]     # seeded => replayable
+    assert all(0.5 <= d <= 1.0 for d in da)           # jitter shrinks only
+
+
+def test_backoff_from_options_ms_keys():
+    b = BackoffPolicy.from_options({
+        "retry.initial.ms": "50", "retry.multiplier": "3",
+        "retry.max.ms": "2000", "retry.jitter": "0"})
+    assert b.delay(0) == pytest.approx(0.05)
+    assert b.delay(1) == pytest.approx(0.15)
+    assert b.delay(10) == pytest.approx(2.0)
+
+
+def test_parse_schedule_forms():
+    assert parse_schedule("3-5,9") == ({3, 4, 5, 9}, None)
+    assert parse_schedule("4-") == (set(), 4)
+    assert parse_schedule(None) == (set(), None)
+    assert parse_schedule("2") == ({2}, None)
+
+
+# ---------------------------------------------------------------------------
+# SinkConnection state machine (unit level, fake clock)
+# ---------------------------------------------------------------------------
+
+class _FlakySink:
+    """Raises for scheduled publish attempts; counts everything."""
+
+    def __init__(self, fail_attempts=(), fail_connects=0):
+        self.fail_attempts = set(fail_attempts)
+        self.fail_connects = fail_connects
+        self.connects = 0
+        self.attempts = 0
+        self.out = []
+
+    def connect(self):
+        self.connects += 1
+        if self.connects <= self.fail_connects:
+            raise ConnectionUnavailableError("connect scheduled to fail")
+
+    def disconnect(self):
+        pass
+
+    def publish(self, payload):
+        self.attempts += 1
+        if self.attempts in self.fail_attempts:
+            raise ConnectionUnavailableError("publish scheduled to fail")
+        self.out.append(payload)
+
+
+def _fake(conn: SinkConnection) -> FakeClock:
+    clock = FakeClock()
+    conn._clock = clock
+    conn._sleep = clock.sleep
+    return clock
+
+
+def test_retry_policy_zero_loss_in_order():
+    """The acceptance scenario: 3 consecutive publish failures recover
+    via backoff with zero event loss under on.error='retry'."""
+    sink = _FlakySink(fail_attempts={3, 4, 5})
+    conn = SinkConnection(
+        sink, stream_id="S", policy="retry",
+        backoff=BackoffPolicy(0.002, 2.0, 0.01, jitter=0.0),
+        breaker_failures=10)
+    conn.connect()
+    for i in range(6):
+        conn.publish(i)
+    assert wait_for_events(lambda: len(sink.out), 6, timeout_s=5.0)
+    assert sink.out == [0, 1, 2, 3, 4, 5]            # order preserved
+    assert conn.state == CONNECTED
+    assert conn.dropped_total == 0
+    assert conn.retries_total >= 2
+    conn.close()
+
+
+def test_retry_policy_bounded_buffer_drops_and_counts():
+    sink = _FlakySink(fail_attempts=set(range(1, 1000)))
+    conn = SinkConnection(
+        sink, stream_id="S", policy="retry",
+        backoff=BackoffPolicy(0.002, 2.0, 0.005, jitter=0.0),
+        buffer_size=4, breaker_failures=10_000)
+    conn.connect()
+    for i in range(10):
+        conn.publish(i)
+    assert conn.buffered() <= 4
+    assert conn.dropped_total >= 6                    # overflow counted
+    conn.close()
+
+
+def test_breaker_trips_to_broken_and_half_open_probe_recovers():
+    clock = FakeClock()
+    sink = _FlakySink(fail_attempts={1, 2, 3})
+    conn = SinkConnection(
+        sink, stream_id="S", policy="log",
+        backoff=BackoffPolicy(0.001, 2.0, 0.002, jitter=0.0),
+        breaker_failures=3, probe_interval_s=5.0, clock=clock)
+    conn.connect()
+    for i in range(3):
+        with pytest.raises(ConnectionUnavailableError):
+            conn.publish(i)
+    assert conn.state == BROKEN
+    # circuit open: publishes shed WITHOUT touching the transport
+    before = sink.attempts
+    with pytest.raises(ConnectionUnavailableError):
+        conn.publish("shed")
+    assert sink.attempts == before
+    # half-open probe due: next publish goes through and closes it
+    clock.advance(5.1)
+    conn.publish("probe")
+    assert conn.state == CONNECTED
+    assert sink.out == ["probe"]
+
+
+def test_wait_policy_blocks_then_delivers():
+    sink = _FlakySink(fail_attempts={1})
+    conn = SinkConnection(
+        sink, stream_id="S", policy="wait",
+        backoff=BackoffPolicy(0.001, 2.0, 0.002, jitter=0.0),
+        wait_timeout_s=5.0)
+    conn.connect()
+    _fake(conn)
+    conn.publish("x")                 # first attempt fails, retry lands
+    assert sink.out == ["x"]
+    assert conn.retries_total >= 1
+
+
+def test_wait_policy_deadline_raises_fake_clock():
+    sink = _FlakySink(fail_attempts=set(range(1, 10_000)))
+    conn = SinkConnection(
+        sink, stream_id="S", policy="wait",
+        backoff=BackoffPolicy(0.5, 2.0, 2.0, jitter=0.0),
+        wait_timeout_s=30.0)
+    conn.connect()
+    clock = _fake(conn)
+    with pytest.raises(ConnectionUnavailableError):
+        conn.publish("x")
+    # the deadline came from the VIRTUAL clock, not real sleeping
+    assert clock.t >= 30.0
+    assert sum(clock.sleeps) >= 30.0
+
+
+def test_non_transport_errors_do_not_trip_the_machine():
+    class Buggy:
+        def connect(self):
+            pass
+
+        def disconnect(self):
+            pass
+
+        def publish(self, payload):
+            raise TypeError("app bug")
+
+    conn = SinkConnection(Buggy(), stream_id="S", policy="retry",
+                          breaker_failures=1)
+    conn.connect()
+    with pytest.raises(TypeError):
+        conn.publish("x")
+    assert conn.state == CONNECTED     # only CUE drives the machine
+    conn.close()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: @sink(on.error=...) through SiddhiQL apps
+# ---------------------------------------------------------------------------
+
+def _app(manager, ql, cb_query=None):
+    rt = manager.create_siddhi_app_runtime(ql)
+    got = []
+    if cb_query:
+        rt.add_callback(cb_query, lambda ts, ins, outs: got.extend(
+            ins or []))
+    rt.start()
+    return rt, got
+
+
+def test_e2e_retry_recovers_with_zero_event_loss(manager):
+    import siddhi_tpu.utils.chaos  # noqa: F401 — registers type='chaos'
+    rt, _ = _app(manager, f"""
+    define stream In (k string, v int);
+    @sink(type='chaos', id='rz', fail.publishes='3-5',
+          on.error='retry', {FAST}, breaker.failures='10')
+    define stream Out (k string, v int);
+    from In select k, v insert into Out;
+    """)
+    h = rt.get_input_handler("In")
+    for i in range(6):
+        h.send(["k", i])
+    rt.flush()
+    snk = ChaosSink.instances["rz"]
+    assert wait_for_events(lambda: len(snk.delivered), 6, timeout_s=5.0), \
+        snk.delivered
+    assert [p.data[1] for p in snk.delivered] == [0, 1, 2, 3, 4, 5]
+    conn = rt.sinks[0].connections[0]
+    assert conn.state == CONNECTED and conn.dropped_total == 0
+
+
+def test_e2e_log_policy_batch_loss_fixed(manager):
+    """Satellite: one failing payload no longer drops the remaining
+    payloads of the batch (pre-fix _flush raised out of the loop)."""
+    import siddhi_tpu.utils.chaos  # noqa: F401
+    rt, _ = _app(manager, """
+    define stream In (k string, v int);
+    @sink(type='chaos', id='bl', fail.publishes='2')
+    define stream Out (k string, v int);
+    from In select k, v insert into Out;
+    """)
+    h = rt.get_input_handler("In")
+    h.send([["a", 1], ["b", 2], ["c", 3], ["d", 4]])   # ONE batch
+    rt.flush()
+    snk = ChaosSink.instances["bl"]
+    assert [p.data[1] for p in snk.delivered] == [1, 3, 4]
+    assert rt.sinks[0].failed_total == 1
+    assert rt.sinks[0].connections[0].dropped_total == 1
+
+
+def test_e2e_store_policy_captures_and_replays_exactly_once(manager):
+    import siddhi_tpu.utils.chaos  # noqa: F401
+    rt, _ = _app(manager, """
+    define stream In (k string, v int);
+    @sink(type='chaos', id='st', fail.publishes='2-3',
+          on.error='store')
+    define stream Out (k string, v int);
+    from In select k, v insert into Out;
+    """)
+    h = rt.get_input_handler("In")
+    for i in range(1, 5):
+        h.send(["k", i])
+    rt.flush()
+    snk = ChaosSink.instances["st"]
+    assert [p.data[1] for p in snk.delivered] == [1, 4]
+    st = rt.error_store.stats()
+    assert st["buffered"] == 2 and st["entries"] == 2
+    # replay re-injects through the normal InputHandler path
+    result = rt.replay_errors()
+    rt.flush()
+    assert result["entries"] == 2 and result["events"] == 2
+    assert sorted(p.data[1] for p in snk.delivered) == [1, 2, 3, 4]
+    assert rt.error_store.stats()["buffered"] == 0    # exactly once
+    assert rt.error_store.stats()["replayed"] == 2
+
+
+def test_e2e_stream_policy_routes_fault_stream(manager):
+    import siddhi_tpu.utils.chaos  # noqa: F401
+    rt, _ = _app(manager, """
+    define stream In (k string, v int);
+    @sink(type='chaos', id='fs', fail.publishes='2',
+          on.error='stream')
+    define stream Out (k string, v int);
+    from In select k, v insert into Out;
+    """)
+    faults = []
+    rt.add_callback("!Out", lambda evs: faults.extend(evs))
+    h = rt.get_input_handler("In")
+    h.send(["a", 1])
+    h.send(["b", 2])
+    rt.flush()
+    snk = ChaosSink.instances["fs"]
+    assert [p.data[1] for p in snk.delivered] == [1]
+    assert len(faults) == 1
+    assert faults[0].data[0] == "b" and faults[0].data[1] == 2
+    assert "scheduled to fail" in faults[0].data[2]   # _error column
+
+
+def test_e2e_junction_onerror_store(manager):
+    """@OnError(action='STORE') captures processing failures in the
+    error store (junction origin)."""
+    rt, _ = _app(manager, """
+    @OnError(action='STORE')
+    define stream In (k string, v int);
+    @info(name='q') from In select k, v insert into Out;
+    """)
+    boom = RuntimeError("downstream exploded")
+
+    def bad_cb(evs):
+        raise boom
+
+    rt.add_callback("Out", bad_cb)
+    rt.get_input_handler("In").send(["a", 1])
+    rt.flush()
+    entries = rt.error_store.entries()
+    assert len(entries) == 1
+    assert entries[0].origin == "junction"
+    assert entries[0].stream_id == "In"
+    assert entries[0].events[0].data[:2] == ["a", 1]
+
+
+def test_error_store_bounded_with_drop_counter():
+    es = InMemoryErrorStore(capacity=2)
+    from siddhi_tpu.core.event import Event
+    for i in range(5):
+        es.store("S", [Event(0, [i])], RuntimeError("x"))
+    st = es.stats()
+    assert st["entries"] == 2 and st["dropped"] == 3
+    assert [e.events[0].data[0] for e in es.entries()] == [3, 4]
+
+
+def test_unknown_on_error_policy_rejected(manager):
+    with pytest.raises(ValueError, match="on.error"):
+        manager.create_siddhi_app_runtime("""
+        define stream In (k string);
+        @sink(type='inMemory', topic='t', on.error='explode')
+        define stream Out (k string);
+        from In select k insert into Out;
+        """)
+
+
+# ---------------------------------------------------------------------------
+# source resilience
+# ---------------------------------------------------------------------------
+
+def test_source_connect_retry_with_pause_hold(manager):
+    import siddhi_tpu.utils.chaos  # noqa: F401
+    rt, got = _app(manager, """
+    @source(type='chaos', id='src', fail.connects='1-2',
+            retry.initial.ms='2', retry.max.ms='10', retry.jitter='0')
+    define stream Rx (k string);
+    @info(name='q') from Rx select k insert into Out;
+    """, cb_query="q")
+    src = ChaosSource.instances["src"]
+    assert wait_for_events(lambda: int(src.connected), 1, timeout_s=5.0)
+    assert src.connects == 3                  # 2 scheduled failures + 1
+    # the reconnect loop held the transport's pause hook down, then
+    # released it exactly once on success
+    assert src.paused >= 1 and src.resumed >= 1
+    src.emit(["hello"])
+    rt.flush()
+    assert [e.data for e in got] == [["hello"]]
+
+
+# ---------------------------------------------------------------------------
+# fault stream under @fuse (satellite: fused-path coverage)
+# ---------------------------------------------------------------------------
+
+def test_fault_stream_routing_under_fused_stepping(manager):
+    """core/fusion._deliver_fused defers per-batch delivery errors and
+    re-raises into the junction's fault routing — previously only the
+    un-fused path had coverage."""
+    rt, _ = _app(manager, """
+    @OnError(action='STREAM')
+    define stream In (k string, v int);
+    @info(name='q') @fuse(batches='2')
+    from In select k, v insert into Out;
+    """)
+    faults = []
+    rt.add_callback("!In", lambda evs: faults.extend(evs))
+
+    def bad_cb(evs):
+        raise RuntimeError("fused downstream exploded")
+
+    rt.add_callback("Out", bad_cb)
+    h = rt.get_input_handler("In")
+    h.send(["a", 1])          # stacks (K=2): no dispatch yet
+    assert faults == []
+    h.send(["b", 2])          # fills the stack -> ONE fused dispatch
+    assert faults, "fused dispatch error never reached the fault stream"
+    assert faults[0].data[0] == "b"
+    assert "fused downstream exploded" in faults[0].data[-1]
+    # the fused query really engaged (not silently excluded)
+    assert rt.query_runtimes["q"]._fuse is not None
+
+
+# ---------------------------------------------------------------------------
+# crash-safe persistence
+# ---------------------------------------------------------------------------
+
+PERSIST_QL = """
+@app:name('P')
+define stream In (k string, v int);
+@info(name='q') from In#window.length(8)
+select k, sum(v) as total group by k insert into Out;
+"""
+
+
+def _persist_app(store):
+    m = SiddhiManager()
+    m.set_persistence_store(store)
+    rt = m.create_siddhi_app_runtime(PERSIST_QL)
+    got = []
+    rt.add_callback("q", lambda ts, ins, outs: got.extend(ins or []))
+    rt.start()
+    return m, rt, got
+
+
+def test_snapshot_files_are_sealed_and_atomic(tmp_path):
+    from siddhi_tpu.utils.persistence import (
+        FileSystemPersistenceStore, seal, unseal)
+    assert unseal(seal(b"payload")) == b"payload"
+    with pytest.raises(CorruptSnapshotError):
+        unseal(seal(b"payload")[:-3] + b"xyz")
+    store = FileSystemPersistenceStore(str(tmp_path))
+    m, rt, _ = _persist_app(store)
+    m.persist()
+    m.wait_for_persistence()
+    files = list((tmp_path / "P").iterdir())
+    assert len(files) == 1
+    assert not [f for f in files if f.name.endswith(".tmp")]
+    # on-disk blob carries the integrity trailer
+    assert files[0].read_bytes()[-4:] == b"SC01"
+    m.shutdown()
+
+
+def test_truncated_snapshot_falls_back_to_previous_revision(tmp_path):
+    """Acceptance scenario: a snapshot truncated mid-file restores from
+    the previous revision — no exception, fallback counter bumped."""
+    from siddhi_tpu.utils.persistence import FileSystemPersistenceStore
+    store = FileSystemPersistenceStore(str(tmp_path))
+    m, rt, _ = _persist_app(store)
+    h = rt.get_input_handler("In")
+    h.send(["a", 10])
+    rt.flush()
+    m.persist()                      # revision 1: a=10
+    m.wait_for_persistence()
+    import time as _t
+    _t.sleep(0.002)                  # distinct revision timestamp
+    h.send(["a", 5])
+    rt.flush()
+    m.persist()                      # revision 2: a=15
+    m.wait_for_persistence()
+    revs = store.get_revisions("P")
+    assert len(revs) == 2
+    # tear the NEWEST revision mid-file
+    path = tmp_path / "P" / (revs[-1] + ".snapshot")
+    blob = path.read_bytes()
+    path.write_bytes(blob[:len(blob) // 2])
+    m.shutdown()
+
+    m2, rt2, got2 = _persist_app(FileSystemPersistenceStore(str(tmp_path)))
+    m2.restore_last_revision()       # must NOT raise
+    assert rt2.restore_fallbacks == 1
+    rt2.get_input_handler("In").send(["a", 1])
+    rt2.flush()
+    # window state restored from revision 1 (a=10), not revision 2
+    assert got2[-1].data[1] == 11
+    m2.shutdown()
+
+
+def test_all_revisions_corrupt_raises(tmp_path):
+    from siddhi_tpu.exceptions import CannotRestoreStateError
+    from siddhi_tpu.utils.persistence import FileSystemPersistenceStore
+    store = FileSystemPersistenceStore(str(tmp_path))
+    m, rt, _ = _persist_app(store)
+    m.persist()
+    m.wait_for_persistence()
+    for f in (tmp_path / "P").iterdir():
+        f.write_bytes(b"garbage")
+    m.shutdown()
+    m2, rt2, _ = _persist_app(FileSystemPersistenceStore(str(tmp_path)))
+    with pytest.raises(CannotRestoreStateError):
+        m2.restore_last_revision()
+    assert rt2.restore_fallbacks == 1
+    m2.shutdown()
+
+
+def test_incremental_chain_truncates_at_corrupt_increment(tmp_path):
+    from siddhi_tpu.utils.persistence import (
+        IncrementalFileSystemPersistenceStore, seal)
+    store = IncrementalFileSystemPersistenceStore(str(tmp_path))
+    store.save_base("A", "r1", b"base")
+    store.save_increment("A", "r2", b"inc1")
+    store.save_increment("A", "r3", b"inc2")
+    # corrupt the middle increment: the chain stops BEFORE it
+    d = tmp_path / "A"
+    p = d / "inc_r2.snapshot"
+    p.write_bytes(seal(b"inc1")[:-2])
+    base, incs = store.load_chain("A")
+    assert base == b"base"
+    assert incs == []
+
+
+# ---------------------------------------------------------------------------
+# observability surfaces
+# ---------------------------------------------------------------------------
+
+def test_metrics_families_for_resilience(manager):
+    import siddhi_tpu.utils.chaos  # noqa: F401
+    from siddhi_tpu.observability import render_prometheus
+    rt, _ = _app(manager, """
+    @app:name('M')
+    define stream In (k string, v int);
+    @sink(type='chaos', id='mx', fail.publishes='1',
+          on.error='store')
+    define stream Out (k string, v int);
+    from In select k, v insert into Out;
+    """)
+    rt.get_input_handler("In").send(["a", 1])
+    rt.flush()
+    text = render_prometheus(manager.runtimes)
+    for family in ("siddhi_sink_retries_total",
+                   "siddhi_sink_breaker_state",
+                   "siddhi_sink_dropped_total",
+                   "siddhi_errorstore_events",
+                   "siddhi_restore_fallbacks_total"):
+        assert family in text, f"missing {family}\n{text}"
+    assert 'siddhi_errorstore_events{app="M",state="buffered"} 1' in text
+
+
+def test_healthz_degraded_on_broken_sink(manager):
+    import siddhi_tpu.utils.chaos  # noqa: F401
+    from siddhi_tpu.observability.health import app_health, healthz
+    rt, _ = _app(manager, """
+    @app:name('H')
+    define stream In (k string, v int);
+    @sink(type='chaos', id='hz', fail.publishes='1-',
+          breaker.failures='2')
+    define stream Out (k string, v int);
+    from In select k, v insert into Out;
+    """)
+    h = rt.get_input_handler("In")
+    rep = app_health(rt)
+    assert rep["degraded"] is False
+    assert rep["sinks"]["Out[0]"]["state"] == CONNECTED
+    for i in range(3):
+        h.send(["a", i])
+    rt.flush()
+    rep = app_health(rt)
+    assert rep["sinks"]["Out[0]"]["state"] == BROKEN
+    assert rep["degraded"] is True
+    code, payload = healthz(manager)
+    assert code == 200                      # degraded, not dead
+    assert payload["degraded"] is True
+    assert payload["status"] == "degraded"
+
+
+def test_rest_error_store_and_replay():
+    import siddhi_tpu.utils.chaos  # noqa: F401
+    from siddhi_tpu.service import SiddhiRestService
+    svc = SiddhiRestService().start()
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        ql = """
+        @app:name('R')
+        define stream In (k string, v int);
+        @sink(type='chaos', id='rr', fail.publishes='1-2',
+              on.error='store')
+        define stream Out (k string, v int);
+        from In select k, v insert into Out;
+        """
+        req = urllib.request.Request(f"{base}/siddhi-apps",
+                                     data=ql.encode(), method="POST")
+        assert urllib.request.urlopen(req).status == 201
+        req = urllib.request.Request(
+            f"{base}/siddhi-apps/R/streams/In",
+            data=json.dumps({"events": [["a", 1], ["b", 2]]}).encode(),
+            method="POST")
+        assert urllib.request.urlopen(req).status == 200
+        svc.manager.runtimes["R"].flush()
+
+        rep = json.loads(urllib.request.urlopen(
+            f"{base}/siddhi-apps/R/error-store").read().decode())
+        assert rep["stats"]["buffered"] == 2
+        assert len(rep["entries"]) == 2
+        assert rep["entries"][0]["stream"] == "Out"
+        assert rep["entries"][0]["events"][0]["data"][:2] == ["a", 1]
+
+        req = urllib.request.Request(
+            f"{base}/siddhi-apps/R/error-store/replay", data=b"{}",
+            method="POST")
+        rep = json.loads(urllib.request.urlopen(req).read().decode())
+        assert rep == {"entries": 2, "events": 2, "skipped": 0}
+        svc.manager.runtimes["R"].flush()
+        snk = ChaosSink.instances["rr"]
+        assert sorted(p.data[1] for p in snk.delivered) == [1, 2]
+        rep = json.loads(urllib.request.urlopen(
+            f"{base}/siddhi-apps/R/error-store").read().decode())
+        assert rep["stats"]["buffered"] == 0
+        # 404 contract
+        try:
+            urllib.request.urlopen(f"{base}/siddhi-apps/nope/error-store")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        svc.stop()
+
+
+# ---------------------------------------------------------------------------
+# SINK001 lint rule (satellite)
+# ---------------------------------------------------------------------------
+
+def test_sink001_fires_on_default_log_policy():
+    from siddhi_tpu.analysis import analyze
+    findings = [f for f in analyze("""
+    define stream In (k string);
+    @sink(type='inMemory', topic='t')
+    define stream Out (k string);
+    from In select k insert into Out;
+    """) if f.rule_id == "SINK001"]
+    assert len(findings) == 1
+    assert findings[0].severity == "WARN"
+    assert findings[0].pos is not None        # cites the @sink line:col
+    line, col = findings[0].pos
+    assert line == 3
+
+
+def test_sink001_silent_with_policy_or_fault_stream():
+    from siddhi_tpu.analysis import analyze
+
+    def rules(ql):
+        return {f.rule_id for f in analyze(ql)}
+
+    # non-default policy: handled
+    assert "SINK001" not in rules("""
+    define stream In (k string);
+    @sink(type='inMemory', topic='t', on.error='retry')
+    define stream Out (k string);
+    from In select k insert into Out;
+    """)
+    # fault stream defined: failures observable
+    assert "SINK001" not in rules("""
+    define stream In (k string);
+    @OnError(action='STREAM')
+    @sink(type='inMemory', topic='t')
+    define stream Out (k string);
+    from In select k insert into Out;
+    """)
+    # hand-fed stream (not a query output, no @async): low rate
+    assert "SINK001" not in rules("""
+    @sink(type='inMemory', topic='t')
+    define stream Manual (k string);
+    """)
