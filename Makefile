@@ -3,10 +3,10 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test lint lint-apps lint-smoke dryrun bench metrics-smoke \
-	fuse-smoke explain-smoke chaos-smoke all
+	fuse-smoke explain-smoke chaos-smoke multichip-smoke all
 
 all: lint lint-apps test dryrun metrics-smoke fuse-smoke explain-smoke \
-	lint-smoke chaos-smoke
+	lint-smoke chaos-smoke multichip-smoke
 
 # static gate on our own code: ruff (rule set in pyproject.toml) when
 # available, with compileall kept as the syntax floor for samples and
@@ -37,6 +37,15 @@ dryrun:
 
 bench:
 	$(PY) bench.py
+
+# sharded serving layer: the sharded/router suites (parity shapes,
+# mesh-resize restore, @fuse-over-mesh, shard metrics) + a quick
+# multichip scaling run asserting byte-identical output at 1/2/4/8
+# shards (README "Sharded serving")
+multichip-smoke:
+	$(CPU_ENV) $(PY) -m pytest tests/test_sharded.py \
+		tests/test_shard_router.py -q
+	$(CPU_ENV) $(PY) bench.py --mode multichip --quick
 
 # boots a sample app behind the REST service, scrapes GET /metrics, and
 # asserts the required metric families are present (observability layer)

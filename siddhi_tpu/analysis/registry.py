@@ -53,12 +53,16 @@ class LintConfig:
     state_budget_bytes: MEM001 threshold — estimated per-query device
         state above this fires (default 128 MiB: a few queries of that
         size exhaust a 16 GB HBM chip once batches/emissions join them).
+    mesh_devices: PART002 deploy target — the shard-mesh size the app
+        will serve on (0 = unknown; runtime analysis resolves it from
+        the live runtime's mesh instead).
     """
 
     disabled: Set[str] = dataclasses.field(default_factory=set)
     severity_overrides: Dict[str, str] = \
         dataclasses.field(default_factory=dict)
     state_budget_bytes: int = 128 * 1024 * 1024
+    mesh_devices: int = 0
 
     def severity_of(self, r: Rule) -> str:
         return self.severity_overrides.get(r.id, r.severity)

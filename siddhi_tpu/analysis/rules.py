@@ -288,6 +288,51 @@ def _float_partition_key(ctx: AnalysisContext) -> Iterator[Finding]:
                          node=element)
 
 
+def _mesh_devices(ctx: AnalysisContext) -> int:
+    """Deploy-target mesh size: the live runtime's mesh when analyzing a
+    runtime, else LintConfig.mesh_devices (CLI --mesh-size), else 0 =
+    unknown (PART002 stays silent — mesh size is a deploy property)."""
+    rt = ctx.runtime
+    if rt is not None:
+        from ..sharding import shard_count
+        n = shard_count(rt)
+        if n > 1:
+            return n
+    return int(getattr(ctx.config, "mesh_devices", 0) or 0)
+
+
+@rule("PART002", "WARN",
+      "partition key capacity below the mesh size",
+      "A mesh-sharded partition spreads key slots round-robin over the "
+      "devices (sharding/router.py), so at most key-capacity shards can "
+      "ever hold a key.  A capacity below the mesh size guarantees idle "
+      "shards: their state slabs are allocated, their collectives run, "
+      "and they never process a key — the deployment pays for devices "
+      "that cannot do work.",
+      "raise @capacity(keys='N') to at least the mesh size — ideally a "
+      "large multiple of it so routing balances — or serve the app "
+      "unsharded")
+def _undersized_partition_keys(ctx: AnalysisContext) -> Iterator[Finding]:
+    from .facts import capacity_annotation
+    n = _mesh_devices(ctx)
+    if n < 2:
+        return
+    for f in ctx.queries:
+        if f.partition is None:
+            continue
+        # the CONFIGURED capacity (runtime rounds it up to a mesh
+        # multiple, so the planned value can never show the hazard)
+        keys = capacity_annotation(f.query, f.partition).get("keys")
+        if keys is None:
+            from .facts import _PARTITION_KEYS
+            keys = _PARTITION_KEYS
+        if keys < n:
+            yield _f(
+                f"partition key capacity {keys} is below the {n}-device "
+                f"mesh — at least {n - keys} shard(s) are guaranteed "
+                f"idle", query=f.name, node=f.partition)
+
+
 # ---------------------------------------------------------------------------
 # expressions
 # ---------------------------------------------------------------------------
@@ -467,6 +512,6 @@ def _sink_silent_drop(ctx: AnalysisContext) -> Iterator[Finding]:
 
 ALL_RULE_IDS: List[str] = [
     "STATE001", "STATE002", "MEM001", "FUSE001", "JOIN001",
-    "DEAD001", "DEAD002", "PART001", "TYPE001", "RATE001", "APP001",
-    "SINK001",
+    "DEAD001", "DEAD002", "PART001", "PART002", "TYPE001", "RATE001",
+    "APP001", "SINK001",
 ]

@@ -418,10 +418,11 @@ class AggregationRuntime:
             hasattr(adef, "get_annotation") else None
         self.bucket_capacity = int(cap_ann.element("buckets")) \
             if cap_ann is not None and cap_ann.element("buckets") else 1 << 16
-        agg_mesh = getattr(app, "mesh", None)
+        from ..sharding import shard_count
+        agg_mesh = app.mesh
         if agg_mesh is not None and (
-                agg_mesh.devices.size < 2 or
-                self.bucket_capacity % agg_mesh.devices.size != 0):
+                shard_count(agg_mesh) < 2 or
+                self.bucket_capacity % shard_count(agg_mesh) != 0):
             agg_mesh = None
         self._dstores: Dict[str, _DurationStore] = {
             d: _DurationStore(adef.id, d, self._identities,

@@ -25,10 +25,13 @@ patterns) are excluded at wiring time, same rule as `@pipeline`: their
 device-computed wake scalar cannot lag.
 
 Paths fused: plain (non-keyed, non-range-partition) single-stream
-queries, non-partitioned pattern/sequence queries, and join sides —
-each wraps the plan's un-jitted step body so fused and sequential
-execution run the identical per-batch program.  Keyed-window, sharded,
-and partitioned-pattern paths fall back to sequential dispatch.
+queries, non-partitioned pattern/sequence queries, join sides — each
+wraps the plan's un-jitted step body so fused and sequential execution
+run the identical per-batch program — and MESH-SHARDED partitioned
+patterns, whose stacks run a lax.scan INSIDE the shard_map
+(pattern_planner._shard_fused_step) so the per-dispatch overhead divides
+by K per shard.  Keyed-window and unsharded partitioned-pattern paths
+fall back to sequential dispatch.
 """
 from __future__ import annotations
 
@@ -62,9 +65,15 @@ def ineligible_reason(qr, kind: str):
     if kind == "pattern":
         if p.timer_step is not None:
             return "absent pattern needs timer wakeups — wake cannot lag"
+        if getattr(p, "mesh", None) is not None:
+            # sharded partitioned patterns fuse through the shard_map'd
+            # scan step (pattern_planner._shard_fused_step)
+            if getattr(p, "shard_fused_steps", None):
+                return None
+            return "sharded pattern step has no fusable body"
         if p.partition_positions:
             return "partitioned pattern grouping is not fused yet"
-        if p.mesh is not None or p.step_bodies is None:
+        if p.step_bodies is None:
             return "sharded pattern step has no fusable body"
         return None
     if kind == "join":
@@ -287,8 +296,43 @@ def _prepare_pattern(qr, items) -> Tuple[Callable, Tuple, Tuple]:
 
 
 def _dispatch_pattern(qr, items) -> None:
+    if getattr(qr.planned, "mesh", None) is not None:
+        return _dispatch_pattern_sharded(qr, items)
     fn, xs, const = _prepare_pattern(qr, items)
     qr.state, outs = fn(qr.state, xs, const)
+    _deliver_fused(qr, outs, [now for _, _, now in items])
+
+
+def _dispatch_pattern_sharded(qr, items) -> None:
+    """Fused dispatch of a MESH-sharded partitioned pattern: each batch
+    routes through the key-space router on the host (slot binding,
+    liveness touch, dirty marking, per-shard counters — the identical
+    bookkeeping the sequential sharded path does), the grouped layouts
+    pad to one common [n*Kb, E] shape across the stack, and the whole
+    [K, ...] block runs as ONE shard_map'd scan dispatch
+    (pattern_planner._shard_fused_step)."""
+    p = qr.planned
+    stream_id = items[0][0]
+    preps = [qr._shard_prep(stream_id, staged, now)
+             for _, staged, now in items]
+    n = preps[0][0].shape[0]
+    Kb = max(ki.shape[1] for ki, _ in preps)
+    E = max(s.shape[2] for _, s in preps)
+    block = qr.shard_router.block
+    k = len(items)
+    key_k = np.full((k, n, Kb), block, np.int32)
+    sel_k = np.full((k, n, Kb, E), -1, np.int32)
+    for i, (ki, s) in enumerate(preps):
+        key_k[i, :, :ki.shape[1]] = ki
+        sel_k[i, :, :s.shape[1], :s.shape[2]] = s
+    stack = ev.StackedBatch([staged for _, staged, _ in items])
+    xs = (tuple(jnp.asarray(c) for c in stack.cols),
+          jnp.asarray(stack.ts),
+          jnp.asarray(sel_k.reshape(k, n * Kb, E)),
+          jnp.asarray(key_k.reshape(k, n * Kb)),
+          _now_stack(items))
+    fn = p.shard_fused_steps[stream_id]
+    qr.state, outs = fn(qr.state, xs, qr._in_tabs())
     _deliver_fused(qr, outs, [now for _, _, now in items])
 
 

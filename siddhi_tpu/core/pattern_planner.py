@@ -166,6 +166,9 @@ class PlannedPatternQuery:
     # THESE in its lax.scan so fused and sequential execution run the
     # identical per-batch program (core/fusion.py); None on the mesh path
     step_bodies: Optional[Dict[str, Callable]] = None
+    # mesh path's @fuse entry: one shard_map dispatch scanning K stacked
+    # batches per device (fusion._dispatch_pattern_sharded); None off-mesh
+    shard_fused_steps: Optional[Dict[str, Callable]] = None
 
     # the compact_rows default means "effectively uncapped" for
     # non-partitioned patterns (a per-key cap with K=1 would cap the
@@ -195,6 +198,7 @@ class PlannedPatternQuery:
         d["emission_cap_explicit"] = bool(self.emit_explicit)
         if self.mesh is not None:
             d["sharded_over_devices"] = int(self.mesh.devices.size)
+            d["shard_fused_step"] = self.shard_fused_steps is not None
         return d
 
 
@@ -341,6 +345,7 @@ def plan_pattern_query(
     steps_w = None
     dense_steps_w = None
     step_bodies = None
+    shard_fused_steps = None
     if mesh is None and partition_positions is None and \
             block_eligible(spec) and not _FORCE_SCAN:
         # single-key simple chain: the sequential E-tick scan degrades to
@@ -372,6 +377,12 @@ def plan_pattern_query(
         steps = {sid: _shard_step(body, mesh, packer, pexec, sel,
                                   owner=name)
                  for sid, body in raw_steps.items()}
+        # @fuse over the mesh: scan-of-K-batches inside the shard_map
+        # (fusion._dispatch_pattern routes stacks here)
+        shard_fused_steps = {
+            sid: _shard_fused_step(body, mesh, packer, pexec, sel,
+                                   owner=f"fused:{name}")
+            for sid, body in raw_steps.items()}
 
     timer_step = None
     if spec.has_absent:
@@ -425,7 +436,8 @@ def plan_pattern_query(
         partition_key_fns=partition_key_fns,
         raw_steps=raw_steps, mesh=mesh, emit_explicit=emit_explicit,
         selector_exec=sel, emits_uuid=pexec.scope.uses_uuid,
-        compact_rows=compact_rows, step_bodies=step_bodies)
+        compact_rows=compact_rows, step_bodies=step_bodies,
+        shard_fused_steps=shard_fused_steps)
 
 
 def _first_schema(spec: PatternSpec, schemas) -> ev.Schema:
@@ -458,20 +470,11 @@ def _used_refs(query: Query, spec: PatternSpec) -> set:
     return used
 
 
-def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
-                sel: SelectorExec,
-                owner=None):
-    """Shard the pattern step over the mesh 'shard' axis.
-
-    Design (scaling-book style): partition keys are the shard axis — each
-    device owns K/n key rows of NFA + aggregation state, the host routes
-    events to their key's shard (slot % n), and the per-device step is the
-    unmodified single-device body.  Keys are independent so the data path
-    needs NO cross-device communication; only the scalar next-wakeup
-    reduction (pmin) and the overflow counter (psum) ride the ICI.
-    This replaces the reference's thread-per-Disruptor scale-up
-    (CORE/stream/StreamJunction.java:296) with SPMD scale-out.
-    """
+def _shard_specs(packer: "StatePacker", pexec: PatternExec,
+                 sel: SelectorExec):
+    """(pattern-state spec, selector-state spec) for the sharded pattern
+    layouts — blobs are [W, K] with the key (shard) axis at axis 1;
+    selector slabs shard axis 0; scalars replicate."""
     from jax.sharding import PartitionSpec as P
 
     ex_packed = packer.pack(pexec.init_state(2))
@@ -480,14 +483,20 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
     def leaf_spec(x):
         return P() if getattr(x, "ndim", 0) == 0 else P("shard")
 
-    # blobs are [W, K]: the key (shard) axis is axis 1
     pspec = (P(None, "shard"), P(None, "shard"),
              tuple(P() for _ in ex_packed[2]))
     sspec = jax.tree.map(leaf_spec, ex_s)
-    bspec = P("shard")    # sharded inputs: [n*Kb, ...] on axis 0
-    rspec = P()           # raw event columns [B]: replicated to all shards
+    return pspec, sspec
 
-    def local(packed, sel_state, raw_cols, raw_ts, sel, key_idx, now,
+
+def _shard_local(body):
+    """Per-device body shared by the sequential sharded step and the
+    fused (scan) variant: replicated inputs are marked device-varying,
+    the unmodified single-device `body` runs over local key rows, and
+    the replicated outputs merge (header psum, scalar-counter delta
+    psum, wake pmin)."""
+
+    def local(packed, sel_state, raw_cols, raw_ts, sel_idx, key_idx, now,
               in_tabs=()):
         b32, b64, scalars = packed
         old_scalars = scalars
@@ -500,7 +509,7 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
         in_tabs = jax.tree.map(
             lambda x: pcast(x, ("shard",), to="varying"), in_tabs)
         ps, ss, out, wake = body((b32, b64, scalars), sel_state, raw_cols,
-                                 raw_ts, sel, key_idx, now, in_tabs)
+                                 raw_ts, sel_idx, key_idx, now, in_tabs)
         out = (lax.psum(out[0], "shard"), lax.psum(out[1], "shard")) + out[2:]
         nb32, nb64, nscal = ps
         # re-replicate scalar counters: old + psum(local delta)
@@ -511,11 +520,69 @@ def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
         wake = lax.pmin(wake, "shard")
         return (nb32, nb64, nscal), ss, out, wake
 
+    return local
+
+
+def _shard_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
+                sel: SelectorExec,
+                owner=None):
+    """Shard the pattern step over the mesh 'shard' axis.
+
+    Design (scaling-book style): partition keys are the shard axis — each
+    device owns K/n key rows of NFA + aggregation state, the host routes
+    events to their key's shard (sharding/router.py: slot % n), and the
+    per-device step is the unmodified single-device body.  Keys are
+    independent so the data path needs NO cross-device communication;
+    only the scalar next-wakeup reduction (pmin) and the overflow counter
+    (psum) ride the ICI.  This replaces the reference's
+    thread-per-Disruptor scale-up (CORE/stream/StreamJunction.java:296)
+    with SPMD scale-out.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    pspec, sspec = _shard_specs(packer, pexec, sel)
+    bspec = P("shard")    # sharded inputs: [n*Kb, ...] on axis 0
+    rspec = P()           # raw event columns [B]: replicated to all shards
     sharded = shard_map(
-        local, mesh=mesh,
+        _shard_local(body), mesh=mesh,
         in_specs=(pspec, sspec, rspec, rspec, bspec, bspec, P(), P()),
         out_specs=(pspec, sspec, (P(), P(), bspec, bspec, bspec, bspec), P()))
     return jit_step(sharded, owner=owner, donate_argnums=(0, 1))
+
+
+def _shard_fused_step(body, mesh, packer: "StatePacker", pexec: PatternExec,
+                      sel: SelectorExec, owner=None):
+    """@fuse(batches=K) over the MESH: one shard_map dispatch whose local
+    body is a lax.scan over K stacked batches — per-dispatch overhead
+    (and, on a tunneled device, the per-send RTT) divides by K per shard,
+    the design lever ROADMAP item 1 names for the sharded serving path.
+    The scan sits INSIDE the shard_map, so every iteration runs the same
+    per-device program as the sequential sharded step and parity is
+    byte-identical; stacked inputs carry a leading [K] axis with the
+    sharded [n*Kb] axes shifted to axis 1."""
+    from jax.sharding import PartitionSpec as P
+    from .steputil import strongify
+
+    pspec, sspec = _shard_specs(packer, pexec, sel)
+    local = _shard_local(body)
+    bspec2 = P(None, "shard")   # stacked sharded inputs: [K, n*Kb, ...]
+
+    def fused_local(carry, xs, in_tabs):
+        def scan_body(c, x):
+            packed, sel_state = c
+            raw_cols, raw_ts, sel_idx, key_idx, now = x
+            ps, ss, out, _wake = local(packed, sel_state, raw_cols,
+                                       raw_ts, sel_idx, key_idx, now,
+                                       in_tabs)
+            return strongify((ps, ss)), out
+        return lax.scan(scan_body, carry, xs)
+
+    sharded = shard_map(
+        fused_local, mesh=mesh,
+        in_specs=((pspec, sspec), (P(), P(), bspec2, bspec2, P()), P()),
+        out_specs=((pspec, sspec),
+                   (P(), P(), bspec2, bspec2, bspec2, bspec2)))
+    return jit_step(sharded, owner=owner, donate_argnums=(0,))
 
 
 def _emit_matches(pexec: PatternExec, sel: SelectorExec, spec: PatternSpec,

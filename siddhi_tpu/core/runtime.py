@@ -35,6 +35,7 @@ from .planner import PlannedQuery, plan_single_query
 from .window import NO_WAKEUP
 from .steputil import jit_step
 from . import fusion as _fusion
+from .. import sharding as _sharding
 
 _NO_WAKEUP_INT = int(NO_WAKEUP)
 
@@ -133,6 +134,18 @@ def _acquire_all(locks):
         time.sleep(0.001)
 
 
+def _rebucket_for(qr, old_layout, host_state):
+    """Mesh-resize restore: permute a snapshot's key-state rows into THIS
+    runtime's shard layout when it was written under a different mesh
+    size (sharding/snapshot.py).  Identity for same-mesh restores and
+    pre-layout snapshots."""
+    new_layout = _sharding.query_layout(qr)
+    if not _sharding.needs_rebucket(old_layout, new_layout):
+        return host_state
+    return _sharding.rebucket_state(host_state, old_layout, new_layout,
+                                    qr.planned)
+
+
 def _allocator_of(qr):
     """Slot allocator of a query runtime (pattern runtimes hold it
     directly, planned single queries on the plan).  Explicit None checks:
@@ -196,7 +209,34 @@ class InputHandler:
         self._runtime._route_columns(self.stream_id, cols, timestamps)
 
 
-class QueryRuntime:
+class _MeshResolved:
+    """Resolved mesh/router accessors shared by every query-runtime
+    wrapper: the ONE way host code asks "is this query sharded, and how".
+    sharding/router.py owns the layout; the former scattered
+    `getattr(.., "mesh"/"keyed_mesh", None)` call sites (purger resets,
+    staging grouping, snapshot layout, fusion eligibility) all route
+    through these."""
+
+    @property
+    def mesh(self):
+        return _sharding.mesh_of(self)
+
+    @property
+    def keyed_mesh(self):
+        return _sharding.keyed_mesh_of(self)
+
+    @property
+    def shard_router(self):
+        # memoized in a 1-tuple so a resolved None doesn't re-resolve
+        # per batch (replans never change mesh/capacity, so no staleness)
+        r = self.__dict__.get("_shard_router_memo")
+        if r is None:
+            r = self.__dict__["_shard_router_memo"] = \
+                (_sharding.router_for(self),)
+        return r[0]
+
+
+class QueryRuntime(_MeshResolved):
     """Host wrapper around one planned query: staging, group slots, routing."""
 
     def __init__(self, planned: PlannedQuery, app: "SiddhiAppRuntime"):
@@ -366,7 +406,7 @@ class QueryRuntime:
         _emit_output(self, out, now, wake)
 
 
-class PatternQueryRuntime:
+class PatternQueryRuntime(_MeshResolved):
     """Host wrapper for a pattern/sequence query: groups events per key into
     the [K, E] device layout and drives the per-stream NFA steps."""
 
@@ -470,12 +510,15 @@ class PatternQueryRuntime:
                        now: int) -> None:
         p = self.planned
         B = staged.ts.shape[0]
-        if p.partition_positions and p.mesh is not None:
-            self._process_sharded(stream_id, staged, now)
-            return
+        # @fuse stacks BEFORE the mesh branch: sharded pattern dispatches
+        # fuse too (fusion._dispatch_pattern routes stacks through the
+        # shard_map'd scan step built in pattern_planner._shard_fused_step)
         fb = self._fuse
         if fb is not None and fb.offer((stream_id, staged, now), staged,
                                        stream_id):
+            return
+        if self.shard_router is not None:
+            self._process_sharded(stream_id, staged, now)
             return
         raw_cols = tuple(jax.numpy.asarray(c) for c in staged.cols)
         # ts-delta wire: ship (base scalar, i32 delta) instead of a fresh
@@ -570,14 +613,15 @@ class PatternQueryRuntime:
         self.state = (pstate, sel_state)
         _emit_output(self, out, now, wake=self._wake_arg(wake))
 
-    def _process_sharded(self, stream_id: str, staged: ev.StagedBatch,
-                         now: int) -> None:
-        """Multi-chip path: route each key to its shard (slot % n), build the
-        stacked [n*Kb, E] layout, run the shard_map step."""
-        from .keyslots import group_events_by_key
+    def _shard_prep(self, stream_id: str, staged: ev.StagedBatch,
+                    now: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Staging-time routing of one batch through the key-space router
+        (host side effects: slot binding, purger liveness touch, dirty
+        marking, per-shard routing counters).  Returns the grouped
+        (key_idx [n, Kb], sel [n, Kb, E]) device layout — shared by the
+        sequential sharded path and fused dispatch (core/fusion.py)."""
         p = self.planned
-        n = p.mesh.devices.size
-        B = staged.ts.shape[0]
+        router = self.shard_router
         kf = (p.partition_key_fns or {}).get(stream_id)
         if kf is not None:
             key_cols, kvalid = kf(staged)
@@ -593,32 +637,29 @@ class PatternQueryRuntime:
             live = slots[slots >= 0]
             if live.size:
                 # global state column of slot s under the shard layout
-                self._dirty[(live % n) * (p.key_capacity // n) +
-                            live // n] = True
-        dev = slots % n
-        local = slots // n
-        groups = []
-        for d in range(n):
-            mask = (dev == d) & staged.valid & (slots >= 0)
-            groups.append(group_events_by_key(
-                np.where(mask, local, -1), mask,
-                pad=p.key_capacity // n))
-        Kb = max(g[0].shape[0] for g in groups)
-        E = max(g[1].shape[1] for g in groups)
-        key_idx = np.full((n, Kb), p.key_capacity // n, np.int32)
-        sel = np.full((n, Kb, E), -1, np.int32)
-        for d, (ki, s, kv) in enumerate(groups):
-            key_idx[d, :ki.shape[0]] = ki
-            sel[d, :s.shape[0], :s.shape[1]] = s
-        flat = lambda a: a.reshape((n * Kb,) + a.shape[2:])
+                self._dirty[router.state_row(live)] = True
+        key_idx, sel, counts = router.group(slots, staged.valid)
+        stats = self.app.stats
+        if stats.enabled:
+            stats.shard_events(self.name, counts)
+        return key_idx, sel
+
+    def _process_sharded(self, stream_id: str, staged: ev.StagedBatch,
+                         now: int) -> None:
+        """Multi-chip path: route each key to its shard (slot % n), build the
+        stacked [n*Kb, E] layout, run the shard_map step."""
+        p = self.planned
+        key_idx, sel = self._shard_prep(stream_id, staged, now)
+        flat = lambda a: a.reshape((-1,) + a.shape[2:])   # noqa: E731
         pstate, sel_state = self.state
-        pstate, sel_state, out, wake = p.steps[stream_id](
-            pstate, sel_state,
-            tuple(jax.numpy.asarray(c) for c in staged.cols),
-            jax.numpy.asarray(staged.ts),
-            jax.numpy.asarray(flat(sel)),
-            jax.numpy.asarray(flat(key_idx)),
-            jax.numpy.asarray(now, jax.numpy.int64), self._in_tabs())
+        with _maybe_span("step", query=self.name, kind="sharded-pattern"):
+            pstate, sel_state, out, wake = p.steps[stream_id](
+                pstate, sel_state,
+                tuple(jax.numpy.asarray(c) for c in staged.cols),
+                jax.numpy.asarray(staged.ts),
+                jax.numpy.asarray(flat(sel)),
+                jax.numpy.asarray(flat(key_idx)),
+                jax.numpy.asarray(now, jax.numpy.int64), self._in_tabs())
         self.state = (pstate, sel_state)
         _emit_output(self, out, now, wake=self._wake_arg(wake))
 
@@ -1065,7 +1106,7 @@ def _apply_table_op(qr, ots, okind, ovalid, ocols, now) -> None:
                            staged=staged)
 
 
-class JoinQueryRuntime:
+class JoinQueryRuntime(_MeshResolved):
     """Host wrapper for join queries: routes each side's batches to the
     side-specific jitted step, passing table snapshots for table sides."""
 
@@ -1135,7 +1176,7 @@ class JoinQueryRuntime:
         whatever the choice; scatters/sorts get collectives as needed).
         Scalars and indivisible leaves stay replicated.  Restore paths call
         this too, so a restored runtime keeps its sharding."""
-        mesh = getattr(self.app, "mesh", None)
+        mesh = self.app.mesh
         if mesh is None or mesh.devices.size < 2:
             return state
         from .shardsafe import axis0_sharding
@@ -1707,12 +1748,6 @@ class _PartitionPurger:
         self.app._scheduler.notify_at(now + self.interval_ms, self)
 
     @staticmethod
-    def _shard_remap(idx: np.ndarray, n: int, capacity: int) -> np.ndarray:
-        """Round-robin shard layout: slot/key s lives at state row
-        (s % n) * (capacity/n) + s // n on the sharded slab."""
-        return (idx % n) * (capacity // n) + idx // n
-
-    @staticmethod
     def _key_mask(idx: np.ndarray, capacity: int):
         from .shardsafe import key_mask
         return key_mask(idx, capacity)
@@ -1725,13 +1760,12 @@ class _PartitionPurger:
     def _reset_pattern_keys(self, qr, idx: np.ndarray) -> None:
         (b32, b64, scalars), sel_state = qr.state
         init32, init64 = self._init_cols[id(qr)]
-        mesh = getattr(qr.planned, "mesh", None)
-        if mesh is not None:
+        router = qr.shard_router
+        if router is not None:
             # the sharded path routes allocator slot s to state column
-            # (s % n) * (K/n) + s // n (keys round-robin over devices,
+            # router.state_row(s) (keys round-robin over devices,
             # _process_sharded) — the reset must hit the same columns
-            idx = self._shard_remap(idx, mesh.devices.size,
-                                    qr.planned.key_capacity)
+            idx = router.state_row(idx)
         mask = self._key_mask(idx, b32.shape[1])
         b32 = self._masked_fill(b32, mask, init32, key_axis=1)
         b64 = self._masked_fill(b64, mask, init64, key_axis=1)
@@ -1750,11 +1784,10 @@ class _PartitionPurger:
     def _reset_selector_slots(self, qr, idx: np.ndarray) -> None:
         wstate, astate = qr.state
         specs = qr.planned.selector_exec.bank.specs
-        mesh = getattr(qr.planned, "mesh", None)
-        if mesh is not None:
-            # sharded plain step stores slot s at row (s%n)*(G/n) + s//n
-            idx = self._shard_remap(idx, mesh.devices.size,
-                                    qr.planned.slot_allocator.capacity)
+        router = _sharding.group_router_for(qr)
+        if router is not None:
+            # sharded plain step stores slot s at row router.state_row(s)
+            idx = router.state_row(idx)
         # pair-indexed specs (distinctCount refcounts) live in a different
         # slot space; queries carrying them are excluded from purge at
         # registration, this guard is defense in depth
@@ -1768,11 +1801,10 @@ class _PartitionPurger:
     def _reset_keyed_window(self, qr, idx: np.ndarray) -> None:
         wslab, astate = qr.state
         single = qr.planned.window.init_state()
-        kmesh = getattr(qr.planned, "keyed_mesh", None)
-        if kmesh is not None:
-            # sharded slab stores key k at row (k%n)*(K/n) + k//n
-            idx = self._shard_remap(idx, kmesh.devices.size,
-                                    qr.planned.key_capacity)
+        router = qr.shard_router
+        if router is not None:
+            # sharded slab stores key k at row router.state_row(k)
+            idx = router.state_row(idx)
         mask = self._key_mask(idx, qr.planned.key_capacity)
         wslab = jax.tree.map(
             lambda s, i0: self._masked_fill(s, mask, i0),
@@ -3277,6 +3309,10 @@ class SiddhiAppRuntime:
                         a.snapshot() for a, _ in
                         getattr(qr.planned, "pair_allocs", [])] or None,
                     "wake": getattr(qr, "next_wakeup", None),
+                    # key-state row order (mesh layout) this snapshot is
+                    # written in: restore re-buckets through the router
+                    # when the target runtime's mesh size differs
+                    "layout": _sharding.query_layout(qr),
                 }
             windows = {
                 wid: jax.tree.map(lambda x: np.asarray(x), nw.state)
@@ -3329,6 +3365,7 @@ class SiddhiAppRuntime:
                         "journal": alloc.drain_journal()
                         if alloc is not None else [],
                         "wake": getattr(qr, "next_wakeup", None),
+                        "layout": _sharding.query_layout(qr),
                     }
                     dirty[:] = False
                 else:
@@ -3345,6 +3382,7 @@ class SiddhiAppRuntime:
                             a.snapshot() for a, _ in
                             getattr(qr.planned, "pair_allocs", [])] or None,
                         "wake": getattr(qr, "next_wakeup", None),
+                        "layout": _sharding.query_layout(qr),
                     }
             from .table import _table_state
             payload = {
@@ -3374,6 +3412,18 @@ class SiddhiAppRuntime:
                 alloc = _allocator_of(qr)
                 if d["kind"] == "keyed":
                     (b32, b64, scalars), _ = qr.state
+                    # incremental deltas index by state ROW: remap rows
+                    # (and the full selector tree riding along) when the
+                    # snapshot was cut under a different mesh size
+                    old_l = d.get("layout")
+                    new_l = _sharding.query_layout(qr)
+                    d_slots = np.asarray(d["slots"])
+                    sel_host = d["sel_state"]
+                    if _sharding.needs_rebucket(old_l, new_l):
+                        d_slots = _sharding.rebucket_rows(
+                            d_slots, old_l, new_l)
+                        sel_host = _sharding.rebucket_selector(
+                            sel_host, old_l, new_l, qr.planned)
                     sharded = len(getattr(
                         b32, "sharding", None).device_set) > 1 \
                         if getattr(b32, "sharding", None) is not None else \
@@ -3383,7 +3433,7 @@ class SiddhiAppRuntime:
                         # remote-shard columns (core/shardsafe.py): go
                         # through a dense masked where instead
                         from .shardsafe import key_mask, masked_fill
-                        slots = np.asarray(d["slots"])
+                        slots = d_slots
                         K = b32.shape[1]
                         mask = key_mask(slots, K)
                         up32 = np.zeros(b32.shape, np.asarray(
@@ -3399,7 +3449,7 @@ class SiddhiAppRuntime:
                                           jax.numpy.asarray(up64),
                                           key_axis=1)
                     else:
-                        idx = jax.numpy.asarray(d["slots"])
+                        idx = jax.numpy.asarray(d_slots)
                         b32 = b32.at[:, idx].set(
                             jax.numpy.asarray(d["b32"]))
                         b64 = b64.at[:, idx].set(
@@ -3407,13 +3457,15 @@ class SiddhiAppRuntime:
                     scalars = tuple(jax.numpy.asarray(s)
                                     for s in d["scalars"])
                     sel_state = jax.tree.map(lambda x: jax.numpy.asarray(x),
-                                             d["sel_state"])
+                                             sel_host)
                     qr.state = ((b32, b64, scalars), sel_state)
                     if alloc is not None:
                         alloc.apply_journal(d["journal"])
                 else:
+                    host_state = _rebucket_for(qr, d.get("layout"),
+                                               d["state"])
                     restored = jax.tree.map(
-                        lambda x: jax.numpy.asarray(x), d["state"])
+                        lambda x: jax.numpy.asarray(x), host_state)
                     qr.state = qr.place_state(restored) \
                         if hasattr(qr, "place_state") else restored
                     if d["slots"] is not None and alloc is not None:
@@ -3441,8 +3493,10 @@ class SiddhiAppRuntime:
                 qr = self.query_runtimes.get(name)
                 if qr is None:
                     continue
+                host_state = _rebucket_for(qr, data.get("layout"),
+                                           data["state"])
                 restored = jax.tree.map(
-                    lambda x: jax.numpy.asarray(x), data["state"])
+                    lambda x: jax.numpy.asarray(x), host_state)
                 qr.state = qr.place_state(restored) \
                     if hasattr(qr, "place_state") else restored
                 alloc = _allocator_of(qr)

@@ -253,7 +253,9 @@ def _steps_of(qr, kind: str) -> List[Tuple[str, Any]]:
         for role, d in (("step", p.steps), ("step_w", p.steps_w),
                         ("dense_step", getattr(p, "dense_steps", None)),
                         ("dense_step_w",
-                         getattr(p, "dense_steps_w", None))):
+                         getattr(p, "dense_steps_w", None)),
+                        ("shard_fused_step",
+                         getattr(p, "shard_fused_steps", None))):
             for sid, fn in (d or {}).items():
                 steps.append((f"{role}[{sid}]", fn))
         if p.timer_step is not None:
@@ -289,6 +291,18 @@ def _runtime_kind(qr) -> str:
 def _fusion_node(qr, kind: str) -> Dict:
     from ..core import fusion as _fusion
     return _fusion.eligibility(qr, kind)
+
+
+def _sharding_entry(qr, kind: str, deep: bool) -> Dict:
+    """{'sharding': node} for mesh-sharded queries (shard layout,
+    per-shard residency, and — deep — the collectives in the compiled
+    HLO), {} for single-device plans."""
+    try:
+        from ..sharding import explain_node
+        node = explain_node(qr, kind, deep=deep)
+    except Exception:  # noqa: BLE001 — diagnostics must not throw
+        node = None
+    return {"sharding": node} if node is not None else {}
 
 
 def _emission_node(qr, kind: str) -> Dict:
@@ -389,6 +403,7 @@ def explain_query(rt, query_name: str, deep: bool = True) -> Dict:
         },
         "emission": _emission_node(qr, kind),
         "fusion": _fusion_node(qr, kind),
+        **_sharding_entry(qr, kind, deep),
         "recompiles": RECOMPILES.snapshot(
             [query_name, f"fused:{query_name}"]),
         "findings": _lint_findings(rt, query_name),

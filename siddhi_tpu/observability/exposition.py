@@ -59,6 +59,16 @@ class _Family:
         self.sample(h.sum_ns / 1e9, "_sum", **labels)
         self.sample(h.total, "_count", **labels)
 
+    def histogram_raw(self, h: LogHistogram, **labels) -> None:
+        """Same shape as histogram() but in the histogram's RAW recorded
+        unit (count-valued series: events per shard per batch)."""
+        self._open()
+        for le, cum in h.buckets_raw():
+            self.sample(cum, "_bucket", **dict(labels, le=_fmt_le(le)))
+        self.sample(h.total, "_bucket", **dict(labels, le="+Inf"))
+        self.sample(h.sum_ns, "_sum", **labels)
+        self.sample(h.total, "_count", **labels)
+
 
 def _fmt_le(le: float) -> str:
     return f"{le:.9g}"
@@ -125,6 +135,17 @@ def render_prometheus(runtimes: Dict) -> str:
     r_fb = fam("siddhi_restore_fallbacks_total", "counter",
                "Snapshot revisions skipped as corrupt/unreadable "
                "during restore_last_revision")
+    sh_ev = fam("siddhi_shard_events_total", "counter",
+                "Events routed to each mesh shard by a sharded query's "
+                "key-space router (sharding/router.py)")
+    sh_oc = fam("siddhi_shard_batch_events", "histogram",
+                "Per-batch events landing on each mesh shard (raw event "
+                "counts, not seconds) — diverging shard p50s mean "
+                "routing skew")
+    sh_mem = fam("siddhi_shard_state_bytes", "gauge",
+                 "Device-state bytes RESIDENT PER SHARD (sharded leaves "
+                 "count their 1/n slice, replicated leaves count whole) "
+                 "— layout metadata only, never fetched")
 
     for app_name, rt in sorted(runtimes.items()):
         st = rt.stats
@@ -166,6 +187,18 @@ def render_prometheus(runtimes: Dict) -> str:
         for owner, comps in sorted(component_bytes(rt).items()):
             for comp, nb in sorted(comps.items()):
                 mem.sample(nb, app=app_name, query=owner, component=comp)
+        # shard dimension: routing totals + per-batch occupancy from the
+        # stats registry, per-shard residency from sharding metadata
+        # (shard_shape arithmetic — still no device work)
+        for q, per_shard in sorted(snap.get("shard_events", {}).items()):
+            for d, c in enumerate(per_shard):
+                sh_ev.sample(c, app=app_name, query=q, shard=d)
+        for key, h in sorted(snap.get("shard_hist", {}).items()):
+            q, _, shard = key.rpartition(":shard")
+            sh_oc.histogram_raw(h, app=app_name, query=q, shard=shard)
+        from ..sharding import shard_state_bytes
+        for d, nb in sorted(shard_state_bytes(rt).items()):
+            sh_mem.sample(nb, app=app_name, shard=d)
         # sink resilience: plain attribute reads off each connection's
         # state machine — no locks held, no device work
         from ..io.resilience import state_gauge

@@ -156,6 +156,15 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         excluded = fusion_exclusions(rt)
     except Exception:  # noqa: BLE001 — probe must not throw
         excluded = {}
+    # shard dimension: per-shard residency + routing balance of a meshed
+    # app (sharding/metrics.py — layout metadata + host counters only)
+    shards = None
+    try:
+        from ..sharding import shard_report
+        shards = shard_report(rt)
+    except Exception:  # noqa: BLE001 — probe must not throw
+        shards = None
+
     report = {
         "started": started,
         "accepting_ingress": accepting,
@@ -165,6 +174,7 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         "streams": streams,
         "sinks": sinks,
         "degraded": degraded,
+        **({"shards": shards} if shards is not None else {}),
         "buffered_emissions": rt.buffered_emissions(),
         "rates_window_s": _WINDOW_S,
         "dropped_per_s": round(_rate(rt, "dropped", drops), 6),

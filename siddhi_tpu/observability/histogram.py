@@ -93,6 +93,22 @@ class LogHistogram:
             out.append(((1 << i) / 1e9, cum))
         return out
 
+    def buckets_raw(self) -> List:
+        """Cumulative (le, count) pairs in the RAW recorded unit — for
+        count-valued histograms (batches per @fuse dispatch, events per
+        shard per batch) where a seconds conversion would lie."""
+        out = []
+        cum = 0
+        hi = 0
+        for i in range(NBUCKETS - 1, -1, -1):
+            if self.counts[i]:
+                hi = i
+                break
+        for i in range(hi + 1):
+            cum += self.counts[i]
+            out.append((float(1 << i), cum))
+        return out
+
     def merge(self, other: "LogHistogram") -> "LogHistogram":
         m = LogHistogram()
         m.counts = [a + b for a, b in zip(self.counts, other.counts)]

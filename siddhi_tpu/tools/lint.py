@@ -2,7 +2,8 @@
 
     python -m siddhi_tpu.tools.lint app.siddhi [more.siddhi ...]
         [--format text|json] [--fail-on info|warn|error]
-        [--disable RULE[,RULE...]] [--state-budget BYTES] [--rules]
+        [--disable RULE[,RULE...]] [--state-budget BYTES]
+        [--mesh-size N] [--rules]
 
 Exit-code contract (stable — CI scripts key on it):
     0   no finding at or above the --fail-on severity (default: error)
@@ -51,6 +52,10 @@ def _build_parser() -> argparse.ArgumentParser:
                    metavar="BYTES",
                    help="MEM001 device-state budget in bytes "
                         "(default: 128 MiB)")
+    p.add_argument("--mesh-size", type=int, default=0, metavar="N",
+                   help="PART002 deploy target: shard-mesh device count "
+                        "the app will serve on (default: unknown — "
+                        "PART002 stays silent)")
     p.add_argument("--rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
@@ -81,6 +86,8 @@ def main(argv: List[str] | None = None) -> int:
                   if r.strip()})
     if args.state_budget is not None:
         config.state_budget_bytes = args.state_budget
+    if args.mesh_size:
+        config.mesh_devices = args.mesh_size
     threshold = severity_rank(_FAIL_LEVELS[args.fail_on])
 
     failed = False

@@ -42,6 +42,11 @@ class StatisticsManager:
         self._junction_hist: Dict[str, LogHistogram] = {}
         self._sink_hist: Dict[str, LogHistogram] = {}
         self._fused_k_hist: Dict[str, LogHistogram] = {}
+        # sharded dispatch routing: per-query cumulative events per mesh
+        # shard + per-shard batch-occupancy histograms keyed
+        # "<query>:shard<d>" (recorded unit: EVENTS, not ns)
+        self._shard_events: Dict[str, list] = {}
+        self._shard_hist: Dict[str, LogHistogram] = {}
         self._counters: Dict[str, int] = {}
         self.tracer = PipelineTracer()
         self._start = time.time()
@@ -103,6 +108,23 @@ class StatisticsManager:
             self._counters[f"{name}.fused_batches"] = \
                 self._counters.get(f"{name}.fused_batches", 0) + k
 
+    def shard_events(self, name: str, counts) -> None:
+        """Events one sharded dispatch routed to each mesh shard
+        (sharding/router.group counts): cumulative per-shard counters
+        (`siddhi_shard_events_total` in /metrics, balance verdicts in
+        /healthz) plus a per-shard occupancy histogram so routing skew
+        shows as diverging p50s, not just diverging totals."""
+        with self._lock:
+            cur = self._shard_events.get(name)
+            if cur is None or len(cur) < len(counts):
+                cur = self._shard_events[name] = \
+                    [0] * len(counts) if cur is None else \
+                    cur + [0] * (len(counts) - len(cur))
+        for d, c in enumerate(counts):
+            cur[d] += int(c)
+            hist_of(self._shard_hist, f"{name}:shard{d}",
+                    self._lock).record(int(c))
+
     # -- recompile projection --------------------------------------------------
     @staticmethod
     def _owners_of(app) -> Optional[list]:
@@ -141,6 +163,9 @@ class StatisticsManager:
                 "junction_hist": dict(self._junction_hist),
                 "sink_hist": dict(self._sink_hist),
                 "fused_k_hist": dict(self._fused_k_hist),
+                "shard_events": {k: list(v)
+                                 for k, v in self._shard_events.items()},
+                "shard_hist": dict(self._shard_hist),
                 "counters": dict(self._counters),
             }
 
@@ -186,6 +211,12 @@ class StatisticsManager:
                 out["fused_batches_per_dispatch"] = {
                     name: h.snapshot()
                     for name, h in self._fused_k_hist.items()}
+            if self._shard_events:
+                # per-shard routing totals of sharded queries (the same
+                # counters /metrics exports as siddhi_shard_events_total)
+                out["shard_events"] = {
+                    name: list(v)
+                    for name, v in self._shard_events.items()}
             if self._counters:
                 out["counters"] = dict(self._counters)
         rec = self.recompiles(app)
@@ -228,6 +259,8 @@ class StatisticsManager:
             self._junction_hist.clear()
             self._sink_hist.clear()
             self._fused_k_hist.clear()
+            self._shard_events.clear()
+            self._shard_hist.clear()
             self._counters.clear()
             self._start = time.time()
 

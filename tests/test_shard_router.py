@@ -1,0 +1,254 @@
+"""Sharded serving runtime units: the key-space router's layout
+arithmetic, mesh-resize permutations, shard-labelled observability
+(/metrics, /healthz, EXPLAIN), and the PART002 lint rule."""
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from siddhi_tpu.sharding import (ShardRouter, needs_rebucket,
+                                 rebucket_rows, shard_count)
+
+
+@pytest.fixture()
+def mesh():
+    devs = np.array(jax.devices())
+    if devs.size < 8:
+        pytest.skip("needs 8 virtual devices")
+    return Mesh(devs[:8], ("shard",))
+
+
+# ---------------------------------------------------------------------------
+# router arithmetic
+# ---------------------------------------------------------------------------
+
+def test_state_row_is_a_bijection():
+    for n in (1, 2, 4, 8):
+        r = ShardRouter(n, 64)
+        slots = np.arange(64)
+        rows = r.state_row(slots)
+        assert sorted(rows.tolist()) == list(range(64))
+        assert np.array_equal(r.slot_of_row(rows), slots)
+
+
+def test_state_row_matches_shard_blocks():
+    """Slot s lands in shard (s % n)'s contiguous row block — the block
+    PartitionSpec('shard') physically places on that device."""
+    r = ShardRouter(4, 32)
+    slots = np.arange(32)
+    rows = r.state_row(slots)
+    for s, row in zip(slots, rows):
+        d = s % 4
+        assert d * 8 <= row < (d + 1) * 8
+        assert r.shard_of(np.array([s]))[0] == d
+
+
+def test_rebucket_index_roundtrip():
+    """new[j] = old[src[j]] moves every slot's state to its new row,
+    for every (n_old, n_new) pair, including to/from 1."""
+    cap = 48
+    base = np.arange(cap)        # state under identity (1-way) layout
+    for n_old in (1, 2, 4, 8):
+        for n_new in (1, 2, 4, 8):
+            r_old, r_new = ShardRouter(n_old, cap), ShardRouter(n_new, cap)
+            # state value of slot s is s; old layout stores it at
+            # r_old.state_row(s)
+            old_state = np.empty(cap, int)
+            old_state[r_old.state_row(base)] = base
+            src = r_new.rebucket_index(r_old)
+            new_state = old_state[src]
+            # after re-bucketing, slot s must sit at r_new.state_row(s)
+            assert np.array_equal(new_state[r_new.state_row(base)], base)
+
+
+def test_rebucket_rows_maps_dirty_indices():
+    old = {"kind": "pattern", "n": 8, "capacity": 64}
+    new = {"kind": "pattern", "n": 2, "capacity": 64}
+    r8, r2 = ShardRouter(8, 64), ShardRouter(2, 64)
+    slots = np.array([0, 5, 17, 63])
+    rows8 = r8.state_row(slots)
+    assert np.array_equal(rebucket_rows(rows8, old, new),
+                          r2.state_row(slots))
+
+
+def test_needs_rebucket_discrimination():
+    a = {"kind": "pattern", "n": 8, "capacity": 64}
+    assert not needs_rebucket(a, a)
+    assert not needs_rebucket(None, a)
+    assert not needs_rebucket(a, None)
+    assert needs_rebucket(a, {"kind": "pattern", "n": 4, "capacity": 64})
+    # capacity or kind mismatch: restore verbatim (fails later exactly
+    # as pre-layout snapshots did)
+    assert not needs_rebucket(a, {"kind": "pattern", "n": 4,
+                                  "capacity": 32})
+    assert not needs_rebucket(a, {"kind": "keyed", "n": 4,
+                                  "capacity": 64})
+
+
+def test_capacity_must_divide():
+    with pytest.raises(ValueError):
+        ShardRouter(8, 60)
+
+
+def test_group_routes_and_counts():
+    r = ShardRouter(4, 16)
+    slots = np.array([0, 1, 2, 3, 4, 5, -1, 4])
+    valid = np.array([True] * 7 + [False])
+    key_idx, sel, counts = r.group(slots, valid)
+    assert key_idx.shape[0] == 4 and sel.shape[0] == 4
+    # slots 0,4 -> shard 0; 1,5 -> shard 1; 2 -> shard 2; 3 -> shard 3
+    assert counts.tolist() == [2, 2, 1, 1]
+    # shard 0 holds local rows 0 (slot 0) and 1 (slot 4)
+    live0 = key_idx[0][key_idx[0] < r.block]
+    assert sorted(live0.tolist()) == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# shard-labelled observability
+# ---------------------------------------------------------------------------
+
+STATS_APP = """
+@app:name('shardmetrics')
+@app:playback
+@app:statistics('BASIC')
+define stream S (key long, price float, volume int);
+partition with (key of S)
+begin
+  @capacity(keys='64', slots='4')
+  @info(name='q1')
+  from every e1=S[volume == 1] -> e2=S[volume == 2]
+  select e1.key as k, e2.price as p
+  insert into Out;
+end;
+"""
+
+
+@pytest.fixture()
+def stats_rt(mesh):
+    from siddhi_tpu import SiddhiManager
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(STATS_APP, mesh=mesh)
+    rt.add_callback("q1", lambda ts, i, o: None)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for stage in (1, 2):
+        h.send([[k, float(stage), stage] for k in range(24)],
+               timestamp=1000 * stage)
+    rt.flush()
+    yield rt
+    m.shutdown()
+
+
+def test_metrics_gain_shard_dimension(stats_rt):
+    from siddhi_tpu.observability.exposition import render_prometheus
+    text = render_prometheus({"shardmetrics": stats_rt})
+    assert 'siddhi_shard_events_total{app="shardmetrics",query="q1",' \
+           'shard="0"}' in text
+    # all 8 shards report residency, and the routed totals sum to the
+    # events sent (24 keys x 2 stages)
+    for d in range(8):
+        assert f'siddhi_shard_state_bytes{{app="shardmetrics",' \
+               f'shard="{d}"}}' in text
+    totals = [int(float(line.rsplit(" ", 1)[1]))
+              for line in text.splitlines()
+              if line.startswith("siddhi_shard_events_total")]
+    assert sum(totals) == 48
+    assert "siddhi_shard_batch_events_bucket" in text
+
+
+def test_healthz_gains_shard_dimension(stats_rt):
+    rep = stats_rt.health()
+    shards = rep["shards"]
+    assert shards["devices"] == 8
+    assert set(shards["per_shard"]) == {str(d) for d in range(8)}
+    assert all(s["state_bytes"] > 0 for s in shards["per_shard"].values())
+    ev = sum(s["events_total"] for s in shards["per_shard"].values())
+    assert ev == 48
+    # 24 keys over 8 shards round-robin: every shard saw traffic
+    assert shards["balanced"] is True
+
+
+def test_per_shard_state_bytes_shrink_with_mesh(stats_rt):
+    """Per-shard residency counts sharded leaves at 1/n: it must be well
+    below the global total for a 64-key slab over 8 devices."""
+    from siddhi_tpu.observability.memory import tree_nbytes
+    from siddhi_tpu.sharding import shard_state_bytes
+    qr = stats_rt.query_runtimes["q1"]
+    total = tree_nbytes(qr.state)
+    per = shard_state_bytes(stats_rt)[0]
+    assert 0 < per < total
+
+
+def test_explain_reports_sharding(stats_rt):
+    rep = stats_rt.explain("q1")
+    node = rep["sharding"]
+    assert node["devices"] == 8
+    assert node["key_capacity"] == 64 and node["keys_per_shard"] == 8
+    assert node["snapshot_layout"] == {"kind": "pattern", "n": 8,
+                                       "capacity": 64}
+    # deep explain compiles: the sharded step's HLO carries collectives
+    # (the psum'd emission header at minimum)
+    colls = node["collectives"]
+    assert any(colls.values()), colls
+
+
+def test_shard_count_accessor(mesh):
+    from siddhi_tpu import SiddhiManager
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(
+        "define stream S (a int); from S select a insert into O;",
+        mesh=mesh)
+    assert shard_count(rt) == 8
+    rt2 = m.create_siddhi_app_runtime(
+        "@app:name('x') define stream S (a int); "
+        "from S select a insert into O;")
+    assert shard_count(rt2) == 1
+    m.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# PART002
+# ---------------------------------------------------------------------------
+
+UNDERSIZED = """
+define stream S (key long, v int);
+partition with (key of S)
+begin
+  @capacity(keys='4')
+  from S select key, sum(v) as t insert into Out;
+end;
+"""
+
+
+def test_part002_fires_with_configured_mesh():
+    from siddhi_tpu.analysis import LintConfig, analyze
+    ids = [f.rule_id for f in analyze(
+        UNDERSIZED, config=LintConfig(mesh_devices=8))]
+    assert "PART002" in ids
+
+
+def test_part002_silent_without_mesh():
+    from siddhi_tpu.analysis import analyze
+    assert "PART002" not in [f.rule_id for f in analyze(UNDERSIZED)]
+    # big-enough capacity: silent even with a mesh configured
+    from siddhi_tpu.analysis import LintConfig
+    ok = UNDERSIZED.replace("keys='4'", "keys='64'")
+    assert "PART002" not in [
+        f.rule_id for f in analyze(ok, config=LintConfig(mesh_devices=8))]
+
+
+def test_part002_resolves_runtime_mesh(mesh):
+    from siddhi_tpu import SiddhiManager
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(UNDERSIZED, mesh=mesh)
+    rep = rt.analyze()
+    assert any(f["rule"] == "PART002" for f in rep["findings"])
+    m.shutdown()
+
+
+def test_part002_cli_flag(tmp_path):
+    from siddhi_tpu.tools.lint import main
+    p = tmp_path / "u.siddhi"
+    p.write_text(UNDERSIZED)
+    assert main([str(p), "--mesh-size", "8", "--fail-on", "warn"]) == 1
+    assert main([str(p), "--fail-on", "warn"]) == 0
