@@ -3,10 +3,10 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test lint lint-apps lint-smoke dryrun bench metrics-smoke \
-	fuse-smoke explain-smoke chaos-smoke multichip-smoke all
+	fuse-smoke explain-smoke chaos-smoke multichip-smoke soak-smoke all
 
 all: lint lint-apps test dryrun metrics-smoke fuse-smoke explain-smoke \
-	lint-smoke chaos-smoke multichip-smoke
+	lint-smoke chaos-smoke multichip-smoke soak-smoke
 
 # static gate on our own code: ruff (rule set in pyproject.toml) when
 # available, with compileall kept as the syntax floor for samples and
@@ -71,3 +71,10 @@ explain-smoke:
 # tolerance")
 chaos-smoke:
 	$(CPU_ENV) $(PY) samples/chaos_smoke.py
+
+# sustained-load telemetry loop in <=30 s: 2 co-resident tenants under
+# @async ingest with chaos ON (sink transport dies mid-run), the
+# in-process sampler ticking, and the SLO verdict required to come back
+# `ok` with zero silent drops (soak-telemetry layer, README "Soak & SLOs")
+soak-smoke:
+	$(CPU_ENV) $(PY) samples/soak_smoke.py

@@ -992,12 +992,211 @@ def run_multichip(quick: bool = False, out_path=None):
     return payload
 
 
+SOAK_QL = """
+@app:name('{name}')
+@app:statistics('BASIC')
+
+@async(buffer.size='128', workers='1')
+define stream In (k long, v float, s int);
+
+@sink(type='chaos', id='{sink_id}', on.error='retry',
+      retry.initial.ms='2', retry.max.ms='25', retry.jitter='0',
+      breaker.failures='100000'{chaos_opts})
+define stream Out (k long, v float);
+
+@info(name='hot') from In[v > 2.95] select k, v insert into Out;
+
+@info(name='agg') from In#window.lengthBatch(512)
+select s, avg(v) as av, count() as c group by s insert into Agg;
+"""
+
+
+def _soak_app(manager, i: int, chaos: bool):
+    """One tenant: @async ingest, a filter query feeding a chaos sink
+    (retry policy, optional mid-run outage), and a grouped lengthBatch
+    aggregation consumed by a counting batch callback."""
+    name = f"soak{i}"
+    # deterministic mid-run transport outage: publish attempts 40-60 fail
+    # (1-based, counted across retries), the retry policy must redeliver
+    # with zero loss; the window is attempt-indexed so it lands mid-run
+    # at any --seconds
+    chaos_opts = ", fail.publishes='40-60'" if chaos else ""
+    rt = manager.create_siddhi_app_runtime(SOAK_QL.format(
+        name=name, sink_id=name, chaos_opts=chaos_opts))
+    agg_rows = [0]
+    rt.add_batch_callback(
+        "agg", lambda ts, b: agg_rows.__setitem__(
+            0, agg_rows[0] + b["n_current"]))
+    rt.start()
+    return name, rt, agg_rows
+
+
+def run_soak(seconds: int = 60, apps: int = 2, chaos: bool = False,
+             out_path=None, interval_s: float = 1.0,
+             p99_ms: float = 500.0, B: int = 1 << 10):
+    """--mode soak: M co-resident tenant apps under sustained @async
+    ingest for `seconds` wall seconds while the in-process time-series
+    sampler ticks every `interval_s` and the SLO engine judges each tick
+    (observability/timeseries.py, observability/slo.py).  With --chaos,
+    utils/chaos.py kills each tenant's sink transport mid-run (publish
+    attempts 40-60 fail) and the retry policy must redeliver with zero
+    loss.  Writes the ROADMAP item-4 long-run artifact (SOAK_r07.json):
+    per-second series, per-tenant accounting, p99 trajectories, and a
+    machine-checked SLO verdict.  Exit contract: rc 0 only when the
+    final verdict is `ok` AND zero events were silently dropped."""
+    import threading as _threading
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.observability.slo import SLORule, default_rules
+    from siddhi_tpu.utils.chaos import ChaosSink
+    _probe_backend()
+    manager = SiddhiManager()
+    tenants = {}
+    for i in range(apps):
+        name, rt, agg_rows = _soak_app(manager, i, chaos)
+        tenants[name] = {"rt": rt, "agg_rows": agg_rows, "sent": 0}
+
+    rng = np.random.default_rng(7)
+    # fixed full-bucket columns: constant shapes keep the steady state
+    # recompile-free, and identical re-sent buffers dedupe on the link
+    kcol = np.arange(B, dtype=np.int64)
+    vcol = (rng.random(B) * 3.0).astype(np.float32)
+    scol = (np.arange(B) % 8).astype(np.int32)
+    sel = int((vcol > 2.95).sum())       # sink rows per send, exact
+
+    # warm EVERY app's query signatures before the SLO clock starts: the
+    # one-time XLA compiles are a deploy cost, not a soak violation
+    for t in tenants.values():
+        h = t["rt"].get_input_handler("In")
+        for _ in range(2):
+            h.send_columns([kcol, vcol, scol])
+        t["rt"].flush()
+        t["sent"] += 2 * B
+
+    rules = default_rules() + [
+        SLORule("max-p99", "max_p99", float(p99_ms), for_ticks=3)]
+    sampler = manager.start_sampler(interval_s=interval_s, rules=rules)
+
+    stop = _threading.Event()
+
+    def produce(t):
+        h = t["rt"].get_input_handler("In")
+        while not stop.is_set():
+            h.send_columns([kcol, vcol, scol])
+            t["sent"] += B
+
+    threads = [_threading.Thread(target=produce, args=(t,), daemon=True,
+                                 name=f"soak-load-{name}")
+               for name, t in tenants.items()]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    deadline = t0 + seconds
+    while time.perf_counter() < deadline:
+        time.sleep(0.1)
+    stop.set()
+    for th in threads:
+        th.join(timeout=10.0)
+    for t in tenants.values():
+        t["rt"].flush()
+    elapsed = time.perf_counter() - t0
+    sampler.tick()                      # final post-flush evaluation
+    manager.stop_sampler()
+
+    total_sent = sum(t["sent"] for t in tenants.values())
+    app_reports = {}
+    verdicts = []
+    all_zero_drops = True
+    for name, t in tenants.items():
+        rt = t["rt"]
+        ts = rt.timeseries()
+        acct = ts.get("tenant", {})
+        slo = ts.get("slo", {})
+        verdicts.append(slo.get("verdict", "unknown"))
+        snap = rt.stats.exposition_snapshot()
+        counters = snap.get("counters", {})
+        drops = sum(v for k, v in counters.items()
+                    if k.endswith(".dropped"))
+        sink_drops = sum(
+            int(getattr(conn, "dropped_total", 0))
+            for sk in rt.sinks for conn in getattr(sk, "connections", ()))
+        hot_rows = counters.get("hot.emitted_rows", 0)
+        delivered = len(ChaosSink.instances[name].delivered)
+        expected_hot = (t["sent"] // B) * sel
+        # "silent" drop = an accepted event that vanished without a
+        # counter: emission drops and sink drops must be zero AND every
+        # row the hot query emitted must have reached the (chaos) sink
+        zero = drops == 0 and sink_drops == 0 and \
+            delivered == hot_rows == expected_hot
+        all_zero_drops = all_zero_drops and zero
+        app_reports[name] = {
+            "sent_events": t["sent"],
+            "tenant": acct,
+            "slo": slo,
+            "series": ts.get("series", {}),
+            "p99_trajectory_us": {
+                k[len("query."):-len(".p99_us")]: v
+                for k, v in ts.get("series", {}).items()
+                if k.startswith("query.") and k.endswith(".p99_us")},
+            "sink_delivered": delivered,
+            "hot_rows_emitted": hot_rows,
+            "hot_rows_expected": expected_hot,
+            "agg_rows_delivered": t["agg_rows"][0],
+            "sink_retries": acct.get("sink_retries", 0),
+            "dropped": drops + sink_drops,
+            "zero_silent_drops": zero,
+        }
+        print(f"soak[{name}]: sent={t['sent']} "
+              f"hot={hot_rows}/{expected_hot} delivered={delivered} "
+              f"agg_rows={t['agg_rows'][0]} "
+              f"retries={acct.get('sink_retries', 0)} "
+              f"verdict={slo.get('verdict')} zero_drops={zero}",
+              file=sys.stderr)
+    order = {"firing": 2, "pending": 1, "ok": 0}
+    verdict = max(verdicts, key=lambda v: order.get(v, 3))
+    import jax
+    payload = {
+        "mode": "soak",
+        "seconds": seconds, "elapsed_s": round(elapsed, 2),
+        "apps": apps, "chaos": chaos,
+        "interval_s": interval_s, "batch": B,
+        "p99_rule_ms": p99_ms,
+        "device": str(jax.devices()[0]),
+        "total_events": total_sent,
+        "events_per_sec": round(total_sent / elapsed),
+        "sampler_ticks": sampler.ticks,
+        "verdict": verdict,
+        "zero_silent_drops": all_zero_drops,
+        "tenants": app_reports,
+        "note": ("sustained multi-tenant soak through the normal "
+                 "@async InputHandler path; series are ring-buffer "
+                 "samples from the in-process sampler (host counters "
+                 "only, no device fetches); with chaos on, each "
+                 "tenant's sink transport dies for publish attempts "
+                 "40-60 and on.error='retry' must redeliver with zero "
+                 "loss"),
+    }
+    manager.shutdown()
+    line = dict(payload)
+    line.pop("tenants")               # the one-line summary stays short
+    print(json.dumps(line))
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(json.dumps(payload, indent=2) + "\n")
+        print(f"soak artifact written to {out_path}", file=sys.stderr)
+    if verdict != "ok" or not all_zero_drops:
+        print(f"SOAK FAILED: verdict={verdict} "
+              f"zero_silent_drops={all_zero_drops}", file=sys.stderr)
+        sys.exit(1)
+    return payload
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode", default="full",
                     choices=["full", "device_loop", "fuse_compare",
-                             "cost_analysis", "multichip"],
+                             "cost_analysis", "multichip", "soak"],
                     help="full: the flagship suite (default); "
                          "device_loop: tunnel-independent chip-side "
                          "events/sec via fused dispatch re-execution; "
@@ -1005,7 +1204,10 @@ if __name__ == "__main__":
                          "cost_analysis: EXPLAIN flops/bytes/peak-memory "
                          "of the flagship + sequence_within steps; "
                          "multichip: sharded-serving scaling efficiency "
-                         "at 1/2/4/8 shards with parity asserts")
+                         "at 1/2/4/8 shards with parity asserts; "
+                         "soak: sustained multi-tenant load with the "
+                         "time-series sampler + SLO verdicts "
+                         "(SOAK artifact)")
     ap.add_argument("--k", type=int, default=16,
                     help="fused stack depth (device_loop/fuse_compare)")
     ap.add_argument("--batch", type=int, default=1 << 11,
@@ -1015,7 +1217,20 @@ if __name__ == "__main__":
     ap.add_argument("--quick", action="store_true",
                     help="reduced scale (CI smoke; multichip)")
     ap.add_argument("--out", default=None, metavar="PATH",
-                    help="also write the result JSON to PATH (multichip)")
+                    help="also write the result JSON to PATH "
+                         "(multichip/soak; soak defaults to "
+                         "SOAK_r07.json)")
+    ap.add_argument("--seconds", type=int, default=60,
+                    help="soak: sustained-load duration")
+    ap.add_argument("--apps", type=int, default=2,
+                    help="soak: co-resident tenant apps")
+    ap.add_argument("--chaos", action="store_true",
+                    help="soak: kill each tenant's sink transport "
+                         "mid-run (retry must redeliver, zero loss)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="soak: sampler tick period (seconds)")
+    ap.add_argument("--p99-ms", type=float, default=500.0,
+                    help="soak: max-p99 SLO rule threshold (ms)")
     args = ap.parse_args()
     if args.mode == "device_loop":
         _enable_compile_cache()
@@ -1028,5 +1243,10 @@ if __name__ == "__main__":
     elif args.mode == "multichip":
         _enable_compile_cache()
         run_multichip(quick=args.quick, out_path=args.out)
+    elif args.mode == "soak":
+        _enable_compile_cache()
+        run_soak(seconds=args.seconds, apps=args.apps, chaos=args.chaos,
+                 out_path=args.out or "SOAK_r07.json",
+                 interval_s=args.interval, p99_ms=args.p99_ms)
     else:
         main()

@@ -114,13 +114,17 @@ class FuseBuffer:
     cross-batch order within the query is preserved exactly.
     """
 
-    __slots__ = ("qr", "k", "kind", "items", "sig", "bypass")
+    __slots__ = ("qr", "k", "kind", "items", "sig", "bypass", "ingests")
 
     def __init__(self, qr, k: int, kind: str):
         self.qr = qr
         self.k = max(1, int(k))
         self.kind = kind
         self.items: List[Tuple] = []
+        # per-item ingest stamps (junction send-acceptance perf_counter_ns,
+        # or None at OFF): a batch's `<query>:e2e` sample must include the
+        # time it sat in this stack waiting for the dispatch
+        self.ingests: List = []
         self.sig = None
         self.bypass = False
 
@@ -130,11 +134,15 @@ class FuseBuffer:
         attached debugger that expects per-batch breakpoints)."""
         if self.bypass or self.qr.app.__dict__.get("_debugger") is not None:
             return False
+        # captured before a signature-change drain(), which resets the
+        # runtime's stash while re-processing the OLD stack
+        t_in = self.qr.__dict__.get("_ingest_ns")
         sig = (tag, staged.ts.shape[0])
         if self.items and sig != self.sig:
             self.drain()
         self.sig = sig
         self.items.append(args)
+        self.ingests.append(t_in)
         if len(self.items) >= self.k:
             self.dispatch()
         return True
@@ -147,16 +155,28 @@ class FuseBuffer:
         if not self.items:
             return
         items, self.items = self.items, []
+        ingests, self.ingests = self.ingests, []
+        qr = self.qr
         self.bypass = True
         try:
-            for args in items:
-                self.qr.process_staged(*args)
+            for args, t_in in zip(items, ingests):
+                qr.__dict__["_ingest_ns"] = t_in
+                qr.process_staged(*args)
+                # consume the inline-delivery flag HERE (a drain may run
+                # from flush()/quiesce with no junction dispatch around
+                # it to close e2e) — stack wait is inside the sample
+                if qr.__dict__.pop("_e2e_owed", False) and \
+                        t_in is not None and qr.app.stats.enabled:
+                    qr.app.stats.e2e_latency(
+                        qr.name, time.perf_counter_ns() - t_in)
         finally:
             self.bypass = False
+            qr.__dict__["_ingest_ns"] = None
 
     def dispatch(self) -> None:
         """Run the full stack as ONE fused device dispatch."""
         items, self.items = self.items, []
+        self.qr.__dict__["_fused_ingests"], self.ingests = self.ingests, []
         qr = self.qr
         stats = qr.app.stats
         k = len(items)
@@ -378,13 +398,20 @@ def _deliver_fused(qr, outs, nows: List[int]) -> None:
     callback error) defers until every batch has been delivered, then
     the first error propagates to the junction's fault routing."""
     from . import runtime as _rt
+    ingests = qr.__dict__.pop("_fused_ingests", None)
     if not _rt._has_consumers(qr):
         return
     K = len(nows)
+    if ingests is None or len(ingests) != K:
+        ingests = [None] * K
     if getattr(qr, "async_emit", False) and qr.app._drainer is not None \
             or getattr(qr, "pipeline_emit", 0):
         for i in range(K):
+            # per-batch stamp restored so _emit_output's deferred queues
+            # (drainer / @pipeline deque) carry the right e2e origin
+            qr.__dict__["_ingest_ns"] = ingests[i]
             _rt._emit_output(qr, _slice_out(outs, i), nows[i], wake=None)
+        qr.__dict__["_ingest_ns"] = None
         return
     first_exc = None
     if len(outs) == 6:
@@ -413,7 +440,8 @@ def _deliver_fused(qr, outs, nows: List[int]) -> None:
                      tuple(c[i] for c in bulk[3]))
             try:
                 _rt._emit_output_sync(qr, out_i, nows[i],
-                                      header=(h0[i], h1[i]))
+                                      header=(h0[i], h1[i]),
+                                      ingest_ns=ingests[i])
             except Exception as exc:  # noqa: BLE001 — deliver the rest
                 first_exc = first_exc or exc
     else:
@@ -424,7 +452,8 @@ def _deliver_fused(qr, outs, nows: List[int]) -> None:
             out_i = (ots[i], okind[i], ovalid[i],
                      tuple(c[i] for c in ocols))
             try:
-                _rt._emit_output_sync(qr, out_i, nows[i])
+                _rt._emit_output_sync(qr, out_i, nows[i],
+                                      ingest_ns=ingests[i])
             except Exception as exc:  # noqa: BLE001 — deliver the rest
                 first_exc = first_exc or exc
     if first_exc is not None:

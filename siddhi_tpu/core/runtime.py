@@ -726,8 +726,13 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
         if wake is not None:
             qr._apply_wake(int(wake))
         return
+    # ingest stamp (perf_counter_ns at send acceptance, stashed by the
+    # junction under the query lock): rides every deferred-delivery queue
+    # so the `<query>:e2e` histogram includes queue wait — None when
+    # statistics are OFF or the batch arrived outside a junction dispatch
+    ingest_ns = qr.__dict__.get("_ingest_ns")
     if getattr(qr, "async_emit", False) and qr.app._drainer is not None:
-        qr.app._drainer.enqueue(qr, out, now, wake)
+        qr.app._drainer.enqueue(qr, out, now, wake, ingest_ns)
         return
     depth = int(getattr(qr, "pipeline_emit", 0) or 0)
     if depth and wake is None and \
@@ -739,7 +744,7 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
         dq = getattr(qr, "_pending_emit", None)
         if dq is None:
             dq = qr._pending_emit = collections.deque()
-        dq.append((out, now, None))
+        dq.append((out, now, None, ingest_ns))
         if len(dq) > depth:
             if depth == 1:
                 # exactly-one-deep contract: each send delivers its
@@ -752,10 +757,15 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
                 take = len(dq) - depth // 2
                 _deliver_many(qr, [dq.popleft() for _ in range(take)])
         return
+    if ingest_ns is not None:
+        # inline delivery: flag the dispatcher to close e2e AFTER
+        # process_staged fully returns, so per batch e2e >= the step
+        # latency sample by construction (same end point, earlier start)
+        qr.__dict__["_e2e_owed"] = True
     _deliver_output(qr, out, now, wake)
 
 
-def _deliver_output(qr, out, now: int, wake) -> None:
+def _deliver_output(qr, out, now: int, wake, ingest_ns=None) -> None:
     """Blocking device->host fetch + delivery of one emission."""
     if len(out) == 6:
         header, wake_h = jax.device_get(((out[0], out[1]), wake))
@@ -764,7 +774,7 @@ def _deliver_output(qr, out, now: int, wake) -> None:
         header = None
     if wake_h is not None:
         qr._apply_wake(int(wake_h))
-    _emit_output_sync(qr, out, now, header=header)
+    _emit_output_sync(qr, out, now, header=header, ingest_ns=ingest_ns)
 
 
 def _deliver_many(qr, items) -> None:
@@ -775,12 +785,12 @@ def _deliver_many(qr, items) -> None:
         return
     fetched = jax.device_get([
         (out[0], out[1]) if len(out) == 6 else out
-        for out, _, _ in items])
-    for (out, now, _), fetch_h in zip(items, fetched):
+        for out, _, _, _ in items])
+    for (out, now, _, t_in), fetch_h in zip(items, fetched):
         if len(out) == 6:
-            _emit_output_sync(qr, out, now, header=fetch_h)
+            _emit_output_sync(qr, out, now, header=fetch_h, ingest_ns=t_in)
         else:
-            _emit_output_sync(qr, fetch_h, now)
+            _emit_output_sync(qr, fetch_h, now, ingest_ns=t_in)
 
 
 def _drain_pending_emit(qr) -> None:
@@ -884,15 +894,42 @@ class _LazyBatchPayload(dict):
         return len(self._LAZY) + len(self._COUNTS) + extra
 
 
-def _emit_output_sync(qr, out, now: int, header=None) -> None:
+def _emit_output_sync(qr, out, now: int, header=None,
+                      ingest_ns=None) -> None:
     """Emission with an `emit` span when a DETAIL pipeline trace is active
     on this thread (sync/pipeline deliveries; drainer-thread deliveries
     fall outside the dispatch trace by design — see observability/
-    tracing.py)."""
-    if _tracing.active() is None:
-        return _emit_output_sync_impl(qr, out, now, header)
-    with _tracing.span("emit", query=qr.name):
-        return _emit_output_sync_impl(qr, out, now, header)
+    tracing.py).  `ingest_ns` (send-acceptance perf_counter_ns) closes the
+    `<query>:e2e` histogram here — after callbacks, downstream routing,
+    and the synchronous sink publish they trigger."""
+    try:
+        if _tracing.active() is None:
+            return _emit_output_sync_impl(qr, out, now, header)
+        with _tracing.span("emit", query=qr.name):
+            return _emit_output_sync_impl(qr, out, now, header)
+    finally:
+        if ingest_ns is not None:
+            st = qr.app.stats
+            if st.enabled:
+                st.e2e_latency(qr.name,
+                               time.perf_counter_ns() - ingest_ns)
+
+
+def _row_nbytes(qr) -> int:
+    """Wire bytes of ONE output row from schema metadata (ts int64 +
+    kind int32 + payload column itemsizes), cached per runtime — feeds
+    the `<q>.emitted_bytes` tenant-accounting counter without touching
+    any buffer."""
+    nb = qr.__dict__.get("_out_row_nbytes")
+    if nb is None:
+        nb = 12
+        try:
+            for t in qr.planned.out_schema.types:
+                nb += int(np.dtype(ev.np_dtype(t)).itemsize)
+        except Exception:  # noqa: BLE001 — metrics must not throw
+            pass
+        qr.__dict__["_out_row_nbytes"] = nb
+    return nb
 
 
 def _emit_output_sync_impl(qr, out, now: int, header=None) -> None:
@@ -978,11 +1015,19 @@ def _emit_output_sync_impl(qr, out, now: int, header=None) -> None:
         if len(out) == 6:
             if nv == 0:
                 return
+            rows_out = nv
         else:
             ots, okind, ovalid, ocols = out
             ovalid_np = np.asarray(ovalid)
             if not ovalid_np.any():
                 return
+            rows_out = int(ovalid_np.sum())
+        _st = qr.app.stats
+        if _st.enabled and rows_out:
+            # per-tenant events_out/emitted_bytes accounting: row count is
+            # already host-side (header / staged valid plane) and the byte
+            # figure is schema metadata × rows — no extra fetch
+            _st.emitted(qr.name, rows_out, rows_out * _row_nbytes(qr))
         if getattr(p, "emits_uuid", False):
             # UUID() sentinels materialize ONCE here, at the device->host
             # emission boundary, so every consumer of this emission (event
@@ -1445,24 +1490,29 @@ class StreamJunction:
 
     def enqueue(self, tag: str, payload, now: int) -> None:
         q = self._async_q
+        # ingest stamp taken BEFORE the queue put: the `<query>:e2e`
+        # histogram must include @async queue wait, not start at dispatch
+        stats = self.app.stats if self.app is not None else None
+        t_in = time.perf_counter_ns() \
+            if stats is not None and stats.enabled else None
         if q is None:          # raced with stop_async: process inline
             if tag == "staged":
-                self.dispatch_staged(payload, now)
+                self.dispatch_staged(payload, now, ingest_ns=t_in)
             else:
-                self.publish(payload, now)
+                self.publish(payload, now, ingest_ns=t_in)
             return
-        q.put((tag, payload, now))
+        q.put((tag, payload, now, t_in))
 
     def _drain_async(self) -> None:
         while True:
-            tag, payload, now = self._async_q.get()
+            tag, payload, now, t_in = self._async_q.get()
             try:
                 if tag == "stop":
                     return
                 if tag == "staged":
-                    self.dispatch_staged(payload, now)
+                    self.dispatch_staged(payload, now, ingest_ns=t_in)
                 else:
-                    self.publish(payload, now)
+                    self.publish(payload, now, ingest_ns=t_in)
             except Exception:  # noqa: BLE001 — worker must survive
                 import traceback
                 traceback.print_exc()
@@ -1477,6 +1527,17 @@ class StreamJunction:
         return self._async_q.unfinished_tasks if self._async_q is not None \
             else 0
 
+    def queue_depth(self) -> int:
+        """Batches sitting in the @async ingress queue RIGHT NOW (0 for
+        synchronous junctions).  Distinct from pending_async(): qsize
+        excludes the batch a worker is currently processing, so this is
+        the pure queue-wait backlog the sampler/healthz watch."""
+        q = self._async_q
+        try:
+            return q.qsize() if q is not None else 0
+        except Exception:  # noqa: BLE001 — metrics must not throw
+            return 0
+
     def stop_async(self) -> None:
         """Drain remaining batches, then terminate the workers (clean
         shutdown keeps at-least-once delivery for accepted sends)."""
@@ -1484,7 +1545,7 @@ class StreamJunction:
             return
         self._async_q.join()
         for _ in self._async_workers:
-            self._async_q.put(("stop", None, 0))
+            self._async_q.put(("stop", None, 0, None))
         for t in self._async_workers:
             t.join(timeout=2.0)
         self._async_workers.clear()
@@ -1497,9 +1558,14 @@ class StreamJunction:
         self.stream_callbacks.append(cb)
 
     def _dispatch_one(self, q, staged: ev.StagedBatch, now: int,
-                      stats, n: int, traced: bool) -> None:
+                      stats, n: int, traced: bool,
+                      ingest_ns=None) -> None:
         """One subscriber's processing, with per-query latency histogram
-        and (at DETAIL with an active trace) a per-query span."""
+        and (at DETAIL with an active trace) a per-query span.
+        `ingest_ns` (send-acceptance stamp) is stashed on the runtime
+        UNDER the query lock so the emission path — however deferred
+        (@pipeline deque, @fuse stack, @async drainer) — can close the
+        `<query>:e2e` histogram against the right batch."""
         lk = _sub_lock(q)
         if stats is None:
             if lk is not None:
@@ -1515,13 +1581,31 @@ class StreamJunction:
                   else _NULL_CM):
                 if lk is not None:
                     with _query_lock(lk, self.stream_id):
-                        q.process_staged(staged, now)
+                        q.__dict__["_ingest_ns"] = ingest_ns
+                        try:
+                            q.process_staged(staged, now)
+                        finally:
+                            # cleared so a later timer-driven emission
+                            # can't close e2e against this batch's stamp
+                            q.__dict__["_ingest_ns"] = None
                 else:
-                    q.process_staged(staged, now)
+                    q.__dict__["_ingest_ns"] = ingest_ns
+                    try:
+                        q.process_staged(staged, now)
+                    finally:
+                        q.__dict__["_ingest_ns"] = None
         finally:
             stats.query_latency(qname, n, time.perf_counter_ns() - t0)
+            if ingest_ns is not None and \
+                    q.__dict__.pop("_e2e_owed", False):
+                # emission delivered inline during this dispatch: close
+                # `<query>:e2e` here, after the step AND delivery — the
+                # stamp predates t0, so e2e >= the step-latency sample
+                stats.e2e_latency(qname,
+                                  time.perf_counter_ns() - ingest_ns)
 
-    def dispatch_staged(self, staged: ev.StagedBatch, now: int) -> None:
+    def dispatch_staged(self, staged: ev.StagedBatch, now: int,
+                        ingest_ns=None) -> None:
         """Run every subscribed query over a staged batch, serialized per
         QUERY (not per app) so queries on different streams — or workers of
         different streams — process concurrently."""
@@ -1533,6 +1617,8 @@ class StreamJunction:
                 except Exception as exc:  # noqa: BLE001 — fault routing
                     self._handle_error_staged(staged, exc, now)
             return
+        if ingest_ns is None:
+            ingest_ns = time.perf_counter_ns()   # synchronous send path
         stats.stream_in(self.stream_id, staged.n)
         tr = stats.tracer.start(self.stream_id, staged.n) \
             if stats.detail else None
@@ -1546,7 +1632,7 @@ class StreamJunction:
             for q in self.queries:
                 try:
                     self._dispatch_one(q, staged, now, stats, staged.n,
-                                       tr is not None)
+                                       tr is not None, ingest_ns)
                 except Exception as exc:  # noqa: BLE001 — fault routing
                     self._handle_error_staged(staged, exc, now)
         finally:
@@ -1555,7 +1641,8 @@ class StreamJunction:
             if tr is not None:
                 stats.tracer.finish(tr)
 
-    def publish(self, events: List[ev.Event], now: int) -> None:
+    def publish(self, events: List[ev.Event], now: int,
+                ingest_ns=None) -> None:
         stats = self.app.stats if self.app is not None else None
         if stats is None or not stats.enabled:
             for cb in self.stream_callbacks:
@@ -1568,6 +1655,8 @@ class StreamJunction:
                     except Exception as exc:  # noqa: BLE001 — fault route
                         self._handle_error(events, exc, now)
             return
+        if ingest_ns is None:
+            ingest_ns = time.perf_counter_ns()   # synchronous send path
         stats.stream_in(self.stream_id, len(events))
         tr = stats.tracer.start(self.stream_id, len(events)) \
             if stats.detail else None
@@ -1587,7 +1676,8 @@ class StreamJunction:
                 for q in self.queries:
                     try:
                         self._dispatch_one(q, staged, now, stats,
-                                           len(events), tr is not None)
+                                           len(events), tr is not None,
+                                           ingest_ns)
                     except Exception as exc:  # noqa: BLE001 — fault route
                         self._handle_error(events, exc, now)
         finally:
@@ -1877,7 +1967,7 @@ class _EmissionDrainer:
             self._started = True
             self._thread.start()
 
-    def enqueue(self, qr, out, now, wake=None):
+    def enqueue(self, qr, out, now, wake=None, ingest_ns=None):
         self.start()
         # start the D2H copy of everything the drainer will fetch NOW
         # (non-blocking): by the time the drainer's device_get runs, the
@@ -1891,7 +1981,7 @@ class _EmissionDrainer:
                     fn()
                 except Exception:  # noqa: BLE001 — best-effort prefetch
                     pass
-        self._q.put((qr, out, now, wake))
+        self._q.put((qr, out, now, wake, ingest_ns))
 
     def flush(self):
         self._q.join()
@@ -1900,6 +1990,14 @@ class _EmissionDrainer:
         """Outputs accepted but not yet delivered (public accessor for the
         buffered-emissions metric; safe on a never-started drainer)."""
         return self._q.unfinished_tasks
+
+    def depth(self) -> int:
+        """Outputs sitting in the drainer queue right now (qsize; excludes
+        the item being delivered) — the siddhi_drainer_queue_depth gauge."""
+        try:
+            return self._q.qsize()
+        except Exception:  # noqa: BLE001 — metrics must not throw
+            return 0
 
     def stop(self):
         if self._started:
@@ -1922,20 +2020,22 @@ class _EmissionDrainer:
                 fetched = jax.device_get([
                     ((out[0], out[1]), wake) if len(out) == 6
                     else (out, wake)
-                    for _, out, _, wake in items])
+                    for _, out, _, wake, _ in items])
             except Exception:  # noqa: BLE001 — drainer must survive
                 traceback.print_exc()
                 fetched = [(None, None)] * len(items)
-            for (qr, out, now, _), (fetch_h, wake_h) in zip(items, fetched):
+            for (qr, out, now, _, t_in), (fetch_h, wake_h) in \
+                    zip(items, fetched):
                 try:
                     if wake_h is not None:
                         qr._apply_wake(int(wake_h))
                     if fetch_h is None:
                         continue
                     if len(out) == 6:
-                        _emit_output_sync(qr, out, now, header=fetch_h)
+                        _emit_output_sync(qr, out, now, header=fetch_h,
+                                          ingest_ns=t_in)
                     else:
-                        _emit_output_sync(qr, fetch_h, now)
+                        _emit_output_sync(qr, fetch_h, now, ingest_ns=t_in)
                 except Exception as exc:  # noqa: BLE001 — drainer survives
                     # route to the app error path (reference: the Disruptor
                     # ExceptionHandler) — MatchOverflowError and callback
@@ -3196,6 +3296,51 @@ class SiddhiAppRuntime:
                 out[sid] = n
         return out
 
+    def queue_depths(self) -> Dict[str, int]:
+        """Current @async ingress queue depth per stream (only streams
+        running an async queue; zero-depth queues ARE reported so the
+        gauge exists before the first backlog).  Host-side qsize reads —
+        safe mid-shutdown."""
+        out: Dict[str, int] = {}
+        for sid, j in list(self.junctions.items()):
+            try:
+                if j._async_q is not None:
+                    out[sid] = j.queue_depth()
+            except Exception:  # noqa: BLE001 — metrics must not throw
+                pass
+        return out
+
+    def drainer_depth(self) -> int:
+        """Device outputs sitting in the async emission drainer queue
+        (siddhi_drainer_queue_depth; 0 on a stopped app)."""
+        d = getattr(self, "_drainer", None)
+        if d is None:
+            return 0
+        try:
+            return d.depth()
+        except Exception:  # noqa: BLE001 — metrics must not throw
+            return 0
+
+    def timeseries(self) -> Dict:
+        """Windowed time-series report for this app: every sampled series
+        (ring-buffer {t, v} arrays), the per-tenant account, and the SLO
+        state — filled by the manager's TimeSeriesSampler
+        (observability/timeseries.py; `enabled` is False until it has
+        ticked).  Served as `GET /siddhi-apps/<name>/timeseries`."""
+        store = self.__dict__.get("_timeseries")
+        out: Dict = {
+            "app": self.name,
+            "enabled": store is not None,
+            "series": store.to_dict() if store is not None else {},
+        }
+        acct = self.__dict__.get("_tenant_account")
+        if acct is not None:
+            out["tenant"] = acct
+        slo = self.__dict__.get("_slo_state")
+        if slo is not None:
+            out["slo"] = slo
+        return out
+
     def trace_dump(self, query: Optional[str] = None,
                    limit: int = 64) -> List[Dict]:
         """Recent DETAIL-level batch traces, newest first, optionally only
@@ -3553,6 +3698,9 @@ class SiddhiManager:
         self.config_manager = ConfigManager()
         self._persistor = AsyncSnapshotPersistor()
         self._has_base: set = set()
+        # time-series sampler (observability/timeseries.py): started on
+        # demand (REST service auto-starts one; bench --mode soak too)
+        self._sampler = None
 
     def set_persistence_store(self, store) -> None:
         """reference: SiddhiManager.setPersistenceStore (full or
@@ -3778,6 +3926,30 @@ class SiddhiManager:
                     f"no intact revision among {len(revs)} stored for "
                     f"app {name!r}")
 
+    def start_sampler(self, interval_s=None, window=None, rules=None,
+                      clock=None):
+        """Start (or return) the manager's in-process time-series sampler:
+        a daemon thread snapshotting every app's host-side metrics into
+        ring-buffer series each tick and evaluating the SLO rules over
+        them (observability/timeseries.py, observability/slo.py).
+        Interval/window default from config properties
+        `metrics.sampler.interval.seconds` / `metrics.sampler.window`.
+        Idempotent; pass `clock`+drive `tick()` yourself in tests."""
+        if self._sampler is None:
+            from ..observability.timeseries import TimeSeriesSampler
+            self._sampler = TimeSeriesSampler(
+                self, interval_s=interval_s, window=window, rules=rules,
+                clock=clock)
+            if clock is None:      # test-driven samplers tick manually
+                self._sampler.start()
+        return self._sampler
+
+    def stop_sampler(self) -> None:
+        s, self._sampler = self._sampler, None
+        if s is not None:
+            s.stop()
+
     def shutdown(self) -> None:
+        self.stop_sampler()
         for rt in self.runtimes.values():
             rt.shutdown()

@@ -32,7 +32,20 @@ and the v2 introspection layer (where the time and memory actually go):
   trace-event JSON (`GET /trace.json`) + guarded `jax.profiler`
   start/stop (`chrome_trace.py`),
 - **health probes**: readiness vs. liveness, per-stream last-event age
-  and backlog, sliding-window drop/recompile rates (`health.py`).
+  and backlog, sliding-window drop/recompile rates (`health.py`),
+
+and the soak-telemetry layer (metrics over TIME, not just at scrape):
+
+- **time-series sampler**: a daemon tick snapshots every host-side
+  counter/gauge/histogram-quantile into per-app ring-buffer series with
+  derived windowed rates, plus per-tenant accounting (events in/out,
+  emitted bytes, dispatch wall-time, recompile blame, state bytes) —
+  `timeseries.py`,
+- **SLO engine**: declarative rules (zero-drop, max-p99, breaker,
+  shard-imbalance, recompile-rate) evaluated over those series each
+  tick with ok/pending/firing hysteresis, surfaced as
+  `siddhi_slo_state` in `/metrics` and an `slo` section in `/healthz`
+  (`slo.py`).
 
 Everything is allocation-free on the hot path when statistics are OFF: each
 hook sits behind a single `enabled`/`active()` check, and every scrape/
@@ -48,6 +61,9 @@ from .memory import component_bytes, total_bytes          # noqa: F401
 from .chrome_trace import (chrome_trace, profiler_status,  # noqa: F401
                            start_profiler, stop_profiler)
 from .health import app_health, healthz, liveness, readiness  # noqa: F401
+from .timeseries import (Series, SeriesStore,                 # noqa: F401
+                         TimeSeriesSampler, tenant_account)
+from .slo import SLOEngine, SLORule, default_rules            # noqa: F401
 
 __all__ = [
     "LogHistogram", "PipelineTracer", "RECOMPILES", "RecompileRegistry",
@@ -55,4 +71,6 @@ __all__ = [
     "explain_app", "explain_query", "component_bytes", "total_bytes",
     "chrome_trace", "start_profiler", "stop_profiler", "profiler_status",
     "app_health", "healthz", "liveness", "readiness",
+    "Series", "SeriesStore", "TimeSeriesSampler", "tenant_account",
+    "SLOEngine", "SLORule", "default_rules",
 ]

@@ -107,6 +107,23 @@ def render_prometheus(runtimes: Dict) -> str:
                 "Device outputs queued in the async emission drainer")
     buf_i = fam("siddhi_buffered_ingress_events", "gauge",
                 "Batches pending in @async ingress queues, per stream")
+    q_dep = fam("siddhi_async_queue_depth", "gauge",
+                "Batches sitting in a stream's bounded @async ingress "
+                "queue right now (pure queue-wait backlog; excludes the "
+                "batch a worker is processing)")
+    d_dep = fam("siddhi_drainer_queue_depth", "gauge",
+                "Device outputs sitting in the async emission drainer "
+                "queue right now")
+    e_rows = fam("siddhi_emitted_rows_total", "counter",
+                 "Output rows delivered per query (callbacks, downstream "
+                 "routing, sinks) — per-tenant events_out accounting")
+    e_byt = fam("siddhi_emitted_bytes_total", "counter",
+                "Output bytes delivered per query (rows x schema row "
+                "width from dtype metadata, never fetched)")
+    slo_g = fam("siddhi_slo_state", "gauge",
+                "SLO rule state per app (0=ok 1=pending 2=firing), "
+                "evaluated over the in-process time series each sampler "
+                "tick (observability/slo.py)")
     fus_d = fam("siddhi_fused_dispatches_total", "counter",
                 "@fuse scan dispatches per query (one device step runs "
                 "K stacked batches)")
@@ -177,9 +194,29 @@ def render_prometheus(runtimes: Dict) -> str:
             elif name.endswith(".fused_batches"):
                 fus_b.sample(n, app=app_name,
                              query=name[:-len(".fused_batches")])
+            elif name.endswith(".emitted_rows"):
+                e_rows.sample(n, app=app_name,
+                              query=name[:-len(".emitted_rows")])
+            elif name.endswith(".emitted_bytes"):
+                e_byt.sample(n, app=app_name,
+                             query=name[:-len(".emitted_bytes")])
         buf_e.sample(rt.buffered_emissions(), app=app_name)
         for sid, n in sorted(rt.buffered_ingress().items()):
             buf_i.sample(n, app=app_name, stream=sid)
+        # bounded-queue depth gauges (queue qsize reads — host only)
+        if hasattr(rt, "queue_depths"):
+            for sid, n in sorted(rt.queue_depths().items()):
+                q_dep.sample(n, app=app_name, stream=sid)
+        if hasattr(rt, "drainer_depth"):
+            d_dep.sample(rt.drainer_depth(), app=app_name)
+        # SLO rule states, attached to the runtime by the sampler tick
+        slo = rt.__dict__.get("_slo_state") \
+            if hasattr(rt, "__dict__") else None
+        if slo:
+            from .slo import STATE_GAUGE
+            for rname, r in sorted(slo.get("rules", {}).items()):
+                slo_g.sample(STATE_GAUGE.get(r.get("state"), 0),
+                             app=app_name, rule=rname)
         # state-memory accounting rides the scrape under the same
         # invariant: memory.component_bytes walks shape/dtype metadata
         # only (observability/memory.py), so this adds zero device work

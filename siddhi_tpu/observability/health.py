@@ -10,7 +10,10 @@ backlog depth AND the last-event age, and classifies each stream from
 the pair.  Rates (drops, emission-cap growths, XLA recompiles) are
 reported over a sliding window sampled at probe time from the cumulative
 counters — a counter that jumped an hour ago must not keep a deployment
-red forever.
+red forever.  The window is the `health.window.seconds` manager config
+property (default 60).  When the time-series sampler is running
+(observability/timeseries.py), each app also reports its `slo` section
+and a FIRING rule flips the `degraded` verdict.
 
 Verdicts are distinct by design:
 
@@ -32,6 +35,27 @@ from collections import deque
 from typing import Dict, Optional, Tuple
 
 _WINDOW_S = 60.0
+
+
+def _window_s(rt) -> float:
+    """Sliding-rate window in seconds: the `health.window.seconds` config
+    property of the owning manager (default 60).  Memoized per runtime —
+    probes run every few seconds and the property cannot change under a
+    live manager."""
+    w = rt.__dict__.get("_health_window_s")
+    if w is not None:
+        return w
+    w = _WINDOW_S
+    try:
+        cm = getattr(getattr(rt, "manager", None), "config_manager", None)
+        v = cm.extract_property("health.window.seconds") \
+            if cm is not None else None
+        if v:
+            w = float(v)
+    except Exception:  # noqa: BLE001 — probe must not throw
+        w = _WINDOW_S
+    rt.__dict__["_health_window_s"] = w
+    return w
 
 
 class SlidingRate:
@@ -66,7 +90,7 @@ def _rate(rt, key: str, value: float) -> float:
     rates = _rates_of(rt)
     r = rates.get(key)
     if r is None:
-        r = rates[key] = SlidingRate()
+        r = rates[key] = SlidingRate(_window_s(rt))
     return r.observe(value)
 
 
@@ -106,8 +130,10 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
 
     st = rt.stats
     snap = st.exposition_snapshot()
+    window_s = _window_s(rt)
     last_ms = snap.get("stream_last_ms", {})
     backlog = rt.buffered_ingress()
+    qdepth = rt.queue_depths() if hasattr(rt, "queue_depths") else {}
     streams: Dict[str, Dict] = {}
     for sid in sorted(rt.junctions):
         if sid.startswith("!"):
@@ -115,16 +141,17 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         seen = last_ms.get(sid)
         age_s = (now_ms - seen) / 1e3 if seen else None
         depth = int(backlog.get(sid, 0))
-        if depth > 0:
+        queued = int(qdepth.get(sid, 0))
+        if depth > 0 or queued > 0:
             status = "backlogged"          # source alive, engine behind
         elif seen is None:
             status = "no-events" if st.enabled else "unknown"
-        elif age_s is not None and age_s > _WINDOW_S:
+        elif age_s is not None and age_s > window_s:
             status = "idle"                # engine drained, source quiet
         else:
             status = "ok"
         streams[sid] = {"last_event_age_s": age_s, "backlog": depth,
-                        "status": status}
+                        "queue_depth": queued, "status": status}
 
     # sink connection states (io/resilience.py): a BROKEN circuit means
     # events are being shed at the edge — the app still processes, so
@@ -165,6 +192,15 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
     except Exception:  # noqa: BLE001 — probe must not throw
         shards = None
 
+    # SLO verdicts (observability/slo.py): evaluated by the time-series
+    # sampler each tick and attached to the runtime; a FIRING rule flips
+    # the same `degraded` verdict a BROKEN sink does — the app still
+    # processes, but an operator-promised objective is being missed
+    slo = rt.__dict__.get("_slo_state")
+    if slo is not None and any(r.get("state") == "firing"
+                               for r in slo.get("rules", {}).values()):
+        degraded = True
+
     report = {
         "started": started,
         "accepting_ingress": accepting,
@@ -175,8 +211,11 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         "sinks": sinks,
         "degraded": degraded,
         **({"shards": shards} if shards is not None else {}),
+        **({"slo": slo} if slo is not None else {}),
         "buffered_emissions": rt.buffered_emissions(),
-        "rates_window_s": _WINDOW_S,
+        "drainer_queue_depth": rt.drainer_depth()
+        if hasattr(rt, "drainer_depth") else 0,
+        "rates_window_s": window_s,
         "dropped_per_s": round(_rate(rt, "dropped", drops), 6),
         "cap_growths_per_s": round(_rate(rt, "cap_growths", growths), 6),
         "recompiles_per_s": round(_rate(rt, "recompiles", recompiles), 6),

@@ -46,7 +46,19 @@ class LogHistogram:
 
     # -- queries ---------------------------------------------------------------
     def quantile(self, q: float) -> float:
-        """Approximate q-quantile in nanoseconds (error <= one octave)."""
+        """Approximate q-quantile in nanoseconds (error <= one octave).
+
+        Bucket convention (the log2 UPPER-BOUND convention, shared with
+        `buckets_seconds`/`buckets_raw` exposition): bucket `i` holds
+        integer values with `bit_length() == i`, i.e. the half-open range
+        `[2^(i-1), 2^i)` for `i >= 1` and exactly `{0}` for `i == 0`.
+        The quantile interpolates linearly inside the winning bucket over
+        `[2^(i-1), 2^i]` — so a target landing EXACTLY on a bucket's
+        cumulative boundary reports that bucket's exclusive upper bound
+        `2^i`, the same `le` value Prometheus' `histogram_quantile` would
+        interpolate to from the exported buckets.  The result is clamped
+        to the observed max, which also makes a single-sample histogram
+        report the exact recorded value at every q."""
         if self.total == 0:
             return 0.0
         target = q * self.total
@@ -56,7 +68,7 @@ class LogHistogram:
                 continue
             if cum + c >= target:
                 lo = float(1 << (i - 1)) if i > 0 else 0.0
-                hi = float((1 << i) - 1) if i > 0 else 0.0
+                hi = float(1 << i) if i > 0 else 0.0
                 frac = (target - cum) / c
                 return min(lo + frac * (hi - lo), float(self.max_ns))
             cum += c

@@ -39,7 +39,20 @@ Endpoints (JSON in/out):
                                                traces or fetches)
   GET    /healthz                           -> liveness+readiness verdicts
                                                (200 live / 503 not); also
-                                               /healthz/live, /healthz/ready
+                                               /healthz/live, /healthz/ready;
+                                               per-app `slo` section when the
+                                               time-series sampler runs (a
+                                               FIRING rule flips `degraded`)
+  GET    /siddhi-apps/<name>/timeseries     -> windowed ring-buffer series
+                                               (events/s, drops, p99
+                                               trajectories, queue depths),
+                                               per-tenant accounting, and
+                                               SLO rule states from the
+                                               in-process sampler
+                                               (observability/timeseries.py;
+                                               auto-started with the service
+                                               unless config property
+                                               metrics.sampler.enabled=false)
   POST   /profiler/start  body={"log_dir"?} -> start a guarded jax.profiler
                                                session (409 if running)
   POST   /profiler/stop                     -> stop it (409 if not running)
@@ -147,6 +160,13 @@ class SiddhiRestService:
                             self._json(404, {"error": "no such app"})
                         else:
                             self._json(200, rt.analyze())
+                    elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "timeseries":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                        else:
+                            self._json(200, rt.timeseries())
                     elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                             and parts[2] == "error-store":
                         rt = svc.manager.runtimes.get(parts[1])
@@ -297,6 +317,16 @@ class SiddhiRestService:
         self._server = ThreadingHTTPServer((host, port), _Handler)
         self.port = self._server.server_address[1]
         self._thread: Optional[threading.Thread] = None
+        # a served manager gets the time-series sampler by default: the
+        # /timeseries, /healthz slo, and siddhi_slo_state surfaces are
+        # empty without its tick (opt out: metrics.sampler.enabled=false)
+        try:
+            enabled = str(self.manager.config_manager.extract_property(
+                "metrics.sampler.enabled") or "true").lower() != "false"
+        except Exception:  # noqa: BLE001 — config must not break boot
+            enabled = True
+        if enabled:
+            self.manager.start_sampler()
 
     def start(self) -> "SiddhiRestService":
         self._thread = threading.Thread(

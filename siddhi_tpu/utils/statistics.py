@@ -77,6 +77,26 @@ class StatisticsManager:
         with self._lock:
             self._query_events[name] = self._query_events.get(name, 0) + n
 
+    def e2e_latency(self, name: str, elapsed_ns: int) -> None:
+        """Ingest->emission wall-time of one batch, recorded under
+        `<query>:e2e`: the clock starts when the send is ACCEPTED (before
+        any @async ingress queue) and stops after delivery (callbacks,
+        downstream routing, sink publish), so queue wait, @fuse stack
+        residency, and @pipeline/@async deferred fetches are all inside —
+        per batch, e2e >= the per-hop step latency by construction."""
+        hist_of(self._query_hist, name + ":e2e", self._lock) \
+            .record(elapsed_ns)
+
+    def emitted(self, name: str, rows: int, nbytes: int) -> None:
+        """Output rows (and their schema-derived payload bytes) a query
+        delivered — the per-tenant `events_out`/`emitted_bytes`
+        accounting substrate (observability/timeseries.py)."""
+        with self._lock:
+            self._counters[f"{name}.emitted_rows"] = \
+                self._counters.get(f"{name}.emitted_rows", 0) + rows
+            self._counters[f"{name}.emitted_bytes"] = \
+                self._counters.get(f"{name}.emitted_bytes", 0) + nbytes
+
     def junction_latency(self, stream_id: str, elapsed_ns: int) -> None:
         hist_of(self._junction_hist, stream_id, self._lock) \
             .record(elapsed_ns)
@@ -182,21 +202,32 @@ class StatisticsManager:
                     if self._included(f"streams.{sid}")},
                 "queries": {},
             }
+            def _quantiles(q, h):
+                # total/avg keys kept from the scalar era; the
+                # quantiles are the ones that matter on TPU
+                q["total_ms"] = h.sum_ns / 1e6
+                q["avg_latency_us"] = h.mean_ns / 1e3
+                q["p50_us"] = h.quantile(0.50) / 1e3
+                q["p95_us"] = h.quantile(0.95) / 1e3
+                q["p99_us"] = h.quantile(0.99) / 1e3
+                q["max_latency_ms"] = h.max_ns / 1e6
+                return q
+
             for name, n in self._query_events.items():
                 if not self._included(f"queries.{name}"):
                     continue
                 h = self._query_hist.get(name)
                 q = {"events": n}
                 if h is not None:
-                    # total/avg keys kept from the scalar era; the
-                    # quantiles are the ones that matter on TPU
-                    q["total_ms"] = h.sum_ns / 1e6
-                    q["avg_latency_us"] = h.mean_ns / 1e3
-                    q["p50_us"] = h.quantile(0.50) / 1e3
-                    q["p95_us"] = h.quantile(0.95) / 1e3
-                    q["p99_us"] = h.quantile(0.99) / 1e3
-                    q["max_latency_ms"] = h.max_ns / 1e6
+                    _quantiles(q, h)
                 out["queries"][name] = q
+            for name, h in self._query_hist.items():
+                # histogram-only entries (`<q>:e2e` has no event counter
+                # of its own): report the sample count as `events`
+                if name in out["queries"] or \
+                        not self._included(f"queries.{name}"):
+                    continue
+                out["queries"][name] = _quantiles({"events": h.total}, h)
             if self._junction_hist:
                 out["junctions"] = {
                     sid: h.snapshot()

@@ -1,0 +1,215 @@
+"""Prometheus text-format (0.0.4) lint over the ENTIRE /metrics
+exposition: HELP/TYPE pairing, sample-name/family agreement, label
+syntax + escaping, value parseability, histogram bucket monotonicity,
+and counter monotonicity across two scrapes — so a new metric family
+can't silently break scrapers (satellite of the soak-telemetry PR)."""
+import re
+
+import pytest
+
+import siddhi_tpu.utils.chaos  # noqa: F401 — registers type='chaos'
+from siddhi_tpu.observability import render_prometheus
+
+_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# one label pair: key="value" with \\, \" and \n as the ONLY escapes
+_PAIR = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\[\\"n])*)"')
+
+
+def _parse(text):
+    """Parse an exposition payload into (families, samples) and assert
+    the structural rules along the way.  families: name -> kind;
+    samples: list of (family, sample_name, labels-frozenset, value)."""
+    families = {}
+    helps = set()
+    samples = []
+    announced = None
+    for lineno, line in enumerate(text.splitlines(), 1):
+        assert line == line.rstrip(), f"L{lineno}: trailing whitespace"
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name = rest.split(" ", 1)[0]
+            assert _NAME.match(name), f"L{lineno}: bad family {name!r}"
+            assert name not in helps, f"L{lineno}: duplicate HELP {name}"
+            helps.add(name)
+            announced = name
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            parts = rest.split(" ")
+            assert len(parts) == 2, f"L{lineno}: malformed TYPE"
+            name, kind = parts
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), f"L{lineno}: bad kind {kind!r}"
+            # HELP must directly precede TYPE for the same family
+            assert announced == name, \
+                f"L{lineno}: TYPE {name} without its HELP line"
+            assert name not in families, \
+                f"L{lineno}: duplicate TYPE {name}"
+            families[name] = kind
+            continue
+        assert not line.startswith("#"), f"L{lineno}: stray comment"
+        # sample line: name{labels} value
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)$", line)
+        assert m, f"L{lineno}: unparseable sample {line!r}"
+        sname, labelblob, value = m.groups()
+        float(value)                      # must parse (raises otherwise)
+        labels = {}
+        if labelblob:
+            inner = labelblob[1:-1]
+            consumed = _PAIR.sub("", inner)
+            assert set(consumed) <= {","}, \
+                f"L{lineno}: malformed/unescaped labels {labelblob!r}"
+            for k, v in _PAIR.findall(inner):
+                assert _LABEL.match(k)
+                assert k not in labels, f"L{lineno}: duplicate label {k}"
+                labels[k] = v
+        fam = next((f for f in (sname, sname.rsplit("_bucket", 1)[0],
+                                sname.rsplit("_sum", 1)[0],
+                                sname.rsplit("_count", 1)[0])
+                    if f in families), None)
+        assert fam is not None, \
+            f"L{lineno}: sample {sname} under no announced family"
+        if families[fam] == "histogram":
+            assert sname in (fam, f"{fam}_bucket", f"{fam}_sum",
+                             f"{fam}_count") and sname != fam, \
+                f"L{lineno}: bad histogram sample {sname}"
+        else:
+            assert sname == fam, \
+                f"L{lineno}: sample {sname} != family {fam}"
+        samples.append((fam, sname, labels, float(value)))
+    return families, samples
+
+
+def _series_key(sname, labels):
+    return (sname, frozenset(labels.items()))
+
+
+@pytest.fixture()
+def soaked_manager(manager):
+    """A manager with two busy apps covering every family dimension:
+    async ingest, fused stepping, chaos sink (breaker counters), drops,
+    SLO state, shard counters stay absent (unmeshed) by design."""
+    rt = manager.create_siddhi_app_runtime("""
+    @app:name('A')
+    @app:statistics('BASIC')
+    @async(buffer.size='16')
+    define stream S (v int);
+    @sink(type='chaos', id='lintA', on.error='retry',
+          retry.initial.ms='1', retry.jitter='0')
+    define stream Out (v int);
+    @info(name='q') from S[v > 0] select v insert into Out;
+    @info(name='f') from S#window.lengthBatch(4)
+    select count() as c insert into C;
+    """)
+    rt.start()
+    rt2 = manager.create_siddhi_app_runtime("""
+    @app:name('B')
+    @app:statistics('BASIC')
+    define stream S (v int);
+    @fuse(batches='2')
+    @info(name='q') from S[v > 0] select v insert into Out2;
+    """)
+    rt2.add_callback("q", lambda ts, cur, exp: None)
+    rt2.start()
+    clock = [0.0]
+    sampler = manager.start_sampler(clock=lambda: clock[0])
+    for i in range(6):
+        rt.get_input_handler("S").send([i + 1])
+        rt2.get_input_handler("S").send([i + 1])
+    rt.flush()
+    rt2.flush()
+    clock[0] += 1.0
+    sampler.tick()
+    return manager
+
+
+def test_full_exposition_lints(soaked_manager):
+    text = render_prometheus(soaked_manager.runtimes)
+    families, samples = _parse(text)
+    # the families this PR added must be present and typed correctly
+    assert families["siddhi_slo_state"] == "gauge"
+    assert families["siddhi_async_queue_depth"] == "gauge"
+    assert families["siddhi_drainer_queue_depth"] == "gauge"
+    assert families["siddhi_emitted_rows_total"] == "counter"
+    assert families["siddhi_emitted_bytes_total"] == "counter"
+    assert families["siddhi_query_latency_seconds"] == "histogram"
+    # every series key appears at most once per scrape
+    keys = [_series_key(s, lb) for _, s, lb, _ in samples]
+    assert len(keys) == len(set(keys)), "duplicate series in one scrape"
+
+
+def test_histogram_buckets_cumulative_and_closed(soaked_manager):
+    text = render_prometheus(soaked_manager.runtimes)
+    families, samples = _parse(text)
+    by_series = {}
+    for fam, sname, labels, value in samples:
+        if families[fam] != "histogram":
+            continue
+        base = dict(labels)
+        le = base.pop("le", None)
+        key = (fam, frozenset(base.items()))
+        by_series.setdefault(key, {"buckets": [], "sum": None,
+                                   "count": None})
+        ent = by_series[key]
+        if sname.endswith("_bucket"):
+            ent["buckets"].append((le, value))
+        elif sname.endswith("_sum"):
+            ent["sum"] = value
+        elif sname.endswith("_count"):
+            ent["count"] = value
+    assert by_series, "no histogram series rendered?"
+    for key, ent in by_series.items():
+        les = [le for le, _ in ent["buckets"]]
+        assert les[-1] == "+Inf", f"{key}: no +Inf bucket"
+        cums = [c for _, c in ent["buckets"]]
+        assert cums == sorted(cums), f"{key}: non-cumulative buckets"
+        finite = [float(le) for le in les[:-1]]
+        assert finite == sorted(finite), f"{key}: le not monotone"
+        assert ent["count"] == cums[-1], f"{key}: _count != +Inf bucket"
+        assert ent["sum"] is not None
+
+
+def test_counters_monotone_across_scrapes(soaked_manager):
+    m = soaked_manager
+    text1 = render_prometheus(m.runtimes)
+    fam1, s1 = _parse(text1)
+    # more traffic between the scrapes
+    for name, rt in m.runtimes.items():
+        for i in range(4):
+            rt.get_input_handler("S").send([i + 1])
+        rt.flush()
+    text2 = render_prometheus(m.runtimes)
+    fam2, s2 = _parse(text2)
+    v1 = {_series_key(s, lb): v for f, s, lb, v in s1
+          if fam1[f] == "counter"}
+    v2 = {_series_key(s, lb): v for f, s, lb, v in s2
+          if fam2[f] == "counter"}
+    assert v1, "no counters rendered?"
+    grew = 0
+    for key, old in v1.items():
+        assert key in v2, f"counter series {key} vanished"
+        assert v2[key] >= old, f"counter {key} went backwards"
+        grew += v2[key] > old
+    assert grew > 0, "traffic between scrapes moved no counter"
+
+
+def test_label_escaping_round_trips(manager):
+    """Quotes, backslashes, and newlines in metric label values must
+    escape per the text-format spec — proven through the real renderer
+    by recording a pathological query name."""
+    rt = manager.create_siddhi_app_runtime("""
+    @app:statistics('BASIC')
+    define stream S (v int);
+    @info(name='q') from S select v insert into Out;
+    """)
+    rt.start()
+    evil = 'we"ird\\name\nwith all three'
+    rt.stats.query_latency(evil, 1, 1000)
+    text = render_prometheus(manager.runtimes)
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    families, samples = _parse(text)      # the lint parser accepts it
+    vals = {lb.get("query") for _, _, lb, _ in samples if "query" in lb}
+    assert 'we\\"ird\\\\name\\nwith all three' in vals

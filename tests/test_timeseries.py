@@ -1,0 +1,536 @@
+"""Soak-telemetry layer: time-series sampler, per-tenant accounting,
+SLO engine, e2e latency, queue-depth gauges — all FakeClock/tick-driven,
+no sleeps (observability/timeseries.py, observability/slo.py)."""
+import json
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from siddhi_tpu.observability.slo import (FIRING, OK, PENDING, SLOEngine,
+                                          SLORule, default_rules)
+from siddhi_tpu.observability.timeseries import (Series, SeriesStore,
+                                                 TimeSeriesSampler,
+                                                 tenant_account)
+
+BASIC_QL = """
+@app:statistics('BASIC')
+define stream S (v int);
+@info(name='q') from S[v > 0] select v insert into Out;
+"""
+
+
+def _drive(rt, n=20):
+    h = rt.get_input_handler("S")
+    for i in range(n):
+        h.send([i + 1])
+    rt.flush()
+
+
+def _consume(rt, qname="q"):
+    got = []
+    rt.add_callback(qname, lambda ts, cur, exp: got.extend(cur or []))
+    return got
+
+
+# -- Series / SeriesStore -----------------------------------------------------
+
+def test_series_ring_is_bounded_and_windowed():
+    s = Series("x", window=5)
+    for i in range(12):
+        s.append(float(i), float(i * 10))
+    assert len(s) == 5
+    d = s.to_dict()
+    assert d["t"] == [7.0, 8.0, 9.0, 10.0, 11.0]
+    assert s.last == 110.0
+    assert s.delta() == 10.0
+
+
+def test_series_rate_is_windowed_slope():
+    s = Series("c", window=100)
+    for i in range(20):
+        s.append(float(i), float(i * 50))      # 50/s counter
+    assert s.rate() == pytest.approx(50.0)
+    assert s.rate(window_s=5.0) == pytest.approx(50.0)
+    # counter reset reads as quiet, never negative
+    s.append(20.0, 0.0)
+    assert s.rate() == 0.0
+
+
+def test_store_get_or_create_and_export():
+    st = SeriesStore(window=4)
+    st.record("a", 1.0, 2.0)
+    st.record("a", 2.0, 3.0)
+    st.record("b", 1.0, 0.0)
+    assert st.names() == ["a", "b"]
+    assert st.last("a") == 3.0 and st.last("missing") is None
+    assert st.to_dict()["a"]["v"] == [2.0, 3.0]
+
+
+# -- sampler ticks (clock-driven, no thread) ----------------------------------
+
+def test_sampler_tick_builds_series_and_rates(manager):
+    rt = manager.create_siddhi_app_runtime(BASIC_QL)
+    _consume(rt)
+    rt.start()
+    clock = [100.0]
+    s = TimeSeriesSampler(manager, interval_s=1.0, window=50,
+                          clock=lambda: clock[0])
+    for _ in range(5):
+        _drive(rt, 10)
+        clock[0] += 1.0
+        s.tick()
+    assert s.ticks == 5
+    ts = rt.timeseries()
+    assert ts["enabled"] is True
+    ser = ts["series"]
+    # 10 external sends + 10 rows routed into the auto-defined Out
+    # stream per round: events_in sums every stream junction
+    assert ser["events_in"]["v"] == [20.0, 40.0, 60.0, 80.0, 100.0]
+    # derived rate: 20 events per 1-second tick
+    assert ser["rate.events_in_per_s"]["v"][-1] == pytest.approx(20.0)
+    assert ser["query.q.p99_us"]["v"][-1] > 0
+    assert ser["dropped"]["v"][-1] == 0.0
+    # sampler ticks carry the SLO evaluation with them
+    assert ts["slo"]["verdict"] == OK
+
+
+def test_sampler_window_bounds_memory(manager):
+    rt = manager.create_siddhi_app_runtime(BASIC_QL)
+    rt.start()
+    s = TimeSeriesSampler(manager, interval_s=1.0, window=4,
+                          clock=lambda: 0.0)
+    for i in range(10):
+        s.tick(now=float(i))
+    ser = rt.timeseries()["series"]
+    assert all(len(v["t"]) <= 4 for v in ser.values())
+
+
+def test_sampler_interval_and_window_from_config(manager):
+    from siddhi_tpu.utils.config import InMemoryConfigManager
+    manager.set_config_manager(InMemoryConfigManager(system_configs={
+        "metrics.sampler.interval.seconds": "0.25",
+        "metrics.sampler.window": "7"}))
+    s = TimeSeriesSampler(manager, clock=lambda: 0.0)
+    assert s.interval_s == 0.25
+    assert s.window == 7
+
+
+def test_tenant_account_fields(manager):
+    rt = manager.create_siddhi_app_runtime(BASIC_QL)
+    _consume(rt)
+    rt.start()
+    _drive(rt, 20)
+    acct = tenant_account(rt)
+    assert acct["events_in"] == 40      # 20 external + 20 routed to Out
+    assert acct["events_out"] == 20          # filter passes all v>0
+    # ts(8) + kind(4) + one int32 payload col = 16 bytes/row
+    assert acct["emitted_bytes"] == 20 * 16
+    assert acct["dispatch_wall_ns"] > 0
+    assert acct["state_bytes"] >= 0
+    assert acct["dropped"] == 0
+    assert "q" in acct["recompile_blame"]
+
+
+def test_manager_start_sampler_idempotent_and_shutdown(manager):
+    s1 = manager.start_sampler(clock=lambda: 0.0)
+    s2 = manager.start_sampler()
+    assert s1 is s2
+    manager.stop_sampler()
+    assert manager._sampler is None
+
+
+# -- SLO engine ---------------------------------------------------------------
+
+def _engine_with_store(rules):
+    eng = SLOEngine(rules=rules)
+    store = SeriesStore(window=32)
+    return eng, store
+
+
+def test_zero_drop_rule_fires_and_recovers():
+    eng, store = _engine_with_store(
+        [SLORule("zero-drop", "zero_drop", for_ticks=1)])
+    store.record("dropped", 0.0, 0)
+    rep = eng.evaluate("a", None, store, 0.0)
+    assert rep["rules"]["zero-drop"]["state"] == OK
+    store.record("dropped", 1.0, 5)          # 5 drops this tick
+    rep = eng.evaluate("a", None, store, 1.0)
+    assert rep["rules"]["zero-drop"]["state"] == FIRING
+    assert rep["verdict"] == FIRING
+    store.record("dropped", 2.0, 5)          # no new drops
+    rep = eng.evaluate("a", None, store, 2.0)
+    assert rep["rules"]["zero-drop"]["state"] == OK
+    assert rep["verdict"] == OK
+
+
+def test_pending_to_firing_hysteresis():
+    eng, store = _engine_with_store(
+        [SLORule("p99", "max_p99", threshold=1.0, for_ticks=3)])
+    t = 0.0
+    states = []
+    for _ in range(4):
+        store.record("query.q.p99_us", t, 5000.0)   # 5ms > 1ms bound
+        states.append(
+            eng.evaluate("a", None, store, t)["rules"]["p99"]["state"])
+        t += 1.0
+    assert states == [PENDING, PENDING, FIRING, FIRING]
+
+
+def test_max_p99_skips_suffixed_series_unless_named():
+    eng, store = _engine_with_store(
+        [SLORule("p99", "max_p99", threshold=1.0, for_ticks=1)])
+    store.record("query.q:e2e.p99_us", 0.0, 9000.0)
+    rep = eng.evaluate("a", None, store, 0.0)
+    assert rep["rules"]["p99"]["state"] == OK       # :e2e not judged
+    eng2, _ = _engine_with_store(
+        [SLORule("p99e", "max_p99", threshold=1.0, query="q:e2e",
+                 for_ticks=1)])
+    rep = eng2.evaluate("a", None, store, 0.0)
+    assert rep["rules"]["p99e"]["state"] == FIRING  # unless named
+
+
+def test_breaker_and_queue_rules_read_gauges():
+    eng, store = _engine_with_store([
+        SLORule("breaker", "breaker", for_ticks=1),
+        SLORule("queue", "max_queue_depth", threshold=10, for_ticks=1)])
+    store.record("sink_broken", 0.0, 1)
+    store.record("async_queue_depth", 0.0, 8)
+    store.record("drainer_queue_depth", 0.0, 7)
+    rep = eng.evaluate("a", None, store, 0.0)
+    assert rep["rules"]["breaker"]["state"] == FIRING
+    assert rep["rules"]["queue"]["state"] == FIRING     # 15 > 10
+
+
+def test_default_rules_and_config_thresholds():
+    names = {r.name for r in default_rules()}
+    assert {"zero-drop", "breaker-not-broken", "recompile-rate",
+            "shard-imbalance"} <= names
+    from siddhi_tpu.utils.config import InMemoryConfigManager
+    cm = InMemoryConfigManager(system_configs={
+        "slo.max.p99.ms": "123", "slo.for.ticks": "5"})
+    rules = {r.name: r for r in default_rules(cm)}
+    assert rules["max-p99"].threshold == 123.0
+    assert rules["max-p99"].for_ticks == 5
+
+
+def test_firing_slo_flips_healthz_degraded(manager):
+    from siddhi_tpu.observability.health import healthz
+    rt = manager.create_siddhi_app_runtime(BASIC_QL)
+    _consume(rt)
+    rt.start()
+    rules = [SLORule("zero-drop", "zero_drop", for_ticks=1)]
+    clock = [0.0]
+    s = TimeSeriesSampler(manager, interval_s=1.0, rules=rules,
+                          clock=lambda: clock[0])
+    _drive(rt, 5)
+    s.tick()
+    code, payload = healthz(manager)
+    app = payload["apps"][rt.name]
+    assert app["slo"]["verdict"] == OK and not app["degraded"]
+    rt.stats.counter_inc("q.dropped", 3)     # injected silent drop
+    clock[0] += 1.0
+    s.tick()
+    code, payload = healthz(manager)
+    app = payload["apps"][rt.name]
+    assert app["slo"]["rules"]["zero-drop"]["state"] == FIRING
+    assert app["degraded"] is True
+    assert payload["status"] == "degraded"   # live but missing the SLO
+    assert code == 200
+
+
+def test_slo_state_gauge_in_metrics(manager):
+    from siddhi_tpu.observability import render_prometheus
+    rt = manager.create_siddhi_app_runtime(BASIC_QL)
+    rt.start()
+    s = TimeSeriesSampler(
+        manager, rules=[SLORule("zero-drop", "zero_drop", for_ticks=1)],
+        clock=lambda: 0.0)
+    s.tick()
+    text = render_prometheus(manager.runtimes)
+    assert 'siddhi_slo_state{app="SiddhiApp",rule="zero-drop"} 0' in text
+    rt.stats.counter_inc("q.dropped", 1)
+    s.tick(now=1.0)
+    text = render_prometheus(manager.runtimes)
+    assert 'siddhi_slo_state{app="SiddhiApp",rule="zero-drop"} 2' in text
+
+
+# -- REST surface -------------------------------------------------------------
+
+def test_timeseries_endpoint_and_sampler_autostart():
+    from siddhi_tpu.service import SiddhiRestService
+    svc = SiddhiRestService()
+    svc.start()
+    try:
+        assert svc.manager._sampler is not None   # auto-started
+        base = f"http://127.0.0.1:{svc.port}"
+        req = urllib.request.Request(
+            f"{base}/siddhi-apps", data=BASIC_QL.encode(), method="POST")
+        assert urllib.request.urlopen(req).status == 201
+        rt = svc.manager.runtimes["SiddhiApp"]
+        _consume(rt)
+        _drive(rt, 10)
+        svc.manager._sampler.tick()               # deterministic tick
+        body = urllib.request.urlopen(
+            f"{base}/siddhi-apps/SiddhiApp/timeseries").read()
+        rep = json.loads(body)
+        assert rep["enabled"] is True
+        assert rep["series"]["events_in"]["v"][-1] == 20.0
+        assert rep["tenant"]["events_in"] == 20
+        assert rep["slo"]["verdict"] in ("ok", "pending")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/siddhi-apps/nope/timeseries")
+        assert e.value.code == 404
+    finally:
+        svc.stop()
+
+
+def test_sampler_autostart_config_opt_out():
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.service import SiddhiRestService
+    from siddhi_tpu.utils.config import InMemoryConfigManager
+    m = SiddhiManager()
+    m.set_config_manager(InMemoryConfigManager(system_configs={
+        "metrics.sampler.enabled": "false"}))
+    svc = SiddhiRestService(m).start()
+    try:
+        assert m._sampler is None
+    finally:
+        svc.stop()
+
+
+# -- e2e latency satellite ----------------------------------------------------
+
+E2E_BASE = """
+@app:statistics('BASIC')
+{sann}
+define stream S (v int);
+{qann}
+@info(name='q') from S[v > 0] select v insert into Out;
+"""
+
+
+@pytest.mark.parametrize("sann,qann", [
+    ("", ""),                                  # sync
+    ("@async(buffer.size='16')", ""),          # @async ingest
+    ("", "@pipeline(depth='4')"),              # @pipeline deferred emit
+    ("", "@fuse(batches='4')"),                # @fuse stacked stepping
+], ids=["sync", "async", "pipeline", "fuse"])
+def test_e2e_histogram_dominates_step_latency(manager, sann, qann):
+    rt = manager.create_siddhi_app_runtime(
+        E2E_BASE.format(sann=sann, qann=qann))
+    got = _consume(rt)
+    rt.start()
+    _drive(rt, 24)
+    qh = rt.stats.exposition_snapshot()["query_hist"]
+    e2e = qh.get("q:e2e")
+    assert e2e is not None and e2e.total == 24   # one sample per batch
+    step_sum = qh["q"].sum_ns + \
+        (qh["q:fused"].sum_ns if "q:fused" in qh else 0)
+    # every e2e sample opens at send acceptance (before staging/queues)
+    # and closes after delivery, so the aggregate dominates the step sum
+    assert e2e.sum_ns >= step_sum
+    assert len(got) == 24                        # and nothing was lost
+
+
+def test_e2e_rides_report_and_metrics(manager):
+    from siddhi_tpu.observability import render_prometheus
+    rt = manager.create_siddhi_app_runtime(BASIC_QL)
+    _consume(rt)
+    rt.start()
+    _drive(rt, 8)
+    rep = rt.statistics()
+    assert rep["queries"]["q:e2e"]["p99_us"] > 0
+    text = render_prometheus(manager.runtimes)
+    assert 'siddhi_query_latency_seconds_count{app="SiddhiApp",' \
+           'query="q:e2e"} 8' in text
+
+
+def test_e2e_off_level_records_nothing(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @info(name='q') from S[v > 0] select v insert into Out;
+    """)
+    _consume(rt)
+    rt.start()
+    _drive(rt, 5)
+    assert rt.stats._query_hist == {}
+
+
+# -- queue-depth gauges satellite ---------------------------------------------
+
+def test_queue_depth_accessors_and_families(manager):
+    from siddhi_tpu.observability import render_prometheus
+    rt = manager.create_siddhi_app_runtime("""
+    @app:statistics('BASIC')
+    @async(buffer.size='32')
+    define stream S (v int);
+    @info(name='q') from S select v insert into Out;
+    """)
+    _consume(rt)
+    rt.start()
+    _drive(rt, 10)
+    # the async stream runs a queue -> gauge exists (drained, so 0)
+    assert rt.queue_depths() == {"S": 0}
+    assert rt.drainer_depth() == 0
+    text = render_prometheus(manager.runtimes)
+    assert 'siddhi_async_queue_depth{app="SiddhiApp",stream="S"} 0' in text
+    assert 'siddhi_drainer_queue_depth{app="SiddhiApp"} 0' in text
+    # /healthz reports the per-stream depth + the drainer depth
+    health = rt.health()
+    assert health["streams"]["S"]["queue_depth"] == 0
+    assert health["drainer_queue_depth"] == 0
+
+
+def test_queue_depth_nonzero_while_worker_blocked(manager):
+    import threading
+    rt = manager.create_siddhi_app_runtime("""
+    @app:statistics('BASIC')
+    @async(buffer.size='32')
+    define stream S (v int);
+    @info(name='q') from S select v insert into Out;
+    """)
+    gate = threading.Event()
+    entered = threading.Event()
+
+    def blocker(ts, cur, exp):
+        entered.set()
+        gate.wait(5.0)
+    rt.add_callback("q", blocker)
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([1])
+    assert entered.wait(5.0)
+    for i in range(4):            # pile up behind the blocked worker
+        h.send([i])
+    try:
+        assert rt.queue_depths()["S"] >= 1
+        assert rt.health()["streams"]["S"]["status"] == "backlogged"
+    finally:
+        gate.set()
+        rt.flush()
+
+
+def test_healthz_window_from_config(manager):
+    from siddhi_tpu.utils.config import InMemoryConfigManager
+    manager.set_config_manager(InMemoryConfigManager(system_configs={
+        "health.window.seconds": "7.5"}))
+    rt = manager.create_siddhi_app_runtime(BASIC_QL)
+    rt.start()
+    assert rt.health()["rates_window_s"] == 7.5
+    rates = rt.__dict__["_health_rates"]
+    assert all(r.window_s == 7.5 for r in rates.values())
+
+
+# -- histogram boundary convention satellite ----------------------------------
+
+def test_quantile_exact_bucket_boundary_convention():
+    from siddhi_tpu.observability import LogHistogram
+    # single sample: every quantile reports the exact recorded value
+    # (clamped to max), including exact powers of two on the boundary
+    for v in (1, 2, 1024, 1 << 20):
+        h = LogHistogram()
+        h.record(v)
+        for q in (0.0, 0.5, 0.99, 1.0):
+            assert h.quantile(q) == float(v), (v, q)
+    # two samples in adjacent octaves: a target landing EXACTLY on the
+    # first bucket's cumulative boundary reports that bucket's EXCLUSIVE
+    # upper bound 2^i — the same le the Prometheus exposition exports
+    h = LogHistogram()
+    h.record(4)        # bucket 3: [4, 8)
+    h.record(16)       # bucket 5: [16, 32)
+    assert h.quantile(0.5) == 8.0
+    les = [le for le, _ in h.buckets_seconds()]
+    assert 8.0 / 1e9 in les     # quantile and exposition agree on 2^i
+    # interpolation stays inside the octave and monotone
+    assert 4.0 <= h.quantile(0.25) <= 8.0
+    assert h.quantile(0.5) <= h.quantile(0.9) <= h.quantile(1.0) == 16.0
+
+
+# -- the never-fetch invariant over a full sampled soak -----------------------
+
+def test_sampled_soak_never_touches_the_device(manager, monkeypatch):
+    """The whole telemetry loop — sampler ticks, tenant accounting, SLO
+    evaluation, /metrics render, /healthz, /timeseries export — runs
+    across a LIVE soak with jax.device_get FORBIDDEN during every
+    telemetry operation: sampling is pure host-side, always.  (The data
+    path legitimately fetches to deliver emissions, so the guard arms
+    around each telemetry pass, every round of the soak.)"""
+    from siddhi_tpu.observability import render_prometheus
+    from siddhi_tpu.observability.health import healthz
+    rt = manager.create_siddhi_app_runtime("""
+    @app:statistics('BASIC')
+    @async(buffer.size='16')
+    define stream S (v int);
+    @info(name='q') from S[v > 0] select v insert into Out;
+    """)
+    _consume(rt)
+    rt.start()
+    h = rt.get_input_handler("S")
+    real_get = jax.device_get
+    armed = [False]
+
+    def guard(*a, **k):
+        if armed[0]:
+            raise AssertionError("device_get on the telemetry path")
+        return real_get(*a, **k)
+    monkeypatch.setattr(jax, "device_get", guard)
+    clock = [0.0]
+    s = TimeSeriesSampler(manager, interval_s=1.0, window=32,
+                          clock=lambda: clock[0])
+    for i in range(5):
+        for _ in range(3):
+            h.send([i + 1])
+        rt.flush()
+        clock[0] += 1.0
+        armed[0] = True
+        try:
+            s.tick()
+            text = render_prometheus(manager.runtimes)
+            code, payload = healthz(manager)
+            rep = rt.timeseries()
+        finally:
+            armed[0] = False
+    assert "siddhi_slo_state" in text
+    assert payload["apps"][rt.name]["slo"]["verdict"] == OK
+    # 15 external sends + 15 rows routed into Out
+    assert rep["series"]["events_in"]["v"][-1] == 30.0
+    assert rep["tenant"]["state_bytes"] >= 0
+
+
+def test_sampler_thread_lifecycle():
+    """The production thread path: start() spins the daemon, stop()
+    joins it.  Kept to one short-interval round so the suite stays
+    fast; all behavioral tests drive tick() directly."""
+    from siddhi_tpu import SiddhiManager
+    m = SiddhiManager()
+    try:
+        m.create_siddhi_app_runtime(BASIC_QL).start()
+        s = m.start_sampler(interval_s=0.01)
+        import time as _t
+        deadline = _t.monotonic() + 5.0
+        while s.ticks == 0 and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        assert s.ticks > 0
+        m.stop_sampler()
+        assert m._sampler is None
+    finally:
+        m.shutdown()
+
+
+def test_fused_partial_drain_records_e2e(manager):
+    """A @fuse stack flushed while PARTIAL (flush() before K batches
+    arrive) still closes every batch's e2e sample — the drain path, not
+    just the full-stack dispatch."""
+    rt = manager.create_siddhi_app_runtime(
+        E2E_BASE.format(sann="", qann="@fuse(batches='8')"))
+    got = _consume(rt)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for i in range(3):            # 3 < K=8: stays stacked until flush
+        h.send([i + 1])
+    rt.flush()
+    qh = rt.stats.exposition_snapshot()["query_hist"]
+    assert qh["q:e2e"].total == 3
+    assert len(got) == 3
