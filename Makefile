@@ -3,10 +3,11 @@ PY ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test lint lint-apps lint-smoke dryrun bench metrics-smoke \
-	fuse-smoke explain-smoke chaos-smoke multichip-smoke soak-smoke all
+	fuse-smoke explain-smoke chaos-smoke multichip-smoke soak-smoke \
+	admission-smoke all
 
 all: lint lint-apps test dryrun metrics-smoke fuse-smoke explain-smoke \
-	lint-smoke chaos-smoke multichip-smoke soak-smoke
+	lint-smoke chaos-smoke multichip-smoke soak-smoke admission-smoke
 
 # static gate on our own code: ruff (rule set in pyproject.toml) when
 # available, with compileall kept as the syntax floor for samples and
@@ -78,3 +79,11 @@ chaos-smoke:
 # `ok` with zero silent drops (soak-telemetry layer, README "Soak & SLOs")
 soak-smoke:
 	$(CPU_ENV) $(PY) samples/soak_smoke.py
+
+# overload is decided, not discovered, in <30 s: an over-ceiling deploy
+# denied BEFORE any compile, exact shed accounting (offered == accepted
+# + shed), recompile-storm penalties at the shared compile gate with a
+# lossless victim, and the REST/healthz admission surfaces agreeing
+# (admission layer, README "Admission control & overload")
+admission-smoke:
+	$(CPU_ENV) $(PY) samples/admission_smoke.py

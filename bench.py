@@ -1191,6 +1191,362 @@ def run_soak(seconds: int = 60, apps: int = 2, chaos: bool = False,
     return payload
 
 
+NOISY_QL = """
+@app:name('noisy')
+@app:statistics('BASIC')
+@app:admission(overload='shed', max.events.per.sec='{rate}',
+               burst='{burst}', max.recompiles.per.min='5',
+               compile.penalty.ms='200')
+
+@async(buffer.size='64', workers='1', queue.policy='shed')
+define stream In (k long, v float, s int);
+
+@info(name='hot') from In[v > 2.95] select k, v insert into Out;
+"""
+
+STORM_QL = """
+@app:name('{name}')
+@app:statistics('BASIC')
+@app:admission(max.recompiles.per.min='2', compile.penalty.ms='60000',
+               compile.penalty.max.ms='600000')
+define stream S (k long, v float);
+@info(name='sq') from S#window.length(32)
+select k, avg(v) as av group by k insert into Out;
+"""
+
+OVER_CEILING_QL = """
+@app:name('hog')
+define stream S (sym string, price double, v long);
+@info(name='hog') from S#window.length(50000000)
+select sym, avg(price) as ap insert into Out;
+"""
+
+
+def _victim_p99_us(rt) -> float:
+    q = rt.statistics().get("queries", {}).get("hot", {})
+    return float(q.get("p99_us", 0.0))
+
+
+def run_soak_noisy(seconds: int = 30, out_path=None,
+                   interval_s: float = 1.0, B: int = 1 << 10):
+    """--mode soak --noisy-tenant: the noisy-neighbor isolation proof
+    (ISSUE 8 acceptance).  Phase 1 runs ONE victim tenant solo and
+    records its step p99 baseline.  Phase 2 co-runs the victim with a
+    deliberately abusive tenant that (a) over-offers into a shed-policy
+    rate limit, (b) recompile-storms by hot deploy/undeploy churn, and
+    (c) attempts an over-ceiling deploy — while the admission layer
+    sheds, penalizes, and denies.  Writes SOAK_r08.json.
+
+    Exit contract (rc 1 on violation):
+      - victim co-run step p99 within 25% of its solo baseline
+      - zero SILENT drops anywhere: the victim's sink ledger balances
+        and the noisy tenant's offered == accepted + shed EXACTLY
+      - the over-ceiling deploy was denied BEFORE any compile
+      - the compile gate actually penalized the storming tenant"""
+    import threading as _threading
+
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.core.admission import COMPILE_GATE, denied_deploys
+    from siddhi_tpu.exceptions import AdmissionDeniedError
+    from siddhi_tpu.observability.recompile import RECOMPILES
+    from siddhi_tpu.utils.chaos import ChaosSink
+    from siddhi_tpu.utils.config import InMemoryConfigManager
+    _probe_backend()
+
+    rng = np.random.default_rng(7)
+    kcol = np.arange(B, dtype=np.int64)
+    vcol = (rng.random(B) * 3.0).astype(np.float32)
+    scol = (np.arange(B) % 8).astype(np.int32)
+    sel = int((vcol > 2.95).sum())
+
+    def _warm(t):
+        h = t["rt"].get_input_handler("In")
+        for _ in range(2):
+            h.send_columns([kcol, vcol, scol])
+        t["rt"].flush()
+        t["sent"] += 2 * B
+
+    def _produce_loop(t, stop, pace_s=None):
+        """Open-loop producer: with `pace_s` the offer rate is FIXED
+        (one batch per period, deadline-scheduled), not closed-loop —
+        a latency comparison across phases is only meaningful when the
+        offered load is identical in both, and a spin-loop producer on
+        a small host measures GIL starvation, not admission isolation."""
+        h = t["rt"].get_input_handler("In")
+        next_t = time.perf_counter()
+        while not stop.is_set():
+            h.send_columns([kcol, vcol, scol])
+            t["sent"] += B
+            if pace_s:
+                next_t += pace_s
+                lag = next_t - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                else:           # fell behind: reschedule, don't burst
+                    next_t = time.perf_counter()
+
+    def _produce_for(t, secs, pace_s=None):
+        stop = _threading.Event()
+        th = _threading.Thread(target=_produce_loop,
+                               args=(t, stop, pace_s), daemon=True)
+        th.start()
+        time.sleep(secs)
+        stop.set()
+        th.join(timeout=10.0)
+        t["rt"].flush()
+
+    def _calibrate_pace(t, n=8):
+        """Victim batch period for BOTH phases: ~4x the uncontended
+        batch cost (≈25% duty solo), clamped to [40ms, 500ms]."""
+        h = t["rt"].get_input_handler("In")
+        t0 = time.perf_counter()
+        for _ in range(n):
+            h.send_columns([kcol, vcol, scol])
+        t["rt"].flush()
+        t["sent"] += n * B
+        per = (time.perf_counter() - t0) / n
+        return min(0.5, max(0.04, 4.0 * per))
+
+    # ---- phase 1: victim solo baseline --------------------------------------
+    # settle window first (the one-time compiles + allocator warmup are
+    # a deploy cost, not a noisy-neighbor signal), then RESET the
+    # histograms and measure the steady window — the co-run phase uses
+    # the same settle/reset/measure shape, so the p99s compare like
+    # for like
+    # the settle window also absorbs the storm's within-budget compiles
+    # (max.recompiles.per.min='2' grants it two free ones; the third is
+    # parked at the gate for its 60s penalty quantum — decided, not
+    # discovered, so it cannot land inside the measure window)
+    settle_s = max(6, seconds // 3)
+    measure_s = max(6, seconds // 2)
+    # p99 of a single measurement window is the handful of slowest
+    # batches — on a shared host, that's dominated by scheduler jitter
+    # spikes, not steady-state behavior.  Each phase therefore measures
+    # THREE consecutive sub-windows and compares MEDIAN p99s; the
+    # victim's row/delivery ledger accumulates across the resets so the
+    # zero-silent-drop reconciliation still covers every sub-window.
+    def _measured_p99(t, rt, pace):
+        vals, hot_rows, drops = [], 0, 0
+        for _ in range(3):
+            rt.stats.reset()
+            _produce_for(t, measure_s / 3.0, pace)
+            vals.append(_victim_p99_us(rt))
+            ctr = rt.stats.exposition_snapshot().get("counters", {})
+            hot_rows += ctr.get("hot.emitted_rows", 0)
+            drops += sum(v for k, v in ctr.items()
+                         if k.endswith(".dropped"))
+        return sorted(vals)[1], vals, hot_rows, drops
+
+    m1 = SiddhiManager()
+    name, rt, agg_rows = _soak_app(m1, 0, chaos=False)
+    victim = {"rt": rt, "sent": 0}
+    _warm(victim)
+    pace_s = _calibrate_pace(victim)
+    # the solo baseline must face the SAME serving infrastructure as
+    # the co-run phase (sampler ticking included) — the phases differ
+    # only by the noisy tenant's presence
+    m1.start_sampler(interval_s=interval_s)
+    _produce_for(victim, settle_s, pace_s)
+    solo_p99_us, solo_p99s, _, _ = _measured_p99(victim, rt, pace_s)
+    m1.stop_sampler()
+    m1.shutdown()
+    print(f"noisy-soak baseline: victim solo p99 {solo_p99_us:.0f}us "
+          f"(median of {['%.0f' % v for v in solo_p99s]}) at "
+          f"{1.0 / pace_s:.1f} batch/s open-loop over {measure_s}s "
+          "steady", file=sys.stderr)
+
+    # ---- phase 2: victim + noisy tenant -------------------------------------
+    m2 = SiddhiManager()
+    m2.set_config_manager(InMemoryConfigManager(system_configs={
+        # a generous box ceiling the 'hog' deploy must overshoot
+        "admission.global.max.state.bytes": str(1 << 30),
+    }))
+    vname, vrt, vagg = _soak_app(m2, 0, chaos=False)
+    victim2 = {"rt": vrt, "sent": 0}
+    _warm(victim2)
+
+    # the over-offering tenant: a paced transport offering ~250x the
+    # admitted quota (1 batch/s admitted, ~250 batch/s offered) — the
+    # admission bucket sheds the difference at the edge, so the noisy
+    # engine only ever dispatches its small admitted slice.  The quota
+    # is sized for the box: one victim batch-time per second of noisy
+    # dispatch is what a single shared core can absorb without the
+    # victim's tail seeing it — exactly the sizing decision the quota
+    # knob exists for
+    noisy_rt = m2.create_siddhi_app_runtime(NOISY_QL.format(
+        rate=B, burst=B))
+    noisy_rt.start()
+    noisy = {"rt": noisy_rt, "sent": 0}
+    _warm(noisy)
+
+    # the over-ceiling deploy: denied BEFORE any compile (provable via
+    # the recompile registry: the hog's owner label never appears)
+    denied_before = denied_deploys()
+    hog_denied = False
+    try:
+        m2.create_siddhi_app_runtime(OVER_CEILING_QL)
+    except AdmissionDeniedError as exc:
+        hog_denied = True
+        print(f"noisy-soak: hog deploy denied: {str(exc)[:100]}",
+              file=sys.stderr)
+    hog_never_compiled = RECOMPILES.count("hog") == 0
+
+    penalized_before = COMPILE_GATE.penalized_total
+    storm_deploys = [0]
+    stop2 = _threading.Event()
+
+    def storm_loop():
+        """Hot deploy/undeploy churn: every cycle plans fresh jitted
+        steps whose first batch traces+compiles — a sustained compile
+        storm attributed to (and penalized, escalatingly, on) the
+        storming tenant's owner labels at the shared gate."""
+        i = 0
+        h_cols = [np.arange(64, dtype=np.int64),
+                  np.ones(64, dtype=np.float32)]
+        while not stop2.is_set():
+            app_name = f"storm{i % 4}"
+            i += 1
+            try:
+                srt = m2.create_siddhi_app_runtime(
+                    STORM_QL.format(name=app_name))
+                srt.start()
+                srt.get_input_handler("S").send_columns(h_cols)
+                srt.flush()
+                storm_deploys[0] += 1
+            except Exception as exc:  # noqa: BLE001 — storm must storm
+                print(f"storm cycle error: {exc!r}", file=sys.stderr)
+            finally:
+                srt2 = m2.runtimes.pop(app_name, None)
+                if srt2 is not None:
+                    srt2.shutdown()
+
+    noise_threads = [
+        _threading.Thread(target=_produce_loop,
+                          args=(noisy, stop2, 0.004),
+                          daemon=True, name="noisy-offer-load"),
+        _threading.Thread(target=storm_loop, daemon=True,
+                          name="noisy-storm"),
+    ]
+    sampler = m2.start_sampler(interval_s=interval_s)
+    t0 = time.perf_counter()
+    for th in noise_threads:
+        th.start()
+    # settle with the noise already running, then measure the victim's
+    # steady sub-windows UNDER noise — the same open-loop pace and
+    # settle/measure shape as the solo baseline, so the median p99s
+    # compare like for like
+    _produce_for(victim2, settle_s, pace_s)
+    delivered0 = len(ChaosSink.instances[vname].delivered)
+    victim2["sent"] = 0
+    co_p99_us, co_p99s, v_hot, v_drops = _measured_p99(
+        victim2, vrt, pace_s)
+    stop2.set()
+    for th in noise_threads:
+        # the storm thread may be parked mid-penalty at the compile
+        # gate (that IS the mechanism under test) — it is a daemon;
+        # don't wait out its sentence
+        th.join(timeout=3.0)
+    vrt.flush()
+    noisy_rt.flush()
+    elapsed = time.perf_counter() - t0
+    sampler.tick()
+    m2.stop_sampler()
+
+    # LogHistogram p99 interpolates inside octave buckets; allow a
+    # small absolute epsilon below which ratio noise is quantization
+    eps_us = 200.0
+    ratio = co_p99_us / solo_p99_us if solo_p99_us > 0 else float("inf")
+    p99_ok = co_p99_us <= solo_p99_us * 1.25 + eps_us
+
+    # victim silent-drop ledger over the measured sub-windows (rows and
+    # drop counters accumulated across the resets by _measured_p99; the
+    # sink delivery list is cumulative, so compare its delta)
+    v_sink_drops = sum(
+        int(getattr(conn, "dropped_total", 0))
+        for sk in vrt.sinks for conn in getattr(sk, "connections", ()))
+    v_delivered = len(ChaosSink.instances[vname].delivered) - delivered0
+    v_expected = (victim2["sent"] // B) * sel
+    victim_zero = v_drops == 0 and v_sink_drops == 0 and \
+        v_delivered == v_hot == v_expected
+
+    # noisy shed ledger: offered == dispatched + admission-shed +
+    # async-shed EXACTLY — every dropped event was a counted DECISION
+    # at one of the two shedding edges, nothing silent
+    nadm = noisy_rt.admission
+    nsnap = noisy_rt.stats.exposition_snapshot()
+    n_accept = nsnap["stream_in"].get("In", 0)
+    n_async_shed = nsnap["counters"].get("async.In.shed", 0)
+    ledger_exact = noisy["sent"] == \
+        n_accept + nadm.shed_total + n_async_shed
+    penalties = COMPILE_GATE.penalized_total - penalized_before
+
+    ok = (p99_ok and victim_zero and ledger_exact and hog_denied
+          and hog_never_compiled and penalties > 0)
+    import jax
+    payload = {
+        "mode": "soak",
+        "noisy_tenant": True,
+        "seconds": seconds, "elapsed_s": round(elapsed, 2),
+        "interval_s": interval_s, "batch": B,
+        "device": str(jax.devices()[0]),
+        "verdict": "ok" if ok else "violated",
+        "victim": {
+            "solo_p99_us": round(solo_p99_us, 1),
+            "solo_p99_us_windows": [round(v, 1) for v in solo_p99s],
+            "corun_p99_us": round(co_p99_us, 1),
+            "corun_p99_us_windows": [round(v, 1) for v in co_p99s],
+            "p99_ratio": round(ratio, 3),
+            "p99_within_25pct": p99_ok,
+            "sent_events": victim2["sent"],
+            "sink_delivered": v_delivered,
+            "hot_rows_emitted": v_hot,
+            "hot_rows_expected": v_expected,
+            "zero_silent_drops": victim_zero,
+            "slo": vrt.timeseries().get("slo", {}),
+        },
+        "noisy": {
+            "offered_events": noisy["sent"],
+            "accepted_events": n_accept,
+            "admission_shed": nadm.shed_total,
+            "async_shed": n_async_shed,
+            "ledger_exact": ledger_exact,
+            "admission": nadm.report(),
+        },
+        "storm": {
+            "deploy_cycles": storm_deploys[0],
+            "compile_penalties": penalties,
+            "denied_deploys": denied_deploys() - denied_before,
+            "hog_denied_before_compile": hog_denied and
+            hog_never_compiled,
+        },
+        "note": ("noisy-neighbor isolation artifact (ISSUE 8): one "
+                 "victim tenant serves steady load while a noisy "
+                 "tenant over-offers into a shed-policy rate limit, "
+                 "recompile-storms via hot deploy/undeploy churn "
+                 "(penalized at the shared compile-admission gate), "
+                 "and attempts an over-ceiling deploy (denied by the "
+                 "static-estimate memory gate before any compile).  "
+                 "Every dropped event is a COUNTED admission decision: "
+                 "offered == accepted + shed exactly; the victim's "
+                 "sink ledger balances to the row."),
+    }
+    m2.shutdown()
+    line = {k: v for k, v in payload.items() if k != "note"}
+    print(json.dumps(line))
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(json.dumps(payload, indent=2) + "\n")
+        print(f"noisy-soak artifact written to {out_path}",
+              file=sys.stderr)
+    if not ok:
+        print(f"NOISY SOAK FAILED: p99_ok={p99_ok} "
+              f"victim_zero={victim_zero} ledger={ledger_exact} "
+              f"hog_denied={hog_denied} penalties={penalties}",
+              file=sys.stderr)
+        sys.exit(1)
+    return payload
+
+
 if __name__ == "__main__":
     import argparse
     ap = argparse.ArgumentParser(description=__doc__)
@@ -1227,6 +1583,12 @@ if __name__ == "__main__":
     ap.add_argument("--chaos", action="store_true",
                     help="soak: kill each tenant's sink transport "
                          "mid-run (retry must redeliver, zero loss)")
+    ap.add_argument("--noisy-tenant", action="store_true",
+                    help="soak: noisy-neighbor isolation mode — one "
+                         "tenant over-offers + recompile-storms while "
+                         "admission sheds/penalizes/denies; asserts "
+                         "the victim's step p99 stays within 25% of "
+                         "its solo baseline (writes SOAK_r08.json)")
     ap.add_argument("--interval", type=float, default=1.0,
                     help="soak: sampler tick period (seconds)")
     ap.add_argument("--p99-ms", type=float, default=500.0,
@@ -1243,6 +1605,12 @@ if __name__ == "__main__":
     elif args.mode == "multichip":
         _enable_compile_cache()
         run_multichip(quick=args.quick, out_path=args.out)
+    elif args.mode == "soak" and args.noisy_tenant:
+        # NO persistent compile cache here: the storm must genuinely
+        # compile each deploy cycle, as a hot-churning tenant would
+        run_soak_noisy(seconds=args.seconds,
+                       out_path=args.out or "SOAK_r08.json",
+                       interval_s=args.interval, B=args.batch)
     elif args.mode == "soak":
         _enable_compile_cache()
         run_soak(seconds=args.seconds, apps=args.apps, chaos=args.chaos,

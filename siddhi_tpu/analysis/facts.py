@@ -29,36 +29,35 @@ import dataclasses
 import types
 from typing import Any, Dict, List, Optional, Tuple
 
-import numpy as np
-
 from ..query_api.app import SiddhiApp
-from ..query_api.definition import AbstractDefinition
 from ..query_api.query import (
     AbsentStreamStateElement,
-    CountStateElement,
-    EveryStateElement,
-    JoinInputStream,
-    LogicalStateElement,
-    NextStateElement,
     Partition,
     Query,
     RangePartitionType,
-    StateInputStream,
-    StreamStateElement,
     Window,
 )
 
-# mirrors of the planner/runtime defaults (planner.plan_single_query,
-# runtime._add_query/_add_partition) — the static estimates must predict
-# what those paths would build
-_BATCH_CAPACITY = 512
-_WINDOW_HINT = 2048
-_PARTITION_WINDOW_HINT = 128
-_PARTITION_KEYS = 4096
-_NFA_SLOTS = 8
-# columnar buffer overhead per row beyond the payload columns:
-# ts i64 + seq i64 + gslot i32 + alive bool (core/window.py empty_buffer)
-_ROW_OVERHEAD = 8 + 8 + 4 + 1
+# the static mini-planner's capacity mirrors and AST helpers live in
+# core/plan_facts.py so the admission deploy gate shares the EXACT
+# implementation (one estimate, one component breakdown — no drift);
+# the underscore aliases are this module's historical public names
+from ..core.plan_facts import (  # noqa: F401  (re-exported API)
+    BATCH_CAPACITY as _BATCH_CAPACITY,
+    NFA_SLOTS as _NFA_SLOTS,
+    PARTITION_KEYS as _PARTITION_KEYS,
+    PARTITION_WINDOW_HINT as _PARTITION_WINDOW_HINT,
+    ROW_OVERHEAD as _ROW_OVERHEAD,
+    WINDOW_HINT as _WINDOW_HINT,
+    capacity_annotation,
+    iter_named_queries,
+    pattern_atoms,
+    query_kind,
+    query_state_components,
+    row_bytes as _row_bytes,
+    window_capacity,
+    window_handler,
+)
 
 
 @dataclasses.dataclass
@@ -77,8 +76,11 @@ class QueryFacts:
     # rendered emission cap (None = uncapped / capacity-bounded)
     emission_cap: Optional[int] = None
     emission_cap_explicit: bool = False
-    # per-query device state, bytes (shape×dtype arithmetic)
+    # per-query device state, bytes (shape×dtype arithmetic), with the
+    # per-component breakdown MEM001 and the admission deploy gate both
+    # cite (static: plan_facts estimator; runtime: measured accounting)
     state_bytes: Optional[int] = None
+    state_components: Optional[Dict[str, int]] = None
     state_bytes_origin: str = "estimated"   # estimated | measured
     key_capacity: int = 1
     nfa_slots: int = _NFA_SLOTS
@@ -104,57 +106,6 @@ class AnalysisContext:
 # shared AST helpers (used by facts builders AND rules)
 # ---------------------------------------------------------------------------
 
-def iter_named_queries(app: SiddhiApp):
-    """(name, query, partition|None) with runtime-identical naming."""
-    qi = 0
-
-    def name_of(q: Query) -> str:
-        info = q.get_annotation("info")
-        if info:
-            n = info.element("name")
-            if n:
-                return n
-        return f"query{qi + 1}"
-
-    for element in app.execution_element_list:
-        if isinstance(element, Query):
-            yield name_of(element), element, None
-            qi += 1
-        elif isinstance(element, Partition):
-            for q in element.query_list:
-                yield name_of(q), q, element
-                qi += 1
-
-
-def window_handler(sis) -> Optional[Window]:
-    for h in getattr(sis, "stream_handlers", ()):
-        if isinstance(h, Window):
-            return h
-    return None
-
-
-def pattern_atoms(el):
-    """Flat list of the stream/absent atoms of a state-element tree."""
-    out = []
-
-    def rec(e):
-        if isinstance(e, (StreamStateElement, AbsentStreamStateElement)):
-            out.append(e)
-        elif isinstance(e, CountStateElement):
-            rec(e.stream_state_element)
-        elif isinstance(e, LogicalStateElement):
-            rec(e.stream_state_element_1)
-            rec(e.stream_state_element_2)
-        elif isinstance(e, NextStateElement):
-            rec(e.state_element)
-            rec(e.next_state_element)
-        elif isinstance(e, EveryStateElement):
-            rec(e.state_element)
-
-    rec(el)
-    return out
-
-
 def window_needs_timer(win: Optional[Window]) -> bool:
     """needs_timer of the processor class the planner would pick —
     resolved from the live WINDOW_TYPES registry, never re-listed here."""
@@ -164,53 +115,6 @@ def window_needs_timer(win: Optional[Window]) -> bool:
     full = (win.namespace + ":" if win.namespace else "") + win.name
     cls = WINDOW_TYPES.get(full)
     return bool(getattr(cls, "needs_timer", False)) if cls else False
-
-
-def _row_bytes(sdef: Optional[AbstractDefinition]) -> int:
-    """Bytes per buffered window row: payload columns (device dtypes via
-    event.dtype_of — STRING is an interned i32, DOUBLE an f32 on TPU)
-    plus the fixed Buffer bookkeeping columns."""
-    from ..core import event as ev
-    n = _ROW_OVERHEAD
-    for a in getattr(sdef, "attribute_list", ()):
-        try:
-            n += int(np.dtype(ev.dtype_of(a.type)).itemsize)
-        except Exception:  # noqa: BLE001 — OBJECT columns etc.
-            n += 8
-    return n
-
-
-def window_capacity(win: Optional[Window], hint: int) -> int:
-    """Resident-row capacity the planner would give this window: the
-    first non-time integer parameter (length/lengthBatch/sort/... row
-    counts), else the capacity hint time-based windows are built with."""
-    if win is None:
-        return _BATCH_CAPACITY
-    from ..query_api.expression import Constant
-    for p in win.parameters:
-        if isinstance(p, Constant) and p.type in ("INT", "LONG") and \
-                not getattr(p, "is_time", False):
-            return max(1, int(p.value))
-    return hint
-
-
-def capacity_annotation(q: Query, part: Optional[Partition]
-                        ) -> Dict[str, int]:
-    """@capacity(keys=, slots=, window=) merged across the query and its
-    partition (runtime._add_partition scans both)."""
-    out: Dict[str, int] = {}
-    anns = list(q.annotations)
-    if part is not None:
-        anns += list(part.annotations)
-        for pq in part.query_list:
-            anns += list(pq.annotations)
-    for ann in anns:
-        if ann.name.lower() == "capacity":
-            for k in ("keys", "slots", "window"):
-                v = ann.element(k)
-                if v is not None:
-                    out[k] = int(v)
-    return out
 
 
 def fuse_requested(app: SiddhiApp, q: Query) -> int:
@@ -241,14 +145,6 @@ def emit_annotation_rows(q: Query) -> Optional[int]:
         return None
     v = ann.element("rows")
     return int(v) if v is not None else None
-
-
-def query_kind(q: Query) -> str:
-    if isinstance(q.input_stream, JoinInputStream):
-        return "join"
-    if isinstance(q.input_stream, StateInputStream):
-        return "pattern"
-    return "plain"
 
 
 # ---------------------------------------------------------------------------
@@ -294,47 +190,6 @@ def _static_exclusion(app: SiddhiApp, q: Query, kind: str,
         return None
 
 
-def _static_state_bytes(app: SiddhiApp, q: Query, kind: str,
-                        part: Optional[Partition], caps: Dict[str, int],
-                        keys: int) -> Optional[int]:
-    """Shape×dtype estimate of the device state the planner would
-    allocate (windows and NFA slot blocks; group-by slabs are bounded
-    and small by comparison)."""
-    defs = app.stream_definition_map
-
-    def stream_def(sid):
-        return defs.get(sid) or app.window_definition_map.get(sid)
-
-    hint = caps.get(
-        "window",
-        _PARTITION_WINDOW_HINT if part is not None else _WINDOW_HINT)
-    if kind == "plain":
-        win = window_handler(q.input_stream)
-        if win is None:
-            return None
-        rows = window_capacity(win, hint)
-        per_key = rows * _row_bytes(stream_def(q.input_stream.stream_id))
-        return per_key * (keys if part is not None else 1)
-    if kind == "join":
-        total = 0
-        for sis in (q.input_stream.left_input_stream,
-                    q.input_stream.right_input_stream):
-            win = window_handler(sis)
-            if win is not None:
-                total += window_capacity(win, _WINDOW_HINT) * \
-                    _row_bytes(stream_def(sis.stream_id))
-        return total or None
-    # pattern: per-key NFA slot block — `slots` pending matches per key,
-    # each capturing one row per pattern state
-    atoms = pattern_atoms(q.input_stream.state_element)
-    slots = caps.get("slots", _NFA_SLOTS)
-    per_state = max(
-        (_row_bytes(stream_def(a.basic_single_input_stream.stream_id))
-         for a in atoms), default=_ROW_OVERHEAD)
-    return (keys if part is not None else 1) * slots * \
-        max(1, len(atoms)) * per_state
-
-
 def facts_from_app(app: SiddhiApp) -> List[QueryFacts]:
     out: List[QueryFacts] = []
     for name, q, part in iter_named_queries(app):
@@ -372,6 +227,9 @@ def facts_from_app(app: SiddhiApp) -> List[QueryFacts]:
             cap = render_cap(emit_rows) if explicit else None
 
         k = fuse_requested(app, q)
+        # the ONE static estimator shared with the admission deploy gate
+        # (core/plan_facts.query_state_components)
+        comps = query_state_components(app, q, kind, part, caps, keys)
         f = QueryFacts(
             name=name, query=q, kind=kind, origin="static",
             partition=part, needs_timer=needs_timer, keyed_window=keyed,
@@ -379,8 +237,8 @@ def facts_from_app(app: SiddhiApp) -> List[QueryFacts]:
             fusion_exclusion=_static_exclusion(
                 app, q, kind, part, needs_timer, keyed) if k else None,
             emission_cap=cap, emission_cap_explicit=explicit,
-            state_bytes=_static_state_bytes(app, q, kind, part, caps,
-                                            keys),
+            state_bytes=sum(comps.values()) if comps else None,
+            state_components=comps or None,
             state_bytes_origin="estimated",
             key_capacity=keys if (part is not None or keyed) else 1,
             nfa_slots=caps.get("slots", _NFA_SLOTS),
@@ -437,6 +295,7 @@ def facts_from_runtime(rt) -> List[QueryFacts]:
             emission_cap_explicit=bool(getattr(p, "emit_explicit",
                                                False)),
             state_bytes=sum(comp.values()) if comp else None,
+            state_components=dict(comp) if comp else None,
             state_bytes_origin="measured",
             key_capacity=int(getattr(p, "key_capacity", 0) or 1),
             nfa_slots=int(getattr(p, "slots", _NFA_SLOTS) or _NFA_SLOTS),

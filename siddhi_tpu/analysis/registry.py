@@ -56,6 +56,10 @@ class LintConfig:
     mesh_devices: PART002 deploy target — the shard-mesh size the app
         will serve on (0 = unknown; runtime analysis resolves it from
         the live runtime's mesh instead).
+    global_state_ceiling_bytes: ADM001 deploy target — the box's
+        `admission.global.max.state.bytes` admission ceiling (0 =
+        unknown; runtime analysis resolves it from the live manager's
+        config instead).
     """
 
     disabled: Set[str] = dataclasses.field(default_factory=set)
@@ -63,6 +67,7 @@ class LintConfig:
         dataclasses.field(default_factory=dict)
     state_budget_bytes: int = 128 * 1024 * 1024
     mesh_devices: int = 0
+    global_state_ceiling_bytes: int = 0
 
     def severity_of(self, r: Rule) -> str:
         return self.severity_overrides.get(r.id, r.severity)

@@ -111,13 +111,18 @@ def _uncapped_pattern_emission(ctx: AnalysisContext) -> Iterator[Finding]:
       "shrink the window / `@capacity(keys=…, slots=…, window=…)`, or "
       "raise the lint budget if the deployment really has the HBM")
 def _state_over_budget(ctx: AnalysisContext) -> Iterator[Finding]:
+    from ..core.plan_facts import format_component_bytes
     budget = getattr(ctx.config, "state_budget_bytes",
                      128 * 1024 * 1024)
     for f in ctx.queries:
         if f.state_bytes is not None and f.state_bytes > budget:
+            # same breakdown string the admission deploy gate prints in
+            # its AdmissionDeniedError (core/plan_facts estimator)
+            detail = f" ({format_component_bytes(f.state_components)})" \
+                if f.state_components else ""
             yield _f(f"{f.state_bytes_origin} device state "
                      f"{_mb(f.state_bytes)} exceeds the "
-                     f"{_mb(budget)} budget", query=f.name,
+                     f"{_mb(budget)} budget{detail}", query=f.name,
                      node=f.query)
 
 
@@ -510,8 +515,92 @@ def _sink_silent_drop(ctx: AnalysisContext) -> Iterator[Finding]:
                      query=None, node=ann)
 
 
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+def _global_ceiling(ctx: AnalysisContext) -> int:
+    """Deploy-target global state ceiling, bytes: the live manager's
+    `admission.global.max.state.bytes` when analyzing a runtime, else
+    LintConfig.global_state_ceiling_bytes (CLI --global-ceiling), else
+    0 = unknown (the size half of ADM001 stays silent)."""
+    rt = ctx.runtime
+    if rt is not None:
+        try:
+            cm = getattr(getattr(rt, "manager", None),
+                         "config_manager", None)
+            v = cm.extract_property("admission.global.max.state.bytes") \
+                if cm is not None else None
+            if v:
+                return int(float(v))
+        except Exception:  # noqa: BLE001 — config must not break lint
+            pass
+    return int(getattr(ctx.config, "global_state_ceiling_bytes", 0) or 0)
+
+
+def _overload_explicit(ctx: AnalysisContext) -> bool:
+    """Did anyone CHOOSE an overload policy for this app?  Runtime:
+    the controller's policy_explicit (annotation, manager property, or
+    REST PUT).  Static: the @app:admission annotation alone."""
+    rt = ctx.runtime
+    if rt is not None:
+        adm = getattr(rt, "admission", None)
+        if adm is not None:
+            return bool(getattr(adm, "policy_explicit", False))
+    ann = ctx.app.get_annotation("app:admission")
+    return ann is not None and ann.element("overload") is not None
+
+
+@rule("ADM001", "WARN",
+      "app will collide with the admission controller at deploy or "
+      "under load",
+      "Two deploy-time hazards the admission layer (core/admission.py) "
+      "turns into runtime denials: an app whose static state estimate "
+      "already exceeds the box's configured global memory ceiling will "
+      "be REJECTED at deploy (`admission.global.max.state.bytes`), and "
+      "an app fed at transport rate by a @source with no explicit "
+      "`admission.overload` policy gets the default 'block' ladder — "
+      "under overload its transport delivery thread backpressures to "
+      "the deadline and then errors, which for a socket feed usually "
+      "means disconnects, not throttling.",
+      "shrink the state (window/@capacity) below the global ceiling, "
+      "and declare @app:admission(overload='shed'|'degrade'|'block', "
+      "max.events.per.sec='…') so overload behavior is chosen, not "
+      "defaulted")
+def _admission_hazards(ctx: AnalysisContext) -> Iterator[Finding]:
+    ceiling = _global_ceiling(ctx)
+    if ceiling > 0:
+        total = sum(f.state_bytes or 0 for f in ctx.queries)
+        if total > ceiling:
+            worst = max((f for f in ctx.queries if f.state_bytes),
+                        key=lambda f: f.state_bytes, default=None)
+            yield _f(f"total {'measured' if ctx.runtime is not None else 'estimated'} "
+                     f"device state {_mb(total)} exceeds the global "
+                     f"admission ceiling {_mb(ceiling)} — deploy would "
+                     "be denied on a box honoring it",
+                     query=worst.name if worst is not None else None,
+                     node=worst.query if worst is not None else None)
+    # transport-rate ingest with a defaulted overload policy
+    if _overload_explicit(ctx):
+        return
+    for sid, sdef in ctx.app.stream_definition_map.items():
+        if sid.startswith(("!", "#")):
+            continue
+        for ann in sdef.annotations:
+            if ann.name.lower() != "source":
+                continue
+            stype = str(ann.element("type") or ann.element(None) or "")
+            if stype.lower() == "inmemory":
+                continue      # hand-fed test transport, not a feed
+            yield _f(f"@source(type={stype!r}) feeds {sid!r} at "
+                     "transport rate but no admission.overload policy "
+                     "is declared — overload backpressures the "
+                     "delivery thread with the default 'block' ladder",
+                     query=None, node=ann)
+
+
 ALL_RULE_IDS: List[str] = [
     "STATE001", "STATE002", "MEM001", "FUSE001", "JOIN001",
     "DEAD001", "DEAD002", "PART001", "PART002", "TYPE001", "RATE001",
-    "APP001", "SINK001",
+    "APP001", "SINK001", "ADM001",
 ]

@@ -1,5 +1,5 @@
-"""Shared compiled-plan fact helpers: uncapped-sentinel rendering and
-fusion-exclusion reasons.
+"""Shared compiled-plan fact helpers: uncapped-sentinel rendering,
+fusion-exclusion reasons, and the static state-bytes estimator.
 
 Three surfaces report the same two plan facts — whether a query's
 emission cap is real or the 1<<30 "effectively uncapped" sentinel, and
@@ -9,10 +9,20 @@ why a requested `@fuse` was skipped at wiring time: the static analyzer
 locally (the sentinel rendering lived only in explain; the exclusion
 reason only in a wiring-time log line), so the renderings could drift.
 This module is the single source of truth all three import.
+
+The same single-source rule applies to the *static state-bytes
+estimate*: lint's MEM001 rule and the admission controller's
+deploy-time memory gate (core/admission.py) must agree on how big an
+app's device state will be BEFORE anything is planned or traced, or an
+app could lint green and still be denied at deploy (or vice versa).
+`static_state_components` below is that one implementation — a pure
+AST walk mirroring the planner/runtime capacity defaults, shape×dtype
+arithmetic only, never touching jax — and both consumers cite the same
+per-component breakdown it returns.
 """
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 # pattern_planner's compact_rows default for non-partitioned patterns:
 # "effectively uncapped" (a per-key cap with K=1 would cap the batch).
@@ -61,3 +71,218 @@ def fusion_exclusions(rt) -> Dict[str, str]:
         if why is not None:
             out[name] = why
     return out
+
+
+# ---------------------------------------------------------------------------
+# static state-bytes estimator (shared by lint MEM001 and the admission
+# deploy gate — one implementation, one component breakdown)
+# ---------------------------------------------------------------------------
+
+# mirrors of the planner/runtime defaults (planner.plan_single_query,
+# runtime._add_query/_add_partition) — the static estimates must predict
+# what those paths would build
+BATCH_CAPACITY = 512
+WINDOW_HINT = 2048
+PARTITION_WINDOW_HINT = 128
+PARTITION_KEYS = 4096
+NFA_SLOTS = 8
+# columnar buffer overhead per row beyond the payload columns:
+# ts i64 + seq i64 + gslot i32 + alive bool (core/window.py empty_buffer)
+ROW_OVERHEAD = 8 + 8 + 4 + 1
+
+
+def iter_named_queries(app):
+    """(name, query, partition|None) with runtime-identical naming
+    (mirrors SiddhiAppRuntime._query_name: @info name, else `query<i>`
+    numbered across top-level queries and partition bodies)."""
+    from ..query_api.query import Partition, Query
+    qi = 0
+
+    def name_of(q) -> str:
+        info = q.get_annotation("info")
+        if info:
+            n = info.element("name")
+            if n:
+                return n
+        return f"query{qi + 1}"
+
+    for element in app.execution_element_list:
+        if isinstance(element, Query):
+            yield name_of(element), element, None
+            qi += 1
+        elif isinstance(element, Partition):
+            for q in element.query_list:
+                yield name_of(q), q, element
+                qi += 1
+
+
+def query_kind(q) -> str:
+    from ..query_api.query import JoinInputStream, StateInputStream
+    if isinstance(q.input_stream, JoinInputStream):
+        return "join"
+    if isinstance(q.input_stream, StateInputStream):
+        return "pattern"
+    return "plain"
+
+
+def window_handler(sis):
+    from ..query_api.query import Window
+    for h in getattr(sis, "stream_handlers", ()):
+        if isinstance(h, Window):
+            return h
+    return None
+
+
+def pattern_atoms(el) -> List:
+    """Flat list of the stream/absent atoms of a state-element tree."""
+    from ..query_api.query import (
+        AbsentStreamStateElement,
+        CountStateElement,
+        EveryStateElement,
+        LogicalStateElement,
+        NextStateElement,
+        StreamStateElement,
+    )
+    out: List = []
+
+    def rec(e):
+        if isinstance(e, (StreamStateElement, AbsentStreamStateElement)):
+            out.append(e)
+        elif isinstance(e, CountStateElement):
+            rec(e.stream_state_element)
+        elif isinstance(e, LogicalStateElement):
+            rec(e.stream_state_element_1)
+            rec(e.stream_state_element_2)
+        elif isinstance(e, NextStateElement):
+            rec(e.state_element)
+            rec(e.next_state_element)
+        elif isinstance(e, EveryStateElement):
+            rec(e.state_element)
+
+    rec(el)
+    return out
+
+
+def window_capacity(win, hint: int) -> int:
+    """Resident-row capacity the planner would give this window: the
+    first non-time integer parameter (length/lengthBatch/sort/... row
+    counts), else the capacity hint time-based windows are built with."""
+    if win is None:
+        return BATCH_CAPACITY
+    from ..query_api.expression import Constant
+    for p in win.parameters:
+        if isinstance(p, Constant) and p.type in ("INT", "LONG") and \
+                not getattr(p, "is_time", False):
+            return max(1, int(p.value))
+    return hint
+
+
+def capacity_annotation(q, part) -> Dict[str, int]:
+    """@capacity(keys=, slots=, window=) merged across the query and its
+    partition (runtime._add_partition scans both)."""
+    out: Dict[str, int] = {}
+    anns = list(q.annotations)
+    if part is not None:
+        anns += list(part.annotations)
+        for pq in part.query_list:
+            anns += list(pq.annotations)
+    for ann in anns:
+        if ann.name.lower() == "capacity":
+            for k in ("keys", "slots", "window"):
+                v = ann.element(k)
+                if v is not None:
+                    out[k] = int(v)
+    return out
+
+
+def row_bytes(sdef) -> int:
+    """Bytes per buffered window row: payload columns (device dtypes via
+    event.dtype_of — STRING is an interned i32, DOUBLE an f32 on TPU)
+    plus the fixed Buffer bookkeeping columns."""
+    import numpy as np
+
+    from . import event as ev
+    n = ROW_OVERHEAD
+    for a in getattr(sdef, "attribute_list", ()):
+        try:
+            n += int(np.dtype(ev.dtype_of(a.type)).itemsize)
+        except Exception:  # noqa: BLE001 — OBJECT columns etc.
+            n += 8
+    return n
+
+
+def query_state_components(app, q, kind: str, part,
+                           caps: Dict[str, int],
+                           keys: int) -> Dict[str, int]:
+    """Per-component shape×dtype estimate of the device state the
+    planner would allocate for ONE query (windows and NFA slot blocks;
+    group-by slabs are bounded and small by comparison).  Empty dict
+    when the query holds no estimable state."""
+    defs = app.stream_definition_map
+
+    def stream_def(sid):
+        return defs.get(sid) or app.window_definition_map.get(sid)
+
+    hint = caps.get(
+        "window",
+        PARTITION_WINDOW_HINT if part is not None else WINDOW_HINT)
+    if kind == "plain":
+        win = window_handler(q.input_stream)
+        if win is None:
+            return {}
+        rows = window_capacity(win, hint)
+        per_key = rows * row_bytes(stream_def(q.input_stream.stream_id))
+        return {"window": per_key * (keys if part is not None else 1)}
+    if kind == "join":
+        out: Dict[str, int] = {}
+        for side, sis in (("join.left", q.input_stream.left_input_stream),
+                          ("join.right",
+                           q.input_stream.right_input_stream)):
+            win = window_handler(sis)
+            if win is not None:
+                out[side] = window_capacity(win, WINDOW_HINT) * \
+                    row_bytes(stream_def(sis.stream_id))
+        return out
+    # pattern: per-key NFA slot block — `slots` pending matches per key,
+    # each capturing one row per pattern state
+    atoms = pattern_atoms(q.input_stream.state_element)
+    slots = caps.get("slots", NFA_SLOTS)
+    per_state = max(
+        (row_bytes(stream_def(a.basic_single_input_stream.stream_id))
+         for a in atoms), default=ROW_OVERHEAD)
+    return {"pattern_slots": (keys if part is not None else 1) * slots *
+            max(1, len(atoms)) * per_state}
+
+
+def static_state_components(app) -> Dict[str, Dict[str, int]]:
+    """{query: {component: bytes}} static state estimate for every query
+    of a parsed (unplanned) app — THE shared MEM001/deploy-gate numbers.
+    Pure AST walk; never plans, traces, or allocates."""
+    out: Dict[str, Dict[str, int]] = {}
+    for name, q, part in iter_named_queries(app):
+        kind = query_kind(q)
+        caps = capacity_annotation(q, part)
+        keys = caps.get("keys", PARTITION_KEYS)
+        comps = query_state_components(app, q, kind, part, caps, keys)
+        if comps:
+            out[name] = comps
+    return out
+
+
+def static_state_bytes(app) -> int:
+    """Total static state estimate across the app's queries."""
+    return sum(sum(c.values())
+               for c in static_state_components(app).values())
+
+
+def format_component_bytes(comps: Dict[str, int],
+                           limit: int = 6) -> str:
+    """Human-facing component breakdown, largest first — the SAME string
+    shape in lint MEM001 findings and AdmissionDeniedError messages, so
+    an operator can line the two up by eye."""
+    items: List[Tuple[str, int]] = sorted(
+        comps.items(), key=lambda kv: (-kv[1], kv[0]))
+    parts = [f"{k}={v / (1024 * 1024):.1f} MiB" for k, v in items[:limit]]
+    if len(items) > limit:
+        parts.append(f"... +{len(items) - limit} more")
+    return ", ".join(parts)

@@ -170,16 +170,36 @@ def _wrap_query_callback(cb) -> Callable:
 
 
 class InputHandler:
-    """reference: CORE/stream/input/InputHandler.java:50"""
+    """reference: CORE/stream/input/InputHandler.java:50
+
+    This is the app's EXTERNAL ingest edge, so admission control
+    (core/admission.py) decides every send here: under an
+    `admission.max.events.per.sec` quota a send may block (caller
+    backpressure to a deadline), be shed (dropped, counted in
+    `siddhi_admission_shed_total`), or raise AdmissionDeniedError.
+    Internal re-routing (query outputs, fault streams, error-store
+    replay via `_admit=False`) is never throttled — shedding an event
+    the engine already accepted would be a silent loss."""
 
     def __init__(self, stream_id: str, runtime: "SiddhiAppRuntime"):
         self.stream_id = stream_id
         self._runtime = runtime
+        self._admit = True
+
+    def _admitted(self, n: int) -> bool:
+        if not self._admit:
+            return True
+        adm = getattr(self._runtime, "admission", None)
+        if adm is None or not adm.ingest_enabled:
+            return True
+        return adm.admit_ingest(self.stream_id, n)
 
     def send(self, data, timestamp: Optional[int] = None) -> None:
         """Accepts one event's data list/tuple, an Event, or a list of those."""
         self._runtime._gate_wait()     # entry valve, see _gate_wait
         events = self._to_events(data, timestamp)
+        if not self._admitted(len(events)):
+            return                     # shed at the edge (counted)
         self._runtime._route(self.stream_id, events)
 
     def _to_events(self, data, timestamp) -> List[ev.Event]:
@@ -206,6 +226,8 @@ class InputHandler:
         (Object[] ownership transfers, InputHandler.java:70); pass a copy
         if you need to keep writing into the array."""
         self._runtime._gate_wait()     # entry valve, see _gate_wait
+        if not self._admitted(len(cols[0]) if cols else 0):
+            return                     # shed at the edge (counted)
         self._runtime._route_columns(self.stream_id, cols, timestamps)
 
 
@@ -458,6 +480,13 @@ class PatternQueryRuntime(_MeshResolved):
         need = max(n_valid + n_dropped, cap * 2)
         new_cap = min(1 << (need - 1).bit_length(), self._EMIT_CAP_MAX)
         if new_cap <= cap:
+            return False
+        # admission: a regrow allocates a bigger emission block AND pays
+        # a recompile — past the state ceiling the growth is denied and
+        # the app sheds overflow at the current cap instead of OOMing
+        adm = getattr(self.app, "admission", None)
+        if adm is not None and not adm.admit_growth(
+                self.name, (new_cap - cap) * _row_nbytes(self)):
             return False
         import logging
         logging.getLogger("siddhi_tpu").warning(
@@ -1196,6 +1225,13 @@ class JoinQueryRuntime(_MeshResolved):
         new_rows = min(1 << (need - 1).bit_length(), self._EMIT_CAP_MAX)
         if cur is not None and new_rows <= cur:
             return False
+        # admission: deny growth past the state ceiling (see
+        # PatternQueryRuntime._grow_emission_cap) — overflow keeps
+        # dropping at the current cap, loudly, instead of OOMing
+        adm = getattr(self.app, "admission", None)
+        if adm is not None and not adm.admit_growth(
+                self.name, (new_rows - (cur or 0)) * _row_nbytes(self)):
+            return False
         logging.getLogger("siddhi_tpu").warning(
             "%s: %d join result rows dropped at emission capacity; growing "
             "the cap to %d (set @emit(rows='N') to pre-size and silence "
@@ -1466,16 +1502,29 @@ class StreamJunction:
         # threads (the reference's Disruptor ring,
         # StreamJunction.java:276-313).  None => synchronous dispatch.
         self._async_q = None
+        self._async_policy = "block"
+        self._async_shed_warn = 0.0
         self._async_workers: List[threading.Thread] = []
 
-    def enable_async(self, buffer_size: int = 256, workers: int = 1) -> None:
-        """Decouple ingestion: sends enqueue (bounded, blocking when full =
-        backpressure) and worker threads dispatch to the queries.  With
-        workers > 1, cross-batch ordering within the stream is relaxed —
-        same trade as the reference's multi-consumer Disruptor."""
+    def enable_async(self, buffer_size: int = 256, workers: int = 1,
+                     policy: str = "block") -> None:
+        """Decouple ingestion: sends enqueue (bounded) and worker threads
+        dispatch to the queries.  `queue.policy` picks the full-queue
+        behavior: 'block' (default) backpressures the producer — the
+        reference's Disruptor blocking-wait; 'shed' drops the send
+        loudly instead (`siddhi_async_shed_total{app,stream}`), for
+        feeds where stale events are worth less than producer liveness.
+        With workers > 1, cross-batch ordering within the stream is
+        relaxed — same trade as the reference's multi-consumer
+        Disruptor."""
         if self._async_q is not None:
             return
+        if policy not in ("block", "shed"):
+            raise CompileError(
+                f"@async(queue.policy={policy!r}) on {self.stream_id!r}: "
+                "policy must be 'block' or 'shed'")
         import queue
+        self._async_policy = policy
         self._async_q = queue.Queue(maxsize=max(1, buffer_size))
         for i in range(max(1, workers)):
             t = threading.Thread(
@@ -1501,7 +1550,31 @@ class StreamJunction:
             else:
                 self.publish(payload, now, ingest_ns=t_in)
             return
+        if self._async_policy == "shed":
+            import queue as _queue
+            try:
+                q.put_nowait((tag, payload, now, t_in))
+            except _queue.Full:
+                self._shed_async(tag, payload)
+            return
         q.put((tag, payload, now, t_in))
+
+    def _shed_async(self, tag: str, payload) -> None:
+        """@async(queue.policy='shed') full-queue drop: loud and counted
+        (`async.<stream>.shed` counter -> siddhi_async_shed_total,
+        sampler series, /healthz stream classification) — never a
+        silent loss."""
+        n = payload.n if tag == "staged" else len(payload)
+        stats = self.app.stats if self.app is not None else None
+        if stats is not None and stats.enabled:
+            stats.counter_inc(f"async.{self.stream_id}.shed", n)
+        t = time.monotonic()
+        if t - self._async_shed_warn >= 10.0:   # rate-limited
+            self._async_shed_warn = t
+            import logging
+            logging.getLogger("siddhi_tpu").warning(
+                "@async queue for %r full: shed %d events "
+                "(queue.policy='shed')", self.stream_id, n)
 
     def _drain_async(self) -> None:
         while True:
@@ -2336,6 +2409,15 @@ class SiddhiAppRuntime:
             elif isinstance(element, Partition):
                 qi = self._add_partition(element, qi)
 
+        # admission control: per-app quotas + overload ladder
+        # (core/admission.py).  Registered with the shared CompileGate
+        # HERE (not start()) — the first trace can happen before start()
+        # via a direct process call or EXPLAIN deep mode.
+        from .admission import AdmissionController
+        self.admission = AdmissionController(self)
+        self.admission.register_owners(
+            self.stats._owners_of(self) or [])
+
     # -- construction ---------------------------------------------------------
     def _define_stream_runtime(self, sdef: StreamDefinition):
         schema = ev.Schema(sdef, self.interner, objects=None)
@@ -2952,7 +3034,9 @@ class SiddhiAppRuntime:
                     if ann is not None:
                         j.enable_async(
                             int(ann.element("buffer.size", 256) or 256),
-                            int(ann.element("workers", 1) or 1))
+                            int(ann.element("workers", 1) or 1),
+                            str(ann.element("queue.policy", "block")
+                                or "block").lower())
             for sk in self.sinks:
                 sk.start()
             for src in self.sources:
@@ -3008,6 +3092,11 @@ class SiddhiAppRuntime:
             self._drainer.stop()
             self._scheduler.stop()
             self._started = False
+        # release this app's compile-gate owner labels whether or not it
+        # ever started (deploy-then-undeploy without traffic is common)
+        adm = getattr(self, "admission", None)
+        if adm is not None:
+            adm.unregister()
 
     def pause_sources(self) -> None:
         """reference: SiddhiAppRuntimeImpl pauses Sources around persist."""
@@ -3149,6 +3238,10 @@ class SiddhiAppRuntime:
                 skipped += 1
                 continue
             h = self.get_input_handler(entry.stream_id)
+            # replay is exactly-once recovery of events the engine
+            # already accepted — the admission rate limit must not
+            # shed them a second time
+            h._admit = False
             for e in entry.events:
                 h.send(e)
             n_entries += 1
@@ -3801,6 +3894,12 @@ class SiddhiManager:
         if isinstance(app, str):
             from ..compiler import SiddhiCompiler
             app = SiddhiCompiler.parse(app)
+        # deploy-time admission gate: the static state estimate is
+        # checked against the configured memory ceilings BEFORE the
+        # runtime is constructed — a denial provably precedes any
+        # planning, tracing, or device allocation (core/admission.py)
+        from .admission import check_deploy
+        check_deploy(app, self)
         runtime = SiddhiAppRuntime(app, self, mesh=mesh)
         self.runtimes[runtime.name] = runtime
         return runtime

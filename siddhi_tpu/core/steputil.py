@@ -114,7 +114,19 @@ def jit_step(fn, owner=None, **jit_kwargs):
 
     @functools.wraps(fn)
     def wrapped(*args, **kwargs):
-        if not RECOMPILES.suppressed():
+        if RECOMPILES.suppressed():
+            # diagnostic re-trace (EXPLAIN cost analysis): no recompile
+            # accounting AND no compile-gate admission — diagnostics
+            # must never queue behind (or penalize) real compiles
+            return strongify(fn(*args, **kwargs))
+        # this body only executes while jax traces a NEW signature, so
+        # the shared compile-admission gate (core/admission.py) wraps
+        # exactly the compile events: traces serialize process-wide and
+        # an app over its admission.max.recompiles.per.min budget pays
+        # its penalty before contending — a storming tenant's compiles
+        # queue behind everyone else instead of in front
+        from .admission import COMPILE_GATE
+        with COMPILE_GATE.admit(label):
             RECOMPILES.record(label, args)
             try:
                 spec_holder["argspecs"] = jax.tree.map(
@@ -122,11 +134,11 @@ def jit_step(fn, owner=None, **jit_kwargs):
                                                    x.aval.dtype), args)
             except Exception:  # noqa: BLE001 — accounting must not break
                 pass           # a trace (e.g. non-array leaves)
-        tr = tracing.active()
-        if tr is None:
-            return strongify(fn(*args, **kwargs))
-        with tracing.span("compile", owner=label):
-            return strongify(fn(*args, **kwargs))
+            tr = tracing.active()
+            if tr is None:
+                return strongify(fn(*args, **kwargs))
+            with tracing.span("compile", owner=label):
+                return strongify(fn(*args, **kwargs))
 
     jitted = jax.jit(wrapped, **jit_kwargs)
     try:

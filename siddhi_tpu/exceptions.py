@@ -71,6 +71,18 @@ class CapacityExceededError(SiddhiAppRuntimeError, RuntimeError):
     caught the untyped error."""
 
 
+class AdmissionDeniedError(SiddhiError):
+    """The admission controller (core/admission.py) refused the request:
+    a deploy whose static state estimate exceeds the configured memory
+    ceiling, or an ingest send that exhausted its `block` deadline.
+    `components` carries the per-component byte breakdown for memory
+    denials (the same breakdown lint MEM001 cites), empty otherwise."""
+
+    def __init__(self, message: str, components=None):
+        super().__init__(message)
+        self.components = dict(components or {})
+
+
 class OnDemandQueryCreationError(CompileError):
     """On-demand (store) query failed to compile (reference:
     OnDemandQueryCreationException)."""

@@ -176,5 +176,14 @@ class SourceRuntime:
                 self._pause_cv.wait()
         now = self.app.timestamp_millis()
         events = self.mapper.map(payload, now)
-        if events:
-            self.app._route(self.stream_id, events)
+        if not events:
+            return
+        # @source feeds are an EXTERNAL ingest edge exactly like
+        # InputHandler sends: the admission rate limit decides them too
+        # (block backpressures the transport's delivery thread; shed
+        # drops loudly, counted in siddhi_admission_shed_total)
+        adm = getattr(self.app, "admission", None)
+        if adm is not None and adm.ingest_enabled and \
+                not adm.admit_ingest(self.stream_id, len(events)):
+            return
+        self.app._route(self.stream_id, events)

@@ -423,9 +423,23 @@ def _lint_findings(rt, query_name: Optional[str]) -> List[Dict]:
         return []
 
 
+def _admission_entry(rt) -> Dict:
+    """{'admission': report} — the app's quota/ladder state rendered
+    into EXPLAIN so capacity questions and plan questions are answered
+    in one place (core/admission.py; attribute reads only)."""
+    adm = getattr(rt, "admission", None)
+    if adm is None:
+        return {}
+    try:
+        return {"admission": adm.report()}
+    except Exception:  # noqa: BLE001 — diagnostics must not throw
+        return {}
+
+
 def explain_app(rt, deep: bool = False) -> Dict:
     """EXPLAIN for every query of an app (shallow by default: skips the
     per-step compile for memory analysis)."""
     return {"app": rt.name,
+            **_admission_entry(rt),
             "queries": {q: explain_query(rt, q, deep=deep)
                         for q in sorted(rt.query_runtimes)}}

@@ -163,6 +163,26 @@ def render_prometheus(runtimes: Dict) -> str:
                  "Device-state bytes RESIDENT PER SHARD (sharded leaves "
                  "count their 1/n slice, replicated leaves count whole) "
                  "— layout metadata only, never fetched")
+    adm_shed = fam("siddhi_admission_shed_total", "counter",
+                   "Events shed at the external ingest edge by the "
+                   "admission rate limit, per stream "
+                   "(core/admission.py; shed/degrade overload policies)")
+    adm_blk = fam("siddhi_admission_blocked_ms_total", "counter",
+                  "Milliseconds callers spent blocked at the admission "
+                  "rate limit (overload='block' backpressure)")
+    adm_qs = fam("siddhi_admission_quota_state", "gauge",
+                 "Admission quota state per app: 0=ok 1=degraded "
+                 "(SLO ladder halved the rate) 2=shedding (state "
+                 "ceiling hit, growth denied)")
+    adm_gd = fam("siddhi_admission_growth_denials_total", "counter",
+                 "Emission-cap/state growths denied by the memory "
+                 "ceiling (the app sheds overflow instead of growing)")
+    adm_cp = fam("siddhi_admission_compile_penalties_total", "counter",
+                 "Compile-gate penalties applied to this app's traces "
+                 "for exceeding admission.max.recompiles.per.min")
+    a_shed = fam("siddhi_async_shed_total", "counter",
+                 "Events shed by a full bounded @async ingress queue "
+                 "under queue.policy='shed', per stream")
 
     for app_name, rt in sorted(runtimes.items()):
         st = rt.stats
@@ -200,6 +220,9 @@ def render_prometheus(runtimes: Dict) -> str:
             elif name.endswith(".emitted_bytes"):
                 e_byt.sample(n, app=app_name,
                              query=name[:-len(".emitted_bytes")])
+            elif name.startswith("async.") and name.endswith(".shed"):
+                a_shed.sample(n, app=app_name,
+                              stream=name[len("async."):-len(".shed")])
         buf_e.sample(rt.buffered_emissions(), app=app_name)
         for sid, n in sorted(rt.buffered_ingress().items()):
             buf_i.sample(n, app=app_name, stream=sid)
@@ -256,5 +279,28 @@ def render_prometheus(runtimes: Dict) -> str:
             except Exception:  # noqa: BLE001 — custom SPI must not
                 pass           # break the scrape
         r_fb.sample(getattr(rt, "restore_fallbacks", 0), app=app_name)
+        # admission controller counters: plain attribute reads off the
+        # per-app controller (core/admission.py) — still no device work
+        adm = getattr(rt, "admission", None)
+        if adm is not None:
+            from ..core.admission import QUOTA_GAUGE
+            for sid, n in sorted(adm.shed_by_stream.items()):
+                adm_shed.sample(n, app=app_name, stream=sid)
+            adm_blk.sample(adm.blocked_ms_total, app=app_name)
+            adm_qs.sample(QUOTA_GAUGE.get(adm.quota_state, 0),
+                          app=app_name)
+            adm_gd.sample(adm.growth_denials, app=app_name)
+            adm_cp.sample(adm.compile_penalties, app=app_name)
+
+    # process-wide admission families: deploys denied before a runtime
+    # existed, and the shared compile-gate queue depth
+    from ..core.admission import COMPILE_GATE, denied_deploys
+    fam("siddhi_admission_denied_deploys_total", "counter",
+        "App deployments denied by the admission memory gate before "
+        "any planning or compile (process-wide)").sample(
+            denied_deploys())
+    fam("siddhi_admission_compile_queue_depth", "gauge",
+        "Traces currently waiting at (or penalized before) the shared "
+        "XLA compile-admission gate").sample(COMPILE_GATE.waiting)
 
     return "\n".join(lines) + ("\n" if lines else "")

@@ -134,6 +134,7 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
     last_ms = snap.get("stream_last_ms", {})
     backlog = rt.buffered_ingress()
     qdepth = rt.queue_depths() if hasattr(rt, "queue_depths") else {}
+    counters = snap.get("counters", {})
     streams: Dict[str, Dict] = {}
     for sid in sorted(rt.junctions):
         if sid.startswith("!"):
@@ -142,7 +143,18 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         age_s = (now_ms - seen) / 1e3 if seen else None
         depth = int(backlog.get(sid, 0))
         queued = int(qdepth.get(sid, 0))
-        if depth > 0 or queued > 0:
+        # @async(queue.policy='shed') losses take precedence in the
+        # classification: a shedding queue IS full, but "backlogged"
+        # would hide that accepted-load is being dropped right now.
+        # "Actively" = sheds moved within the sliding window, or sheds
+        # have happened and the queue is still backed up (the first
+        # probe has no rate span yet).
+        async_shed = int(counters.get(f"async.{sid}.shed", 0))
+        shed_rate = _rate(rt, f"async_shed.{sid}", async_shed) \
+            if async_shed else 0.0
+        if async_shed and (shed_rate > 0 or depth > 0 or queued > 0):
+            status = "shedding"            # full queue actively dropping
+        elif depth > 0 or queued > 0:
             status = "backlogged"          # source alive, engine behind
         elif seen is None:
             status = "no-events" if st.enabled else "unknown"
@@ -151,7 +163,9 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         else:
             status = "ok"
         streams[sid] = {"last_event_age_s": age_s, "backlog": depth,
-                        "queue_depth": queued, "status": status}
+                        "queue_depth": queued, "status": status,
+                        **({"async_shed": async_shed}
+                           if async_shed else {})}
 
     # sink connection states (io/resilience.py): a BROKEN circuit means
     # events are being shed at the edge — the app still processes, so
@@ -201,6 +215,21 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
                                for r in slo.get("rules", {}).values()):
         degraded = True
 
+    # admission controller (core/admission.py): quota state, shed/
+    # blocked/denied counters, ladder level — attribute reads only.  A
+    # non-ok quota state flips the same `degraded` verdict a BROKEN
+    # sink does: the app still processes, but it is deliberately
+    # shedding or rate-halved
+    admission = None
+    adm = getattr(rt, "admission", None)
+    if adm is not None:
+        try:
+            admission = adm.report()
+            if admission.get("quota_state") != "ok":
+                degraded = True
+        except Exception:  # noqa: BLE001 — probe must not throw
+            admission = None
+
     report = {
         "started": started,
         "accepting_ingress": accepting,
@@ -212,6 +241,7 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         "degraded": degraded,
         **({"shards": shards} if shards is not None else {}),
         **({"slo": slo} if slo is not None else {}),
+        **({"admission": admission} if admission is not None else {}),
         "buffered_emissions": rt.buffered_emissions(),
         "drainer_queue_depth": rt.drainer_depth()
         if hasattr(rt, "drainer_depth") else 0,

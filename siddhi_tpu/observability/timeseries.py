@@ -194,6 +194,12 @@ def tenant_account(rt, snap: Optional[Dict] = None) -> Dict:
         "sink_retries": sink["retries"],
         "queue_depth": sum(rt.queue_depths().values())
         if hasattr(rt, "queue_depths") else 0,
+        # admission charges (core/admission.py): decided-not-discovered
+        # overload, so shed/blocked work is attributed per tenant too
+        "admission_shed": getattr(getattr(rt, "admission", None),
+                                  "shed_total", 0),
+        "admission_blocked_ms": getattr(getattr(rt, "admission", None),
+                                        "blocked_ms_total", 0),
     }
 
 
@@ -304,6 +310,27 @@ class TimeSeriesSampler:
                 rec("shard_skew", now, rep["event_skew_max_over_mean"])
         except Exception:  # noqa: BLE001 — metrics must not throw
             pass
+        # admission controller series (core/admission.py): the quota
+        # ladder's trajectory — shed/blocked counters, quota state, and
+        # the effective (possibly degraded) rate limit
+        adm = getattr(rt, "admission", None)
+        if adm is not None:
+            from ..core.admission import QUOTA_GAUGE
+            rec("admission_shed", now, adm.shed_total)
+            rec("admission_blocked_ms", now, adm.blocked_ms_total)
+            rec("admission_growth_denials", now, adm.growth_denials)
+            rec("admission_quota_state", now,
+                QUOTA_GAUGE.get(adm.quota_state, 0))
+            rec("admission_compile_penalties", now,
+                adm.compile_penalties)
+            eff = adm.effective_rate()
+            if eff is not None:
+                rec("admission_rate_limit", now, eff)
+        # @async(queue.policy='shed') losses, summed across streams
+        a_shed = sum(v for k, v in snap.get("counters", {}).items()
+                     if k.startswith("async.") and k.endswith(".shed"))
+        if a_shed:
+            rec("async_shed", now, a_shed)
         # derived windowed rates, recorded as series themselves so the
         # artifact carries the ev/s curve, not just the raw counter
         rate_w = min(60.0, self.window * self.interval_s)
@@ -316,6 +343,14 @@ class TimeSeriesSampler:
                 rec(dst, now, s.rate(rate_w))
         # SLO rules evaluate over the freshly-appended series
         rt._slo_state = self.slo.evaluate(name, rt, store, now)
+        # ... and the mitigation ladder climbs on the verdict: under
+        # admission.overload='degrade' a FIRING tick halves the app's
+        # effective ingest rate; sustained ok ticks recover it
+        if adm is not None:
+            try:
+                adm.on_slo(rt._slo_state, now)
+            except Exception:  # noqa: BLE001 — ladder must not kill tick
+                pass
 
     # -- thread lifecycle ------------------------------------------------------
     def start(self) -> "TimeSeriesSampler":

@@ -56,6 +56,16 @@ Endpoints (JSON in/out):
   POST   /profiler/start  body={"log_dir"?} -> start a guarded jax.profiler
                                                session (409 if running)
   POST   /profiler/stop                     -> stop it (409 if not running)
+  GET    /siddhi-apps/<name>/admission      -> admission-control report:
+                                               overload policy, quota
+                                               state, effective rate,
+                                               shed/blocked/denied
+                                               counters (core/admission)
+  PUT    /siddhi-apps/<name>/admission body={"overload"?, "max.events.
+                                               per.sec"?, "max.state.
+                                               bytes"?, ...} -> update
+                                               the app's quotas live;
+                                               returns the new report
   GET    /siddhi-apps/<name>/error-store    -> error-store stats + captured
                                                entries (?stream=S filters;
                                                ?limit=N caps entries)
@@ -160,6 +170,15 @@ class SiddhiRestService:
                             self._json(404, {"error": "no such app"})
                         else:
                             self._json(200, rt.analyze())
+                    elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "admission":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                        else:
+                            self._json(200, {
+                                "app": parts[1],
+                                **rt.admission.report()})
                     elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                             and parts[2] == "timeseries":
                         rt = svc.manager.runtimes.get(parts[1])
@@ -292,6 +311,26 @@ class SiddhiRestService:
                         rows = rt.query(req["query"])
                         self._json(200, {
                             "records": [list(e.data) for e in rows]})
+                    else:
+                        self._json(404, {"error": "unknown path"})
+                except SiddhiError as exc:
+                    self._json(400, {"error": str(exc)})
+                except Exception as exc:  # noqa: BLE001 — HTTP boundary
+                    self._json(500, {"error": repr(exc)})
+
+            def do_PUT(self):
+                try:
+                    parts = [p for p in self.path.split("/") if p]
+                    if len(parts) == 3 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "admission":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                            return
+                        req = json.loads(self._body() or b"{}")
+                        self._json(200, {
+                            "app": parts[1],
+                            **rt.admission.configure(req)})
                     else:
                         self._json(404, {"error": "unknown path"})
                 except SiddhiError as exc:

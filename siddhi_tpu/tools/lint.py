@@ -56,6 +56,12 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="PART002 deploy target: shard-mesh device count "
                         "the app will serve on (default: unknown — "
                         "PART002 stays silent)")
+    p.add_argument("--global-ceiling", type=int, default=0,
+                   metavar="BYTES",
+                   help="ADM001 deploy target: the box's "
+                        "admission.global.max.state.bytes ceiling "
+                        "(default: unknown — ADM001's size half stays "
+                        "silent)")
     p.add_argument("--rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
@@ -88,6 +94,8 @@ def main(argv: List[str] | None = None) -> int:
         config.state_budget_bytes = args.state_budget
     if args.mesh_size:
         config.mesh_devices = args.mesh_size
+    if args.global_ceiling:
+        config.global_state_ceiling_bytes = args.global_ceiling
     threshold = severity_rank(_FAIL_LEVELS[args.fail_on])
 
     failed = False
