@@ -4,10 +4,11 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test lint lint-apps lint-smoke dryrun bench metrics-smoke \
 	fuse-smoke explain-smoke chaos-smoke multichip-smoke soak-smoke \
-	admission-smoke all
+	admission-smoke audit audit-update audit-smoke docgen-check all
 
-all: lint lint-apps test dryrun metrics-smoke fuse-smoke explain-smoke \
-	lint-smoke chaos-smoke multichip-smoke soak-smoke admission-smoke
+all: lint lint-apps docgen-check audit test dryrun metrics-smoke \
+	fuse-smoke explain-smoke lint-smoke chaos-smoke multichip-smoke \
+	soak-smoke admission-smoke audit-smoke
 
 # static gate on our own code: ruff (rule set in pyproject.toml) when
 # available, with compileall kept as the syntax floor for samples and
@@ -29,6 +30,32 @@ lint-apps:
 # agreement (static-analysis layer, README "Static analysis")
 lint-smoke:
 	$(CPU_ENV) $(PY) samples/lint_smoke.py
+
+# plan-audit gate: fingerprint the corpus (samples + bench shapes) and
+# diff against the committed PLAN_BASELINE.json — exit 1 on any
+# flops/bytes/memory/collectives regression (README "Plan audit")
+audit:
+	$(CPU_ENV) $(PY) -m siddhi_tpu.tools.audit check
+
+# refresh the baseline after an INTENTIONAL plan change (commit the
+# rewritten PLAN_BASELINE.json and say why in the PR)
+audit-update:
+	$(CPU_ENV) $(PY) -m siddhi_tpu.tools.audit update
+
+# exit-code contract end-to-end through the real CLI: HEAD clean,
+# injected flops/bytes/collectives regression -> 1, missing baseline
+# -> 2, diff informational -> 0
+audit-smoke:
+	$(CPU_ENV) $(PY) samples/audit_smoke.py
+
+# regenerate the committed docgen pages (lint rule catalog + audit
+# metric/tolerance table) and fail on drift from the registries
+docgen-check:
+	$(CPU_ENV) $(PY) -m siddhi_tpu.tools.docgen /tmp/siddhi_docs_check
+	diff -u docs/extensions/lint-rules.md \
+		/tmp/siddhi_docs_check/lint-rules.md
+	diff -u docs/extensions/audit-metrics.md \
+		/tmp/siddhi_docs_check/audit-metrics.md
 
 test:
 	$(CPU_ENV) $(PY) -m pytest tests/ -q

@@ -21,24 +21,16 @@ BATCH = 1 << 17           # 131072 keys per micro-batch (524288 events/send)
 SLOTS = 4
 SWEEPS = 4                # timed sweeps over all keys x 4 stages
 
-QL_TEMPLATE = """
-@app:playback
-{async_ann}
-define stream TradeStream (key long, price float, volume int);
-partition with (key of TradeStream)
-begin
-  @capacity(keys='{n_keys}', slots='{slots}')
-  @emit(rows='2')
-  {pipe_ann}
-  @info(name='flagship')
-  from every e1=TradeStream[volume == 1]
-       -> e2=TradeStream[volume == 2 and price >= e1.price]
-       -> e3=TradeStream[volume == 3]
-       -> e4=TradeStream[volume == 4 and price >= e3.price]
-  select e1.key as k, e1.price as p1, e2.price as p2, e4.price as p4
-  insert into Matches;
-end;
-"""
+# the serving shapes live in siddhi_tpu/analysis/corpus.py — ONE set of
+# strings the benchmark drives and the plan-audit gate
+# (`python -m siddhi_tpu.tools.audit`) fingerprints, so they cannot drift
+from siddhi_tpu.analysis.corpus import (  # noqa: E402
+    FLAGSHIP_QL_TEMPLATE as QL_TEMPLATE,
+    MC_FLAGSHIP_QL,
+    MC_JOIN_QL,
+    SEQUENCE_QL,
+    WINDOWED_JOIN_QL,
+)
 
 
 def run_tpu(async_ingest: bool = False, pipeline: bool = False):
@@ -263,18 +255,9 @@ def config_time_groupby_having(n_batches=16, B=1 << 17, n_sym=256):
 
 
 def config_windowed_join(n_batches=16, B=1 << 13, n_sym=64):
-    """#3: two-stream window.length join on symbol."""
-    ql = """
-    @app:playback
-    define stream L (symbol long, price float);
-    define stream R (symbol long, qty int);
-    @emit(rows='65536')
-    @info(name='q')
-    from L#window.length(128) join R#window.length(128)
-      on L.symbol == R.symbol
-    select L.symbol as s, L.price as p, R.qty as v
-    insert into Out;
-    """
+    """#3: two-stream window.length join on symbol (the audit-corpus
+    shape — siddhi_tpu/analysis/corpus.py WINDOWED_JOIN_QL)."""
+    ql = WINDOWED_JOIN_QL
     from siddhi_tpu import SiddhiManager
     manager = SiddhiManager()
     rt = manager.create_siddhi_app_runtime(ql)
@@ -375,20 +358,6 @@ def flagship_small_batch(B, n_sends=64):
     dt = time.perf_counter() - t0
     manager.shutdown()
     return total / dt, _lat_stats(lat)
-
-
-SEQUENCE_QL = """
-@app:playback
-define stream S (symbol long, price float, volume int);
-@capacity(keys='1', slots='8')
-@emit(rows='4096')
-{ann}
-@info(name='q')
-from every e1=S[volume == 1], e2=S[volume == 2 and price > e1.price]
-  within 1 sec
-select e1.price as p1, e2.price as p2
-insert into M;
-"""
 
 
 def _sequence_staged(B, k, interner):
@@ -749,37 +718,6 @@ def run_cost_analysis(B=1 << 12, n_keys=1 << 12):
                       "state_bytes": rep["state"]["component_bytes"]}
         m.shutdown()
     print(json.dumps({"mode": "cost_analysis", **out}))
-
-
-MC_FLAGSHIP_QL = """
-@app:playback
-define stream TradeStream (key long, price float, volume int);
-partition with (key of TradeStream)
-begin
-  @capacity(keys='{keys}', slots='4')
-  @emit(rows='2')
-  @fuse(batches='4')
-  @info(name='flagship')
-  from every e1=TradeStream[volume == 1]
-       -> e2=TradeStream[volume == 2 and price >= e1.price]
-       -> e3=TradeStream[volume == 3]
-       -> e4=TradeStream[volume == 4 and price >= e3.price]
-  select e1.key as k, e1.price as p1, e2.price as p2, e4.price as p4
-  insert into Matches;
-end;
-"""
-
-MC_JOIN_QL = """
-@app:playback
-define stream JL (sym long, price float);
-define stream JR (sym long, qty int);
-@emit(rows='65536')
-@info(name='wjoin')
-from JL#window.length(64) join JR#window.length(64)
-  on JL.sym == JR.sym
-select JL.sym as s, JL.price as p, JR.qty as q
-insert into JOut;
-"""
 
 
 def _mc_mesh(n):

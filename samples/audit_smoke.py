@@ -1,0 +1,90 @@
+"""Plan-audit smoke: corpus clean at HEAD + the CLI exit-code contract.
+
+Asserts, through the REAL CLI (subprocesses, same as CI):
+
+1. `audit check` against the committed PLAN_BASELINE.json exits 0 —
+   this checkout's compiled plans match their pinned fingerprints.
+2. An injected regression (baseline flops/bytes scaled down so HEAD
+   exceeds tolerance, plus a collective kind removed so HEAD "adds"
+   one) makes `audit check` exit 1 and name the metric.
+3. A missing baseline exits 2 (error, distinct from regression).
+4. `audit diff` is informational: exit 0 even against the doctored
+   baseline.
+
+Run: JAX_PLATFORMS=cpu python samples/audit_smoke.py   (make audit-smoke)
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(ROOT, "PLAN_BASELINE.json")
+
+ENV = dict(os.environ, JAX_PLATFORMS="cpu")
+ENV.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+
+def run_audit(*args):
+    p = subprocess.run(
+        [sys.executable, "-m", "siddhi_tpu.tools.audit", *args],
+        capture_output=True, text=True, cwd=ROOT, env=ENV, timeout=600)
+    return p.returncode, p.stdout, p.stderr
+
+
+def main():
+    # 1. HEAD is clean against the committed baseline
+    code, out, err = run_audit("check")
+    assert code == 0, f"audit check failed at HEAD (exit {code}):\n" \
+        f"{out}\n{err}"
+    assert "0 regression(s)" in out, out
+    print("audit-smoke: HEAD clean vs committed baseline")
+
+    # 2. injected regression -> exit 1, metric named
+    with open(BASELINE) as fh:
+        doctored = json.load(fh)
+    hits = 0
+    for shape in doctored["corpus"].values():
+        for fp in shape["queries"].values():
+            for step in fp["steps"].values():
+                # shrink the pinned cost so HEAD's real cost reads as
+                # an over-tolerance increase
+                step["flops"] = (step.get("flops") or 1) * 0.5
+                step["bytes_accessed"] = \
+                    (step.get("bytes_accessed") or 1) * 0.5
+                if step.get("collectives"):
+                    step["collectives"] = []
+                    hits += 1
+    assert hits, "expected at least one sharded step with collectives"
+    with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                     delete=False) as fh:
+        json.dump(doctored, fh)
+        doctored_path = fh.name
+    try:
+        code, out, err = run_audit("check", "--baseline",
+                                   doctored_path)
+        assert code == 1, f"doctored baseline must exit 1, got " \
+            f"{code}:\n{out}\n{err}"
+        assert "REGRESSION" in out and "flops" in out, out
+        assert "new collective op" in out, out
+        print("audit-smoke: injected flops/bytes/collectives "
+              "regression -> exit 1")
+
+        # 4. diff is informational even against the doctored baseline
+        code, out, err = run_audit("diff", "--baseline", doctored_path)
+        assert code == 0, f"diff must exit 0, got {code}:\n{err}"
+        print("audit-smoke: diff stays informational (exit 0)")
+    finally:
+        os.unlink(doctored_path)
+
+    # 3. missing baseline -> exit 2
+    code, out, err = run_audit("check", "--baseline",
+                               os.path.join(ROOT, "nope.json"))
+    assert code == 2, f"missing baseline must exit 2, got {code}"
+    print("audit-smoke: missing baseline -> exit 2")
+    print("audit-smoke: OK")
+
+
+if __name__ == "__main__":
+    main()

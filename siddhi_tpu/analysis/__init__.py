@@ -15,6 +15,15 @@ the app.
 Surfaces: `python -m siddhi_tpu.tools.lint app.siddhi`,
 `runtime.analyze()`, `GET /siddhi-apps/<app>/lint`, and findings echoed
 into `explain()` reports.
+
+The package also hosts the plan auditor (`analysis/audit.py` +
+`python -m siddhi_tpu.tools.audit`): per-query compiled-plan cost
+fingerprints (flops/bytes/memory/collectives via the EXPLAIN
+re-lowering path at canonical synthesized signatures —
+`analysis/signatures.py`) diffed against the checked-in
+PLAN_BASELINE.json, and the expression type/null-flow inference pass
+(`analysis/typeflow.py`) that NULL001/JOIN002 and the fingerprints
+consume.
 """
 from .driver import analyze, report
 from .findings import ERROR, INFO, SEVERITIES, WARN, Finding, counts, \

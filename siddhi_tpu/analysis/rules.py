@@ -174,6 +174,35 @@ def _join_cap_overflow(ctx: AnalysisContext) -> Iterator[Finding]:
                      "are dropped", query=f.name, node=f.query)
 
 
+@rule("JOIN002", "INFO",
+      "equi-join evaluated as a full cross-product grid",
+      "The join ON-condition has a top-level equality conjunct, but the "
+      "compiled plan still evaluates the full [rows × rows] grid every "
+      "batch — this is the windowed_join 100× outlier (ROADMAP item 2: "
+      "bucket both sides by the equality key on device, "
+      "IndexEventHolder-style, and evaluate only intra-bucket pairs).  "
+      "Bytes-accessed scales with the grid, not the matches.",
+      "no action needed today — this flags plans that will benefit "
+      "from the ROADMAP item-2 equi-join fast path; shrink the windows "
+      "if the grid cost already hurts")
+def _equi_join_grid(ctx: AnalysisContext) -> Iterator[Finding]:
+    from .typeflow import infer_query
+    for f in ctx.queries:
+        if f.kind != "join":
+            continue
+        try:
+            flow = infer_query(ctx.app, f.name, f.query, "join", {})
+        except Exception:  # noqa: BLE001 — inference must not kill lint
+            continue
+        for node, left, right in flow.equi_conjuncts:
+            yield _f(f"ON-condition equality {left} == {right} is "
+                     "evaluated as a full grid — the equi-join fast "
+                     "path (ROADMAP item 2) would bucket by key and "
+                     "probe only intra-bucket pairs", query=f.name,
+                     node=node if getattr(node, "pos", None)
+                     else f.query)
+
+
 # ---------------------------------------------------------------------------
 # dataflow
 # ---------------------------------------------------------------------------
@@ -423,6 +452,66 @@ def _lossy_filter_compare(ctx: AnalysisContext) -> Iterator[Finding]:
                     break
 
 
+@rule("NULL001", "WARN",
+      "nullable attribute hits the in-band null encoding's divergences",
+      "Nulls are in-band reserved values on device (INT/LONG use the "
+      "dtype minimum, BOOL has no spare value — PARITY.md).  When the "
+      "null-flow pass proves an attribute can be null (outer-join "
+      "unmatched side, optional pattern atom, empty-set aggregation) "
+      "and it flows into a compare or arithmetic, semantics diverge "
+      "from the reference: a legitimate INT_MIN/LONG_MIN value is "
+      "treated as null, and a null BOOL compares as False instead of "
+      "making the comparison false.  This is the static half of "
+      "ROADMAP item 5 (validity bit-planes delete the divergence).",
+      "guard with `is null` / coalesce() before comparing, use a "
+      "FLOAT/DOUBLE column (NaN null is out-of-band for comparisons), "
+      "or accept the documented INT_MIN-as-value semantics")
+def _nullable_sentinel_flow(ctx: AnalysisContext) -> Iterator[Finding]:
+    from ..query_api import expression as ex
+    from .typeflow import SENTINEL_DIVERGENT, infer_app
+    try:
+        flow = infer_app(ctx.app)
+    except Exception:  # noqa: BLE001 — inference must not kill lint
+        return
+    for f in ctx.queries:
+        qf = flow.queries.get(f.name)
+        if qf is None:
+            continue
+        seen = set()
+        for use in qf.uses:
+            if not isinstance(use.node, (ex.Compare, ex.Add,
+                                         ex.Subtract, ex.Multiply,
+                                         ex.Divide, ex.Mod)):
+                continue
+            if use.context == "on":
+                continue      # join ON null-keys simply never match
+            for side, info in zip((use.node.left, use.node.right),
+                                  use.operands):
+                if not info.nullable or \
+                        info.type not in SENTINEL_DIVERGENT:
+                    continue
+                if id(use.node) in seen:
+                    break
+                seen.add(id(use.node))
+                what = side.attribute_name \
+                    if isinstance(side, ex.Variable) else "expression"
+                op = "compared" if isinstance(use.node, ex.Compare) \
+                    else "used in arithmetic"
+                divergence = (
+                    "null decodes as False, so `== false` matches "
+                    "nulls" if info.type == "BOOL" else
+                    f"a legitimate {info.type}_MIN value is treated "
+                    "as null")
+                yield _f(
+                    f"nullable {info.type} {what!r} "
+                    f"({info.why or 'null-flow'}) is {op} — "
+                    f"{divergence}; reference semantics diverge "
+                    "(PARITY.md in-band nulls)", query=f.name,
+                    node=use.node if getattr(use.node, "pos", None)
+                    else f.query)
+                break
+
+
 # ---------------------------------------------------------------------------
 # rate limiting
 # ---------------------------------------------------------------------------
@@ -600,7 +689,7 @@ def _admission_hazards(ctx: AnalysisContext) -> Iterator[Finding]:
 
 
 ALL_RULE_IDS: List[str] = [
-    "STATE001", "STATE002", "MEM001", "FUSE001", "JOIN001",
-    "DEAD001", "DEAD002", "PART001", "PART002", "TYPE001", "RATE001",
-    "APP001", "SINK001", "ADM001",
+    "STATE001", "STATE002", "MEM001", "FUSE001", "JOIN001", "JOIN002",
+    "DEAD001", "DEAD002", "NULL001", "PART001", "PART002", "TYPE001",
+    "RATE001", "APP001", "SINK001", "ADM001",
 ]

@@ -1,0 +1,521 @@
+"""Expression-level static type & null-flow inference.
+
+Reference (what): the reference type-checks every ExpressionExecutor at
+parse time (returnType on each executor) and passes boxed nulls through
+every operator.  TPU design (how): our nulls are IN-BAND reserved
+values (INT/LONG dtype minimum, FLOAT/DOUBLE NaN, BOOL has no spare
+value — PARITY.md "Numeric nulls"), so knowing *which attributes can
+actually be null* is a static property worth computing: it decides
+where the in-band encoding diverges from reference semantics (a
+legitimate INT_MIN decodes as None; a null BOOL decodes as False) and
+it is exactly the per-column fact a validity bit-plane (ROADMAP item 5)
+would materialize.
+
+This pass walks the parsed app only — no runtime, no jax — and infers
+for every AST expression a `TypeInfo(type, nullable, why)`:
+
+- types mirror `core.executor.compile_expression`'s promotion rules
+  (the ONE `promote()` implementation is imported, not re-listed);
+- nullability ORIGINATES at outer-join non-preserved sides, optional
+  pattern atoms (`or` branches, `count` atoms with min 0, absent
+  streams), and empty-set aggregations (`min`/`max`/`avg`/`sum`), then
+  PROPAGATES through arithmetic, selectors, and inserted-into streams
+  to downstream queries (fixpoint over the app's dataflow);
+- `coalesce` clears nullability unless every argument is nullable;
+  comparisons and boolean operators always yield non-null BOOL (the
+  device lowers null compares to false).
+
+Consumers: the plan auditor records per-query output types/nullability
+in fingerprints (analysis/audit.py), and lint rule NULL001 flags
+nullable INT/LONG/BOOL attributes flowing into compares/arithmetic —
+the static half of the ROADMAP item-5 divergence list.  JOIN002 uses
+the same query walk to spot equi-join conjuncts (ROADMAP item 2).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from ..query_api import expression as ex
+from ..query_api.app import SiddhiApp
+from ..query_api.query import (
+    AbsentStreamStateElement,
+    CountStateElement,
+    EveryStateElement,
+    JoinInputStream,
+    LogicalStateElement,
+    NextStateElement,
+    Query,
+    StreamStateElement,
+)
+
+# type promotion is the executor's (core/executor.py promote) — imported
+# so this pass can never disagree with what the device computes
+from ..core.executor import promote as _promote
+
+NUMERIC = ("INT", "LONG", "FLOAT", "DOUBLE")
+# in-band null encodings diverge from reference semantics for these
+# types: INT/LONG reserve the dtype minimum (a legitimate INT_MIN is
+# treated as null), BOOL has no spare value (null decodes as False)
+SENTINEL_DIVERGENT = ("INT", "LONG", "BOOL")
+
+# empty-set aggregations return null in the reference (count does not)
+_NULLABLE_AGGS = {"min", "max", "avg", "sum", "stdDev", "first", "last",
+                  "minForever", "maxForever"}
+_AGG_TYPES = {"count": "LONG", "distinctCount": "LONG", "avg": "DOUBLE",
+              "stdDev": "DOUBLE"}
+
+
+@dataclasses.dataclass(frozen=True)
+class TypeInfo:
+    """Inferred static type of one expression node."""
+
+    type: Optional[str]          # INT|LONG|FLOAT|DOUBLE|BOOL|STRING|OBJECT
+    nullable: bool = False
+    why: Optional[str] = None    # provenance of the nullability
+
+    def to_dict(self) -> Dict:
+        d: Dict = {"type": self.type, "nullable": self.nullable}
+        if self.nullable and self.why:
+            d["why"] = self.why
+        return d
+
+
+_NOT_NULL_BOOL = TypeInfo("BOOL", False)
+
+
+@dataclasses.dataclass
+class ExprUse:
+    """One analyzed expression occurrence inside a query: the AST node,
+    its inferred TypeInfo, per-operand infos (for binary nodes), and
+    where it sits (filter | select | having | on | group_by)."""
+
+    node: object
+    info: TypeInfo
+    context: str
+    operands: Tuple[TypeInfo, ...] = ()
+
+
+@dataclasses.dataclass
+class QueryTypeFlow:
+    """Everything the pass inferred about one query."""
+
+    name: str
+    kind: str                                    # plain | join | pattern
+    outputs: List[Dict]                          # [{name, type, nullable, why?}]
+    uses: List[ExprUse]
+    # join only: the ON-condition's top-level equality conjuncts across
+    # sides, [(Compare node, left attr, right attr)] — JOIN002's facts
+    equi_conjuncts: List[Tuple[object, str, str]] = \
+        dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class AppTypeFlow:
+    """Per-app inference result: stream-attribute nullability (after the
+    dataflow fixpoint) and per-query flows."""
+
+    streams: Dict[str, Dict[str, TypeInfo]]
+    queries: Dict[str, QueryTypeFlow]
+
+
+# ---------------------------------------------------------------------------
+# variable resolution
+# ---------------------------------------------------------------------------
+
+class _Resolver:
+    """Resolves Variables for one query against its input sources.
+
+    `sources` maps source key (stream id or pattern ref) to
+    (definition, extra-nullability map, why).  Unqualified attribute
+    names search all sources in order."""
+
+    def __init__(self, app: SiddhiApp,
+                 stream_nulls: Dict[str, Dict[str, TypeInfo]],
+                 inferred_types: Optional[Dict[str, Dict[str, str]]]
+                 = None):
+        self.app = app
+        self.stream_nulls = stream_nulls
+        # attribute types of INFERRED streams (insert-into targets with
+        # no `define stream`), learned from the producing query's
+        # selector during the fixpoint
+        self.inferred_types = inferred_types or {}
+        # key -> (stream_id, source_nullable, why)
+        self.sources: List[Tuple[str, str, bool, Optional[str]]] = []
+        self.bound: Dict[str, TypeInfo] = {}   # selector output aliases
+
+    def add(self, key: str, stream_id: str, nullable: bool = False,
+            why: Optional[str] = None) -> None:
+        self.sources.append((key, stream_id, nullable, why))
+
+    def _definition(self, stream_id: str):
+        app = self.app
+        return (app.stream_definition_map.get(stream_id)
+                or app.window_definition_map.get(stream_id)
+                or app.table_definition_map.get(stream_id))
+
+    def resolve(self, var: ex.Variable) -> TypeInfo:
+        name = var.attribute_name
+        order = [s for s in self.sources
+                 if var.stream_id in (None, s[0], s[1])] \
+            if var.stream_id else list(self.sources)
+        for _, sid, src_null, src_why in order:
+            d = self._definition(sid)
+            t = None
+            if d is not None:
+                try:
+                    t = d.attribute_type(name)
+                except (KeyError, AttributeError):
+                    t = None
+            if t is None:
+                t = self.inferred_types.get(sid, {}).get(name)
+            if t is None:
+                continue
+            flow = self.stream_nulls.get(sid, {}).get(name)
+            nullable = bool(src_null or (flow and flow.nullable))
+            why = src_why if src_null else (flow.why if flow else None)
+            return TypeInfo(t, nullable, why)
+        if name in self.bound:
+            return self.bound[name]
+        return TypeInfo(None, False)
+
+
+# ---------------------------------------------------------------------------
+# expression inference
+# ---------------------------------------------------------------------------
+
+def infer_expr(e, resolver: _Resolver) -> TypeInfo:
+    """TypeInfo of one expression tree (mirrors executor semantics)."""
+    if e is None:
+        return TypeInfo(None, False)
+    if isinstance(e, ex.Constant):
+        return TypeInfo(e.type, False)
+    if isinstance(e, ex.Variable):
+        return resolver.resolve(e)
+    if isinstance(e, (ex.Add, ex.Subtract, ex.Multiply, ex.Divide,
+                      ex.Mod)):
+        li = infer_expr(e.left, resolver)
+        ri = infer_expr(e.right, resolver)
+        t = _promote(li.type, ri.type) \
+            if li.type in NUMERIC and ri.type in NUMERIC else li.type
+        n = li.nullable or ri.nullable
+        why = li.why if li.nullable else ri.why
+        return TypeInfo(t, n, f"arithmetic over nullable operand ({why})"
+                        if n else None)
+    if isinstance(e, (ex.Compare, ex.And, ex.Or, ex.Not, ex.IsNull,
+                      ex.In)):
+        # device compare with null is false; boolean ops never yield null
+        return _NOT_NULL_BOOL
+    if isinstance(e, ex.AttributeFunction):
+        return _infer_function(e, resolver)
+    return TypeInfo(None, False)
+
+
+def _const_str(p) -> Optional[str]:
+    return p.value if isinstance(p, ex.Constant) and \
+        isinstance(p.value, str) else None
+
+
+def _infer_function(e: ex.AttributeFunction,
+                    resolver: _Resolver) -> TypeInfo:
+    name = e.name
+    params = [infer_expr(p, resolver) for p in e.parameters]
+    if name in ("cast", "convert") and len(e.parameters) >= 2:
+        target = (_const_str(e.parameters[1]) or "").upper()
+        target = {"INTEGER": "INT"}.get(target, target)
+        src = params[0]
+        if target in NUMERIC + ("STRING", "BOOL", "OBJECT"):
+            return TypeInfo(target, src.nullable, src.why)
+        return TypeInfo(src.type, src.nullable, src.why)
+    if name == "coalesce" and params:
+        # first non-null argument: nullable only when EVERY arg is
+        t = params[0].type
+        for p in params[1:]:
+            if t in NUMERIC and p.type in NUMERIC:
+                t = _promote(t, p.type)
+        if all(p.nullable for p in params):
+            return TypeInfo(t, True, params[0].why)
+        return TypeInfo(t, False)
+    if name == "ifThenElse" and len(params) == 3:
+        a, b = params[1], params[2]
+        t = _promote(a.type, b.type) \
+            if a.type in NUMERIC and b.type in NUMERIC else a.type
+        n = a.nullable or b.nullable
+        return TypeInfo(t, n, a.why if a.nullable else b.why)
+    if name in _AGG_TYPES or name in _NULLABLE_AGGS:
+        t = _AGG_TYPES.get(name) or (params[0].type if params else None)
+        if name in _NULLABLE_AGGS:
+            return TypeInfo(t, True,
+                            f"{name}() over an empty set yields null")
+        return TypeInfo(t, False)
+    if name in ("str", "concat", "upper", "lower", "trim", "UUID",
+                "currentTimeMillis"):
+        t = "STRING" if name != "currentTimeMillis" else "LONG"
+        n = any(p.nullable for p in params)
+        return TypeInfo(t, n, next((p.why for p in params
+                                    if p.nullable), None))
+    # unknown function: type unknown, null flows through
+    n = any(p.nullable for p in params)
+    return TypeInfo(None, n, next((p.why for p in params
+                                   if p.nullable), None))
+
+
+# ---------------------------------------------------------------------------
+# per-query source wiring (where nullability ORIGINATES)
+# ---------------------------------------------------------------------------
+
+def _optional_pattern_refs(el, optional: bool = False
+                           ) -> Iterator[Tuple[str, str, bool, str]]:
+    """(ref key, stream id, nullable, why) for every pattern atom.
+    An atom is optional — its captured event may be absent in an emitted
+    match — inside an `or` branch, a `count` with min 0, or an absent
+    element."""
+    if isinstance(el, StreamStateElement):
+        sis = el.basic_single_input_stream
+        key = sis.stream_reference_id or sis.stream_id
+        why = "optional pattern atom: match may emit without it" \
+            if optional else None
+        kind = "absent pattern stream" \
+            if isinstance(el, AbsentStreamStateElement) else why
+        yield (key, sis.stream_id,
+               optional or isinstance(el, AbsentStreamStateElement),
+               kind or "")
+    elif isinstance(el, CountStateElement):
+        yield from _optional_pattern_refs(
+            el.stream_state_element,
+            optional or el.min_count == 0)
+    elif isinstance(el, LogicalStateElement):
+        branch_optional = optional or el.type == "OR"
+        yield from _optional_pattern_refs(el.stream_state_element_1,
+                                          branch_optional)
+        yield from _optional_pattern_refs(el.stream_state_element_2,
+                                          branch_optional)
+    elif isinstance(el, NextStateElement):
+        yield from _optional_pattern_refs(el.state_element, optional)
+        yield from _optional_pattern_refs(el.next_state_element, optional)
+    elif isinstance(el, EveryStateElement):
+        yield from _optional_pattern_refs(el.state_element, optional)
+
+
+def _build_resolver(app: SiddhiApp, q: Query, kind: str,
+                    stream_nulls, inferred_types=None) -> _Resolver:
+    r = _Resolver(app, stream_nulls, inferred_types)
+    ist = q.input_stream
+    if kind == "plain":
+        sis = ist
+        r.add(sis.stream_reference_id or sis.stream_id, sis.stream_id)
+    elif kind == "join":
+        jt = ist.type
+        for side, sis, nullable_when in (
+                ("left", ist.left_input_stream,
+                 (JoinInputStream.RIGHT_OUTER_JOIN,
+                  JoinInputStream.FULL_OUTER_JOIN)),
+                ("right", ist.right_input_stream,
+                 (JoinInputStream.LEFT_OUTER_JOIN,
+                  JoinInputStream.FULL_OUTER_JOIN))):
+            nullable = jt in nullable_when
+            r.add(sis.stream_reference_id or sis.stream_id,
+                  sis.stream_id, nullable,
+                  f"{jt.lower().replace('_', ' ')}: unmatched rows null "
+                  f"the {side} side" if nullable else None)
+    else:
+        for key, sid, nullable, why in _optional_pattern_refs(
+                ist.state_element):
+            r.add(key, sid, nullable, why or None)
+    return r
+
+
+def _join_sides(q: Query) -> Tuple[set, set]:
+    """(left source keys, right source keys) of a join query."""
+    ist = q.input_stream
+    ls, rs = ist.left_input_stream, ist.right_input_stream
+    return ({ls.stream_reference_id or ls.stream_id, ls.stream_id},
+            {rs.stream_reference_id or rs.stream_id, rs.stream_id})
+
+
+def _equi_conjuncts(q: Query, resolver: _Resolver
+                    ) -> List[Tuple[object, str, str]]:
+    """Top-level `==` conjuncts of a join ON-condition that compare one
+    attribute from each side — the fact ROADMAP item 2's equi-join fast
+    path (device hash bucketing, IndexEventHolder-style) keys on."""
+    on = getattr(q.input_stream, "on_compare", None)
+    if on is None:
+        return []
+    left_keys, right_keys = _join_sides(q)
+
+    def conjuncts(e):
+        if isinstance(e, ex.And):
+            yield from conjuncts(e.left)
+            yield from conjuncts(e.right)
+        else:
+            yield e
+
+    def side_of(v: ex.Variable) -> Optional[str]:
+        if v.stream_id in left_keys:
+            return "left"
+        if v.stream_id in right_keys:
+            return "right"
+        return None
+
+    out = []
+    for c in conjuncts(on):
+        if not isinstance(c, ex.Compare) or c.operator != "==":
+            continue
+        if not (isinstance(c.left, ex.Variable) and
+                isinstance(c.right, ex.Variable)):
+            continue
+        sl, sr = side_of(c.left), side_of(c.right)
+        if sl and sr and sl != sr:
+            la, ra = c.left, c.right
+            if sl == "right":
+                la, ra = ra, la
+            out.append((c, f"{la.stream_id}.{la.attribute_name}",
+                        f"{ra.stream_id}.{ra.attribute_name}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# query + app inference
+# ---------------------------------------------------------------------------
+
+def _filters_of(q: Query, kind: str):
+    """(filter expression, context) pairs across the query's inputs."""
+    from ..query_api.query import Filter
+
+    def handlers(sis):
+        for h in getattr(sis, "stream_handlers", ()):
+            if isinstance(h, Filter):
+                yield h.expression
+
+    ist = q.input_stream
+    if kind == "plain":
+        for e in handlers(ist):
+            yield e
+    elif kind == "join":
+        for sis in (ist.left_input_stream, ist.right_input_stream):
+            for e in handlers(sis):
+                yield e
+    else:
+        from ..core.plan_facts import pattern_atoms
+        for a in pattern_atoms(ist.state_element):
+            for e in handlers(a.basic_single_input_stream):
+                yield e
+
+
+def infer_query(app: SiddhiApp, name: str, q: Query, kind: str,
+                stream_nulls, inferred_types=None) -> QueryTypeFlow:
+    resolver = _build_resolver(app, q, kind, stream_nulls,
+                               inferred_types)
+    uses: List[ExprUse] = []
+
+    def record(e, context: str) -> TypeInfo:
+        info = infer_expr(e, resolver)
+        operands: Tuple[TypeInfo, ...] = ()
+        if isinstance(e, (ex.Compare, ex.Add, ex.Subtract, ex.Multiply,
+                          ex.Divide, ex.Mod)):
+            operands = (infer_expr(e.left, resolver),
+                        infer_expr(e.right, resolver))
+        uses.append(ExprUse(e, info, context, operands))
+        for sub in ex.walk(e):
+            if sub is e:
+                continue
+            if isinstance(sub, (ex.Compare, ex.Add, ex.Subtract,
+                                ex.Multiply, ex.Divide, ex.Mod)):
+                uses.append(ExprUse(
+                    sub, infer_expr(sub, resolver), context,
+                    (infer_expr(sub.left, resolver),
+                     infer_expr(sub.right, resolver))))
+        return info
+
+    for e in _filters_of(q, kind):
+        record(e, "filter")
+    if kind == "join" and getattr(q.input_stream, "on_compare", None) \
+            is not None:
+        record(q.input_stream.on_compare, "on")
+
+    outputs: List[Dict] = []
+    sel = q.selector
+    if sel is not None:
+        for a in sel.selection_list or ():
+            info = record(a.expression, "select")
+            resolver.bound[a.name] = info
+            out = {"name": a.name, **info.to_dict()}
+            outputs.append(out)
+        for g in sel.group_by_list or ():
+            record(g, "group_by")
+        if sel.having_expression is not None:
+            record(sel.having_expression, "having")
+    if not outputs and sel is not None and not sel.selection_list:
+        # select * : output columns mirror the (first) input source
+        for _key, sid, nullable, _why in resolver.sources[:1]:
+            d = resolver._definition(sid)
+            for a in getattr(d, "attribute_list", ()):
+                flow = stream_nulls.get(sid, {}).get(a.name)
+                outputs.append({"name": a.name, "type": a.type,
+                                "nullable": bool(nullable or
+                                                 (flow and
+                                                  flow.nullable))})
+
+    flow = QueryTypeFlow(name=name, kind=kind, outputs=outputs,
+                         uses=uses)
+    if kind == "join":
+        flow.equi_conjuncts = _equi_conjuncts(q, resolver)
+    return flow
+
+
+def infer_app(app: SiddhiApp) -> AppTypeFlow:
+    """Full-app inference with the inter-query nullability fixpoint:
+    a query inserting nullable columns into a stream makes downstream
+    readers of that stream see them nullable."""
+    from ..core.plan_facts import iter_named_queries, query_kind
+
+    stream_nulls: Dict[str, Dict[str, TypeInfo]] = {}
+    inferred_types: Dict[str, Dict[str, str]] = {}
+    queries: Dict[str, QueryTypeFlow] = {}
+    named = [(name, q, query_kind(q))
+             for name, q, _part in iter_named_queries(app)]
+    # dataflow fixpoint: nullability/inferred types only ever turn ON,
+    # and the lattice is finite (streams × attrs), so this converges in
+    # <= |queries|+1 rounds; the bound guards pathological cycles
+    for _ in range(len(named) + 1):
+        changed = False
+        for name, q, kind in named:
+            flow = infer_query(app, name, q, kind, stream_nulls,
+                               inferred_types)
+            queries[name] = flow
+            tgt = getattr(q.output_stream, "target_id", None)
+            if not tgt:
+                continue
+            slot = stream_nulls.setdefault(tgt, {})
+            tslot = inferred_types.setdefault(tgt, {})
+            for col in flow.outputs:
+                t = col.get("type")
+                if t is not None and tslot.get(col["name"]) != t:
+                    tslot[col["name"]] = t
+                    changed = True
+                if not col.get("nullable"):
+                    continue
+                prev = slot.get(col["name"])
+                if prev is None or not prev.nullable:
+                    slot[col["name"]] = TypeInfo(
+                        t, True,
+                        col.get("why") or f"written nullable by "
+                        f"query {name!r}")
+                    changed = True
+        if not changed:
+            break
+    return AppTypeFlow(streams=stream_nulls, queries=queries)
+
+
+def summarize(flow: QueryTypeFlow) -> Dict:
+    """JSON-able per-query summary for fingerprints/EXPLAIN: output
+    column types + the nullable subset with provenance."""
+    return {
+        "out_types": [{k: v for k, v in col.items() if k != "why"}
+                      for col in flow.outputs],
+        "nullable_outputs": [
+            {"name": col["name"], "why": col.get("why")}
+            for col in flow.outputs if col.get("nullable")],
+        "equi_join_keys": [f"{lk} == {rk}"
+                           for _, lk, rk in flow.equi_conjuncts],
+    }

@@ -185,7 +185,8 @@ def _spec_sig(specs) -> str:
 
 
 def step_cost(fn, cache: Optional[Dict] = None,
-              deep: bool = True) -> Dict:
+              deep: bool = True, specs=None,
+              collectives: bool = False) -> Dict:
     """XLA cost analysis of one jitted step at its last-traced signature.
 
     Returns {available, flops, bytes_accessed, peak_bytes, ...} or
@@ -193,21 +194,31 @@ def step_cost(fn, cache: Optional[Dict] = None,
     signature) or the backend rejects the analysis.  `deep=True` also
     compiles the lowering for memory_analysis (argument/output/temp
     bytes); the result is memoized in `cache` keyed by (owner, signature)
-    so repeated EXPLAINs never re-lower."""
+    so repeated EXPLAINs never re-lower.
+
+    `specs` supplies synthesized argument ShapeDtypeStructs for steps
+    that have never traced (analysis/signatures.py) — the plan auditor's
+    no-traffic path; a captured (traced) signature always wins so
+    EXPLAIN keeps reporting what actually ran.  `collectives=True` also
+    scans the compiled HLO for collective ops (implies compiling)."""
     holder = getattr(fn, "_siddhi_argspec", None)
-    specs = holder.get("argspecs") if holder else None
+    traced = holder.get("argspecs") if holder else None
+    origin = "traced" if traced is not None else "synthesized"
+    if traced is not None:
+        specs = traced
     if specs is None:
         return {"available": False,
                 "reason": "step has not executed yet — send traffic, "
                           "then re-run explain"}
     owner = getattr(fn, "_siddhi_owner", "step")
     sig = _spec_sig(specs)
-    key = (owner, id(fn), sig, bool(deep))
+    key = (owner, id(fn), sig, bool(deep), bool(collectives))
     if cache is not None:
         hit = cache.get(key)
         if hit is not None:
             return hit
-    out: Dict[str, Any] = {"available": True, "signature": sig}
+    out: Dict[str, Any] = {"available": True, "signature": sig,
+                           "signature_origin": origin}
     try:
         with RECOMPILES.suppress():
             lowered = fn.lower(*specs)
@@ -217,7 +228,7 @@ def step_cost(fn, cache: Optional[Dict] = None,
         for k in _COST_KEYS:
             if k in ca:
                 out[k.replace(" ", "_")] = float(ca[k])
-        if deep:
+        if deep or collectives:
             with RECOMPILES.suppress():
                 compiled = lowered.compile()
             ma = compiled.memory_analysis()
@@ -231,6 +242,9 @@ def step_cost(fn, cache: Optional[Dict] = None,
                 # live-at-once estimate while the step executes
                 "peak_bytes": arg + outb + tmp - alias,
             }
+            if collectives:
+                from ..sharding.metrics import hlo_collectives
+                out["collectives"] = hlo_collectives(compiled)
     except Exception as exc:  # noqa: BLE001 — diagnostics must not throw
         return {"available": False, "signature": sig,
                 "reason": f"cost analysis failed: {exc!r}"}
@@ -380,9 +394,18 @@ def explain_query(rt, query_name: str, deep: bool = True) -> Dict:
                        f"(queries: {sorted(rt.query_runtimes)})")
     kind = _runtime_kind(qr)
     cache = rt.__dict__.setdefault("_explain_cost_cache", {})
+    # canonical no-traffic signatures (analysis/signatures.py): steps
+    # that have never traced still get cost analysis, marked
+    # signature_origin='synthesized'
+    try:
+        from ..analysis.signatures import synthesize
+        synth = synthesize(qr, kind)
+    except Exception:  # noqa: BLE001 — diagnostics must not throw
+        synth = {}
     steps = {}
     for role, fn in _steps_of(qr, kind):
-        steps[role] = step_cost(fn, cache, deep=deep)
+        steps[role] = step_cost(fn, cache, deep=deep,
+                                specs=synth.get(role))
     from .memory import query_component_bytes
     try:
         plan = qr.planned.describe()     # compiled facts from the planner

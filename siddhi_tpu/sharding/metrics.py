@@ -117,19 +117,33 @@ def shard_report(rt) -> Optional[Dict[str, Any]]:
     }
 
 
-def step_collectives(fn) -> Optional[List[str]]:
+def hlo_collectives(compiled) -> List[str]:
+    """Sorted collective-op kinds present in a compiled step's HLO text.
+    THE one token scan — step_collectives (EXPLAIN) and step_cost's
+    collectives mode (the plan auditor) both report it, so a new
+    collective appearing in a plan is the same string everywhere."""
+    try:
+        hlo = compiled.as_text()
+    except Exception:  # noqa: BLE001 — diagnostics must not throw
+        return []
+    return sorted({tok for tok in COLLECTIVE_TOKENS if tok in hlo})
+
+
+def step_collectives(fn, specs=None) -> Optional[List[str]]:
     """Collective ops in a jitted step's compiled HLO at its last-traced
-    signature (None = not traced yet / backend refused).  Compiles —
-    EXPLAIN deep mode only, memoized upstream."""
+    signature — or, when it never traced, at synthesized `specs`
+    (analysis/signatures.py).  None = no signature available / backend
+    refused.  Compiles — EXPLAIN deep mode only, memoized upstream."""
     holder = getattr(fn, "_siddhi_argspec", None)
-    specs = holder.get("argspecs") if holder else None
+    traced = holder.get("argspecs") if holder else None
+    if traced is not None:
+        specs = traced
     if specs is None:
         return None
     try:
         from ..observability.recompile import RECOMPILES
         with RECOMPILES.suppress():
-            hlo = fn.lower(*specs).compile().as_text()
-        return sorted({tok for tok in COLLECTIVE_TOKENS if tok in hlo})
+            return hlo_collectives(fn.lower(*specs).compile())
     except Exception:  # noqa: BLE001 — diagnostics must not throw
         return None
 

@@ -1,0 +1,146 @@
+"""siddhi-audit CLI: compiled-plan cost fingerprints vs the baseline.
+
+    python -m siddhi_tpu.tools.audit check  [options]
+    python -m siddhi_tpu.tools.audit update [options]
+    python -m siddhi_tpu.tools.audit diff   [options]
+
+    options:
+        --baseline PATH     baseline file (default: PLAN_BASELINE.json
+                            at the repository root)
+        --corpus DIR        sample-app directory (default: samples/apps)
+        --no-bench          audit only the sample apps, not the bench
+                            serving shapes
+        --format text|json  report format (default: text)
+        --tolerance M=REL   override one metric's relative tolerance
+                            (repeatable), e.g. --tolerance flops=0.10
+
+Subcommands:
+    check   fingerprint the corpus, diff against the baseline, and GATE:
+            exit 0 clean, 1 on any regression, 2 on error.  This is the
+            CI entry (`make audit`): a PR that silently doubles a
+            query's bytes-accessed or adds a collective fails here,
+            before any benchmark runs.
+    update  re-fingerprint and REWRITE the baseline.  Run it when a
+            plan change is intentional, commit PLAN_BASELINE.json, and
+            say why in the PR.
+    diff    print every delta (including within-tolerance improvements)
+            without gating — exit 0 unless extraction itself fails.
+
+The audit never executes traffic: it plans the corpus apps, synthesizes
+canonical step signatures, and re-lowers under RECOMPILES.suppress()
+(analysis/audit.py; guard-tested in tests/test_audit.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from ..analysis import audit as _audit
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m siddhi_tpu.tools.audit",
+        description="Compiled-plan cost fingerprint regression gate "
+                    "(flops/bytes/memory/collectives from EXPLAIN, "
+                    "never executing traffic).")
+    p.add_argument("command", choices=("check", "update", "diff"))
+    p.add_argument("--baseline", default=None, metavar="PATH")
+    p.add_argument("--corpus", default=None, metavar="DIR",
+                   help="sample-app directory (default: samples/apps)")
+    p.add_argument("--no-bench", action="store_true",
+                   help="skip the flagship/windowed_join/block-NFA "
+                        "bench shapes")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--tolerance", action="append", default=[],
+                   metavar="METRIC=REL",
+                   help="override a relative tolerance, e.g. "
+                        "flops=0.10 (repeatable)")
+    return p
+
+
+def _tolerances(pairs: List[str]):
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise ValueError(f"--tolerance wants METRIC=REL, got "
+                             f"{pair!r}")
+        k, v = pair.split("=", 1)
+        if k not in _audit.DEFAULT_TOLERANCES:
+            raise ValueError(
+                f"unknown metric {k!r} (known: "
+                f"{', '.join(sorted(_audit.DEFAULT_TOLERANCES))})")
+        out[k] = float(v)
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    try:
+        tol = _tolerances(args.tolerance)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+
+    try:
+        if args.command == "update":
+            baseline = _audit.build_baseline(
+                samples_dir=args.corpus,
+                include_bench=not args.no_bench)
+            path = _audit.save_baseline(baseline, args.baseline)
+            n_shapes = len(baseline["corpus"])
+            n_queries = sum(len(e["queries"])
+                            for e in baseline["corpus"].values())
+            print(f"wrote {path}: {n_shapes} shapes, "
+                  f"{n_queries} query fingerprints")
+            for s in baseline.get("skipped_at_update", ()):
+                print(f"note: skipped {s} (too few devices here)",
+                      file=sys.stderr)
+            return 0
+
+        baseline = _audit.load_baseline(args.baseline)
+        current, skipped = _audit.corpus_fingerprints(
+            samples_dir=args.corpus,
+            include_bench=not args.no_bench)
+        deltas = _audit.diff_fingerprints(baseline, current,
+                                          skipped=skipped,
+                                          tolerances=tol)
+    except FileNotFoundError as exc:
+        print(f"error: {exc} — run `python -m siddhi_tpu.tools.audit "
+              "update` to create the baseline", file=sys.stderr)
+        return 2
+    except Exception as exc:  # noqa: BLE001 — CLI boundary
+        print(f"error: {exc!r}", file=sys.stderr)
+        return 2
+
+    shown = deltas if args.command == "diff" else \
+        [d for d in deltas if d.level != "note"] or deltas
+    if args.format == "json":
+        print(json.dumps({
+            "command": args.command,
+            "deltas": [d.to_dict() for d in shown],
+            "regressions": sum(d.level == "regression" for d in deltas),
+            "improvements": sum(d.level == "improvement"
+                                for d in deltas),
+        }, indent=2, sort_keys=True))
+    else:
+        for d in shown:
+            print(d.render())
+        n_reg = sum(d.level == "regression" for d in deltas)
+        n_imp = sum(d.level == "improvement" for d in deltas)
+        print(f"audit {args.command}: {n_reg} regression(s), "
+              f"{n_imp} improvement(s) across "
+              f"{len(baseline.get('corpus', {}))} baselined shapes")
+        if n_imp and not n_reg:
+            print("improvements only — consider refreshing the "
+                  "baseline (`audit update`) so the win is pinned")
+
+    if args.command == "diff":
+        return 0
+    return 1 if _audit.has_regressions(deltas) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

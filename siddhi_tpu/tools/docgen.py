@@ -79,6 +79,18 @@ def collect() -> Dict[str, List[Tuple[str, str]]]:
          f"**{r['severity']}** — {r['title']}. {r['rationale']} "
          f"*Fix:* {r['hint']}")
         for r in lint_catalog()]
+    # plan-audit metric catalog from the auditor's Metric dataclasses
+    # (analysis/audit.py METRICS) — same no-drift contract: the gate's
+    # tolerances and the doc are one table.  CI regenerates this page
+    # (and lint-rules.md) and fails on diff.
+    from ..analysis.audit import METRICS
+    out["audit-metrics"] = [
+        (m.name,
+         (f"gate: **{m.gate}**"
+          + (f", tolerance ±{m.tolerance * 100:g}%"
+             if m.gate == "increase" else "")
+          + f" — {m.description}"))
+        for m in METRICS]
     return out
 
 

@@ -1,0 +1,242 @@
+"""Expression type & null-flow inference (analysis/typeflow.py) and the
+lint rules it powers: NULL001 (in-band null divergences) and JOIN002
+(equi-join grid visibility)."""
+import pytest
+
+from siddhi_tpu.analysis import analyze
+from siddhi_tpu.analysis.typeflow import infer_app, infer_expr
+from siddhi_tpu.compiler import SiddhiCompiler
+
+
+def _flow(src):
+    return infer_app(SiddhiCompiler.parse(src))
+
+
+def _findings(src, rule):
+    return [f for f in analyze(src) if f.rule_id == rule]
+
+
+# ---------------------------------------------------------------------------
+# expression typing
+# ---------------------------------------------------------------------------
+
+BASIC = """
+define stream S (i int, l long, f float, d double, b bool, s string);
+@info(name='q')
+from S[i > 5 and b == true]
+select i + l as il, f * d as fd, i / 2 as half,
+       cast(i, 'double') as ci, coalesce(i, 0) as co,
+       count() as n, sum(l) as tot, avg(f) as mean
+insert into Out;
+"""
+
+
+def test_basic_types_and_promotion():
+    q = _flow(BASIC).queries["q"]
+    types = {c["name"]: c["type"] for c in q.outputs}
+    assert types["il"] == "LONG"          # INT + LONG promotes
+    assert types["fd"] == "DOUBLE"        # FLOAT * DOUBLE promotes
+    assert types["half"] == "INT"
+    assert types["ci"] == "DOUBLE"        # cast target
+    assert types["n"] == "LONG"           # count is LONG
+    assert types["tot"] == "LONG"
+    assert types["mean"] == "DOUBLE"
+
+
+def test_aggregations_nullable_count_not():
+    q = _flow(BASIC).queries["q"]
+    null = {c["name"]: c["nullable"] for c in q.outputs}
+    assert null["tot"] and null["mean"]   # empty-set agg yields null
+    assert not null["n"]                  # count never
+    assert not null["il"]                 # plain stream attrs not null
+    assert not null["co"]                 # coalesce(i, 0) clears
+
+
+def test_compare_and_bool_ops_not_null():
+    from siddhi_tpu.query_api.expression import Expression
+    e = Expression.compare(Expression.value(1), "<", Expression.value(2))
+
+    class R:
+        def resolve(self, v):
+            raise AssertionError
+
+    info = infer_expr(e, R())
+    assert info.type == "BOOL" and not info.nullable
+
+
+# ---------------------------------------------------------------------------
+# nullability origination
+# ---------------------------------------------------------------------------
+
+OUTER = """
+define stream L (id int, price float);
+define stream R (id int, qty int);
+@info(name='oj')
+from L#window.length(8) {jt} R#window.length(8) on L.id == R.id
+select L.id as id, price, qty
+insert into J;
+"""
+
+
+@pytest.mark.parametrize("jt,id_null,qty_null", [
+    ("join", False, False),
+    ("left outer join", False, True),
+    ("right outer join", True, False),
+    ("full outer join", True, True),
+])
+def test_outer_join_nullability(jt, id_null, qty_null):
+    q = _flow(OUTER.format(jt=jt)).queries["oj"]
+    null = {c["name"]: c["nullable"] for c in q.outputs}
+    assert null["id"] == id_null          # L side
+    assert null["qty"] == qty_null        # R side
+
+
+def test_pattern_or_branch_and_count_zero_optional():
+    src = """
+    define stream S (a int, b int);
+    @info(name='p1')
+    from every e1=S[a > 0] -> e2=S[a > 1] or e3=S[b > 1] within 1 sec
+    select e1.a as x, e2.a as y, e3.b as z
+    insert into M;
+    """
+    q = _flow(src).queries["p1"]
+    null = {c["name"]: c["nullable"] for c in q.outputs}
+    assert not null["x"]                  # mandatory atom
+    assert null["y"] and null["z"]        # or-branches are optional
+
+
+def test_inter_query_propagation_fixpoint():
+    src = OUTER.format(jt="left outer join") + """
+    @info(name='hop')
+    from J select id, qty insert into K;
+    @info(name='sink')
+    from K[qty > 1] select qty insert into Z;
+    """
+    flow = _flow(src)
+    assert flow.streams["J"]["qty"].nullable
+    assert flow.streams["K"]["qty"].nullable
+    sink = flow.queries["sink"]
+    null = {c["name"]: c["nullable"] for c in sink.outputs}
+    assert null["qty"]
+
+
+# ---------------------------------------------------------------------------
+# NULL001
+# ---------------------------------------------------------------------------
+
+def test_null001_fires_on_nullable_int_compare():
+    src = OUTER.format(jt="left outer join") + """
+    @info(name='ds')
+    from J[qty > 5] select id insert into Big;
+    """
+    found = _findings(src, "NULL001")
+    assert len(found) == 1
+    f = found[0]
+    assert f.query == "ds" and f.severity == "WARN"
+    assert "INT_MIN" in f.message and "qty" in f.message
+
+
+def test_null001_fires_on_nullable_arithmetic():
+    src = OUTER.format(jt="left outer join") + """
+    @info(name='ds')
+    from J select qty * 2 as q2 insert into Big;
+    """
+    found = _findings(src, "NULL001")
+    assert len(found) == 1 and "arithmetic" in found[0].message
+
+
+def test_null001_bool_divergence():
+    src = """
+    define stream L (id int, ok bool);
+    define stream R (id int, flag bool);
+    @info(name='oj')
+    from L#window.length(8) left outer join R#window.length(8)
+      on L.id == R.id
+    select L.id as id, flag insert into J;
+    @info(name='ds')
+    from J[flag == false] select id insert into Off;
+    """
+    found = _findings(src, "NULL001")
+    assert len(found) == 1
+    assert "False" in found[0].message    # null-BOOL-decodes-False case
+
+
+def test_null001_silent_on_floats_and_guarded_access():
+    # FLOAT/DOUBLE nulls are out-of-band NaN: comparisons are false in
+    # both engines, no divergence to warn about
+    src = OUTER.format(jt="left outer join") + """
+    @info(name='ds')
+    from J[price > 1.0] select id insert into Big;
+    """
+    assert not _findings(src, "NULL001")
+    # coalesce() is the documented remediation
+    src2 = OUTER.format(jt="left outer join") + """
+    @info(name='ds')
+    from J[coalesce(qty, 0) > 5] select id insert into Big;
+    """
+    assert not _findings(src2, "NULL001")
+
+
+def test_null001_silent_on_inner_join():
+    src = OUTER.format(jt="join") + """
+    @info(name='ds')
+    from J[qty > 5] select id insert into Big;
+    """
+    assert not _findings(src, "NULL001")
+
+
+# ---------------------------------------------------------------------------
+# JOIN002
+# ---------------------------------------------------------------------------
+
+def test_join002_fires_on_equality_conjunct():
+    found = _findings(OUTER.format(jt="join"), "JOIN002")
+    assert len(found) == 1
+    f = found[0]
+    assert f.severity == "INFO" and f.query == "oj"
+    assert "L.id == R.id" in f.message and "item 2" in f.message
+    assert f.pos is not None              # cites the condition
+
+
+def test_join002_silent_on_pure_range_join():
+    src = """
+    define stream L (id int, price float);
+    define stream R (id int, qty int);
+    @info(name='rj')
+    from L#window.length(8) join R#window.length(8)
+      on L.price > R.qty
+    select L.id as id insert into J;
+    """
+    assert not _findings(src, "JOIN002")
+
+
+def test_join002_fires_on_windowed_join_corpus_shape():
+    """The satellite requirement: the 100x-outlier bench shape gets the
+    visibility finding."""
+    from siddhi_tpu.analysis.corpus import WINDOWED_JOIN_QL
+    found = _findings(WINDOWED_JOIN_QL, "JOIN002")
+    assert len(found) == 1
+    assert "L.symbol == R.symbol" in found[0].message
+
+
+def test_join002_finds_equality_inside_conjunction():
+    src = """
+    define stream L (id int, price float);
+    define stream R (id int, qty int);
+    @info(name='cj')
+    from L#window.length(8) join R#window.length(8)
+      on L.id == R.id and L.price > R.qty
+    select L.id as id insert into J;
+    """
+    found = _findings(src, "JOIN002")
+    assert len(found) == 1 and "L.id == R.id" in found[0].message
+
+
+# ---------------------------------------------------------------------------
+# shipped corpus stays clean of the new WARN
+# ---------------------------------------------------------------------------
+
+def test_sample_corpus_has_no_null001():
+    from siddhi_tpu.analysis.corpus import sample_apps
+    for key, ql in sample_apps().items():
+        assert not _findings(ql, "NULL001"), key
