@@ -4,11 +4,12 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: test lint lint-apps lint-smoke dryrun bench metrics-smoke \
 	fuse-smoke explain-smoke chaos-smoke multichip-smoke soak-smoke \
-	admission-smoke audit audit-update audit-smoke docgen-check all
+	admission-smoke audit audit-update audit-smoke docgen-check \
+	join-smoke all
 
 all: lint lint-apps docgen-check audit test dryrun metrics-smoke \
 	fuse-smoke explain-smoke lint-smoke chaos-smoke multichip-smoke \
-	soak-smoke admission-smoke audit-smoke
+	soak-smoke admission-smoke audit-smoke join-smoke
 
 # static gate on our own code: ruff (rule set in pyproject.toml) when
 # available, with compileall kept as the syntax floor for samples and
@@ -106,6 +107,14 @@ chaos-smoke:
 # `ok` with zero silent drops (soak-telemetry layer, README "Soak & SLOs")
 soak-smoke:
 	$(CPU_ENV) $(PY) samples/soak_smoke.py
+
+# equi-join fast path (ROADMAP item 2) in <60 s: windowed_join plans
+# with bucketing ACTIVE (JOIN002 INFO), grid-vs-bucketed outputs
+# byte-identical across inner/outer/residual/group-by/@fuse + the
+# stream-table index probe, and the audit bytes-accessed fingerprint
+# collapsed vs the grid plan (README "Equi-join fast path")
+join-smoke:
+	$(CPU_ENV) $(PY) samples/join_smoke.py
 
 # overload is decided, not discovered, in <30 s: an over-ceiling deploy
 # denied BEFORE any compile, exact shed accounting (offered == accepted

@@ -463,6 +463,68 @@ def run_fuse_compare(k=8, B=1 << 11, n_batches=64):
     return results
 
 
+def run_join_compare(B=1 << 10, n_batches=8, out_path=None):
+    """--mode join_compare: the windowed_join corpus shape with the
+    equi-join fast path ON vs OFF (full [R,C] grid), plus the
+    cost_analysis bytes-accessed delta for the same two plans — the
+    ROADMAP item-2 A-B artifact (JOIN_r10.json)."""
+    from siddhi_tpu.core import join as joinmod
+
+    results = {}
+    costs = {}
+    for tag, fast in (("fastpath", True), ("grid", False)):
+        joinmod.FASTPATH_ENABLED = fast
+        try:
+            eps, lat = config_windowed_join(n_batches=n_batches, B=B)
+            results[tag] = {"value": round(eps), "unit": "events/sec",
+                            **lat}
+            costs[tag] = _join_cost_fingerprint()
+        finally:
+            joinmod.FASTPATH_ENABLED = True
+        print(f"join_compare[{tag}]: {eps:,.0f} ev/s "
+              f"p50={lat['p50_ms']}ms p99={lat['p99_ms']}ms "
+              f"bytes/dispatch={costs[tag]['bytes_accessed']:,}",
+              file=sys.stderr)
+    base = results["grid"]["value"]
+    fastv = results["fastpath"]["value"]
+    payload = {
+        "metric": "join_compare_windowed_join_events_per_sec",
+        "batch": B, "n_batches": n_batches,
+        "speedup": round(fastv / max(base, 1), 2),
+        "bytes_accessed_delta": round(
+            1.0 - costs["fastpath"]["bytes_accessed"] /
+            max(costs["grid"]["bytes_accessed"], 1), 4),
+        "configs": results,
+        "cost_analysis": costs,
+        "shape": "analysis/corpus.py WINDOWED_JOIN_QL",
+    }
+    print(json.dumps(payload))
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"wrote {out_path}", file=sys.stderr)
+    return payload
+
+
+def _join_cost_fingerprint():
+    """Hot-path flops/bytes of the CURRENT windowed_join plan (both side
+    steps summed) via the audit extractor — traffic-free, synthesized
+    signatures."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.analysis.audit import query_fingerprint
+    manager = SiddhiManager()
+    rt = manager.create_siddhi_app_runtime(WINDOWED_JOIN_QL)
+    rt.start()
+    try:
+        fp = query_fingerprint(rt, "q")
+        tot = fp.get("totals", {})
+        return {"flops": int(tot.get("flops", 0)),
+                "bytes_accessed": int(tot.get("bytes_accessed", 0)),
+                "fastpath": fp.get("equi_fastpath", {})}
+    finally:
+        manager.shutdown()
+
+
 def _enable_compile_cache():
     """Persistent XLA compile cache: the flagship program compiles in
     minutes on the tunneled TPU; repeat bench runs (driver re-runs, local
@@ -1490,7 +1552,8 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--mode", default="full",
                     choices=["full", "device_loop", "fuse_compare",
-                             "cost_analysis", "multichip", "soak"],
+                             "cost_analysis", "multichip", "soak",
+                             "join_compare"],
                     help="full: the flagship suite (default); "
                          "device_loop: tunnel-independent chip-side "
                          "events/sec via fused dispatch re-execution; "
@@ -1501,7 +1564,10 @@ if __name__ == "__main__":
                          "at 1/2/4/8 shards with parity asserts; "
                          "soak: sustained multi-tenant load with the "
                          "time-series sampler + SLO verdicts "
-                         "(SOAK artifact)")
+                         "(SOAK artifact); "
+                         "join_compare: windowed_join equi-join fast "
+                         "path ON vs OFF + bytes-accessed delta "
+                         "(JOIN artifact)")
     ap.add_argument("--k", type=int, default=16,
                     help="fused stack depth (device_loop/fuse_compare)")
     ap.add_argument("--batch", type=int, default=1 << 11,
@@ -1540,6 +1606,11 @@ if __name__ == "__main__":
         run_fuse_compare(args.k, args.batch)
     elif args.mode == "cost_analysis":
         run_cost_analysis(B=args.batch)
+    elif args.mode == "join_compare":
+        _enable_compile_cache()
+        run_join_compare(B=1 << 8 if args.quick else 1 << 10,
+                         n_batches=2 if args.quick else 8,
+                         out_path=args.out)
     elif args.mode == "multichip":
         _enable_compile_cache()
         run_multichip(quick=args.quick, out_path=args.out)

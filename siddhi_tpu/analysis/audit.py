@@ -90,6 +90,11 @@ METRICS: List[Metric] = [
            "@fuse eligibility / active K / concrete exclusion reason"),
     Metric("types", 0.0, "exact",
            "static output column types + nullable set (typeflow pass)"),
+    Metric("equi_fastpath", 0.0, "exact",
+           "equi-join fast-path mode / key attrs / lane capacity (or "
+           "the inapplicability reason) — a silently deactivated fast "
+           "path is a 10-100x regression the float metrics would also "
+           "catch, this names the cause"),
 ]
 
 DEFAULT_TOLERANCES: Dict[str, float] = {m.name: m.tolerance
@@ -168,6 +173,8 @@ def query_fingerprint(rt, qname: str, typeflow_summary: Optional[Dict]
         },
         "fusion": _fusion.eligibility(qr, kind),
     }
+    if hasattr(p, "fastpath_facts"):
+        fp["equi_fastpath"] = p.fastpath_facts()
     if typeflow_summary is not None:
         fp["types"] = typeflow_summary
     return fp
@@ -408,6 +415,7 @@ def _diff_query(out: List[Delta], shape: str, q: str, b: Dict, c: Dict,
             ("collectives", "collective_kinds"),
             ("emission_cap", "emission"),
             ("fusion", "fusion"),
+            ("equi_fastpath", "equi_fastpath"),
             ("types", "types")):
         _cmp_exact(out, shape, q, None, metric, b.get(path),
                    c.get(path))

@@ -95,7 +95,11 @@ def run_rules(ctx, config: Optional[LintConfig] = None) -> List[Finding]:
         produced: Iterable[Finding] = r.check(ctx) or ()
         for f in produced:
             f.rule_id = r.id
-            f.severity = sev
+            # a rule may grade its own findings (JOIN002: fast path
+            # ACTIVE = INFO, inapplicable = WARN); an explicit config
+            # override still forces every finding of the rule
+            if not f.severity or r.id in config.severity_overrides:
+                f.severity = sev
             if f.source is None:
                 f.source = ctx.source_name
             if f.hint is None:

@@ -175,32 +175,67 @@ def _join_cap_overflow(ctx: AnalysisContext) -> Iterator[Finding]:
 
 
 @rule("JOIN002", "INFO",
-      "equi-join evaluated as a full cross-product grid",
-      "The join ON-condition has a top-level equality conjunct, but the "
-      "compiled plan still evaluates the full [rows × rows] grid every "
-      "batch — this is the windowed_join 100× outlier (ROADMAP item 2: "
-      "bucket both sides by the equality key on device, "
-      "IndexEventHolder-style, and evaluate only intra-bucket pairs).  "
-      "Bytes-accessed scales with the grid, not the matches.",
-      "no action needed today — this flags plans that will benefit "
-      "from the ROADMAP item-2 equi-join fast path; shrink the windows "
-      "if the grid cost already hurts")
+      "equi-join fast path: ACTIVE (INFO) or inapplicable (WARN)",
+      "The join ON-condition has a top-level equality conjunct.  When "
+      "the equi-join fast path applies (both sides plain stream "
+      "windows -> device key bucketing; or an indexed table side with "
+      "a windowless trigger -> host hash probe) the plan evaluates "
+      "only same-key candidate pairs and this rule reports INFO with "
+      "the key attributes.  When the conjunct exists but the fast path "
+      "cannot apply, the plan still evaluates the full [rows × rows] "
+      "grid every batch — bytes-accessed scales with the grid, not the "
+      "matches — and this rule WARNs with the wiring's exact reason "
+      "(core/plan_facts.join_fastpath).",
+      "bucket mode needs plain stream windows with no side [filter]; "
+      "table mode needs an @Index/@PrimaryKey on the join key and a "
+      "windowless trigger side; shrink the windows if the grid cost "
+      "hurts")
 def _equi_join_grid(ctx: AnalysisContext) -> Iterator[Finding]:
-    from .typeflow import infer_query
+    from ..core.plan_facts import join_fastpath, table_probe_attrs_of
+    app = ctx.app
+
+    def side_kind(sid: str) -> str:
+        if sid in app.aggregation_definition_map:
+            return "aggregation"
+        if sid in app.window_definition_map:
+            return "named_window"
+        if sid in app.table_definition_map:
+            return "table"
+        return "stream"
+
+    def probe_attrs(sid: str):
+        d = app.table_definition_map.get(sid)
+        return table_probe_attrs_of(d) if d is not None else []
+
     for f in ctx.queries:
         if f.kind != "join":
             continue
         try:
-            flow = infer_query(ctx.app, f.name, f.query, "join", {})
-        except Exception:  # noqa: BLE001 — inference must not kill lint
+            mode, pairs, reason = join_fastpath(
+                f.query.input_stream, side_kind, probe_attrs)
+        except Exception:  # noqa: BLE001 — analysis must not kill lint
             continue
-        for node, left, right in flow.equi_conjuncts:
-            yield _f(f"ON-condition equality {left} == {right} is "
-                     "evaluated as a full grid — the equi-join fast "
-                     "path (ROADMAP item 2) would bucket by key and "
-                     "probe only intra-bucket pairs", query=f.name,
-                     node=node if getattr(node, "pos", None)
-                     else f.query)
+        if not pairs:
+            continue
+        keys = ", ".join(
+            f"{lv.stream_id}.{lv.attribute_name} == "
+            f"{rv.stream_id}.{rv.attribute_name}"
+            for _c, lv, rv in pairs)
+        node = pairs[0][0] if getattr(pairs[0][0], "pos", None) \
+            else f.query
+        if mode is not None:
+            fd = _f(f"equi-join fast path ACTIVE ({mode}): only "
+                    f"same-key candidates are probed for {keys}",
+                    query=f.name, node=node,
+                    hint="no action needed")
+            fd.severity = "INFO"
+        else:
+            fd = _f(f"ON-condition equality {keys} found but the fast "
+                    f"path cannot apply: {reason} — the full "
+                    "[rows × rows] grid is evaluated every batch",
+                    query=f.name, node=node)
+            fd.severity = "WARN"
+        yield fd
 
 
 # ---------------------------------------------------------------------------

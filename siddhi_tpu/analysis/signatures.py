@@ -185,9 +185,22 @@ def _join_specs(qr) -> Dict[str, Tuple]:
         other = _join_side_other(qr, is_left)
         if other is None:
             continue
-        out[role] = (state, _sds((B,), np.int64), _sds((B,), np.int32),
-                     _sds((B,), np.bool_), _device_cols(side.schema, B),
-                     _sds((B,), np.int32), other, now)
+        args = [state, _sds((B,), np.int64), _sds((B,), np.int32),
+                _sds((B,), np.bool_), _device_cols(side.schema, B),
+                _sds((B,), np.int32)]
+        # equi-join fast-path probe arg (core/join.py): bucket slots or
+        # host table candidates ride between gslot and the other-side
+        # snapshot
+        if getattr(p, "fastpath", None) == "bucket":
+            args.append(_sds((B,), np.int32))
+        elif getattr(p, "fastpath", None) == "table":
+            tid = (p.left if p.table_is_left else p.right).stream_id
+            t = qr.app.tables[tid]
+            w = (t.indexes[p.table_pos].lanes.shape[1]
+                 if p.table_pos in t.indexes else 1)
+            args.append((_sds((B, w), np.int32), _sds((B, w), np.bool_)))
+        args += [other, now]
+        out[role] = tuple(args)
     return out
 
 

@@ -350,10 +350,14 @@ class StagedBatch:
     """Host (numpy) staging of a batch: used for group-key/partition-key slot
     computation before the single host->device transfer."""
 
-    __slots__ = ("ts", "kind", "valid", "cols", "n")
+    __slots__ = ("ts", "kind", "valid", "cols", "n", "jprobe")
 
     def __init__(self, ts, kind, valid, cols, n):
         self.ts, self.kind, self.valid, self.cols, self.n = ts, kind, valid, cols, n
+        # equi-join bucket slots, bound once at the fuse-offer edge and
+        # replayed verbatim by drains/dispatch (core/runtime.py
+        # JoinQueryRuntime._join_key_probe)
+        self.jprobe = None
 
     def to_device(self, schema: Schema) -> EventBatch:
         cols = tuple(jnp.asarray(c).astype(d)

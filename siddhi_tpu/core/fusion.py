@@ -251,9 +251,16 @@ def _adapt_pattern(body):
 
 def _adapt_join(body):
     def fused_body(carry, x, const):
-        ts, kind, valid, cols, gslot, now = x
-        carry, out, _wake = body(carry, ts, kind, valid, cols, gslot,
-                                 const, now)
+        if len(x) == 7:
+            # equi-join fast path: per-batch probe (bucket slots or
+            # host table candidates) rides the stack
+            ts, kind, valid, cols, gslot, probe, now = x
+            carry, out, _wake = body(carry, ts, kind, valid, cols,
+                                     gslot, probe, const, now)
+        else:
+            ts, kind, valid, cols, gslot, now = x
+            carry, out, _wake = body(carry, ts, kind, valid, cols, gslot,
+                                     const, now)
         return carry, out
     return fused_body
 
@@ -364,16 +371,34 @@ def _dispatch_join(qr, items) -> None:
     gs = [qr._join_slots(is_left, staged) for _, staged, _ in items]
     stack = ev.StackedBatch([staged for _, staged, _ in items])
     batch = stack.to_device(side.schema)
-    xs = (batch.ts, batch.kind, batch.valid, batch.cols,
-          jnp.asarray(np.stack([np.asarray(g) for g in gs])),
-          _now_stack(items))
+    xs = [batch.ts, batch.kind, batch.valid, batch.cols,
+          jnp.asarray(np.stack([np.asarray(g) for g in gs]))]
+    if p.fastpath == "bucket":
+        # probes were bound (and the retention mirror fed) at offer
+        # time, so the stack replays them verbatim
+        xs.append(jnp.asarray(np.stack(
+            [np.asarray(qr._join_key_probe(is_left, staged))
+             for _, staged, _ in items])))
+    elif p.fastpath == "table":
+        # candidates resolve against the table at DISPATCH time — the
+        # same moment `const` snapshots its columns below
+        probes = [qr._table_probe(staged) for _, staged, _ in items]
+        w = max(c.shape[1] for c, _ in probes)
+        b = probes[0][0].shape[0]
+        cand_k = np.full((len(probes), b, w), -1, np.int32)
+        ok_k = np.zeros((len(probes), b, w), np.bool_)
+        for i, (c, o) in enumerate(probes):
+            cand_k[i, :, :c.shape[1]] = c
+            ok_k[i, :, :o.shape[1]] = o
+        xs.append((jnp.asarray(cand_k), jnp.asarray(ok_k)))
+    xs.append(_now_stack(items))
     # table/aggregation other-side snapshot is taken ONCE at dispatch:
     # under @fuse the per-batch read-your-writes of a concurrently
     # updated table relaxes to dispatch granularity (stream other-sides
     # live in the carry and stay exact)
     const = qr._other_table(is_left)
     fn = _fused_fn(qr, "join", body)
-    qr.state, outs = fn(qr.state, xs, const)
+    qr.state, outs = fn(qr.state, tuple(xs), const)
     _deliver_fused(qr, outs, [now for _, _, now in items])
 
 

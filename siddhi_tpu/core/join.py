@@ -17,11 +17,14 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..query_api.definition import StreamDefinition
 from ..query_api.query import JoinInputStream, Query, SingleInputStream
 from . import event as ev
 from .executor import CompileError, CompiledExpr, Scope, compile_expression
+from .keyslots import SlotAllocator
+from .plan_facts import JOIN_LANE_K_MIN, join_fastpath, table_probe_attrs_of
 from .selector import SelectorExec
 from .steputil import jit_step
 from .window import Buffer, NoWindow, Rows, WindowProcessor, create_window
@@ -80,6 +83,30 @@ class PlannedJoinQuery:
     # un-jitted side bodies for @fuse(batches=K) scan fusion (core/fusion.py)
     raw_left: Optional[Callable] = None
     raw_right: Optional[Callable] = None
+    # ---- equi-join fast path (ROADMAP item 2) ----
+    # 'bucket': both stream windows carry a key-slot column; the step
+    # probes only same-bucket pairs through a lane table derived from
+    # the buffer each dispatch.  'table': the table side's hash index
+    # answers [B, K] candidates host-side.  None: full [R, C] grid.
+    fastpath: Optional[str] = None
+    # why an equality conjunct exists but the fast path stays off
+    # (plan_facts.join_fastpath wording — lint JOIN002 prints the same)
+    fastpath_reason: Optional[str] = None
+    key_attrs: List[Tuple[str, str]] = dataclasses.field(
+        default_factory=list)            # [(left attr, right attr)]
+    key_left: List[int] = dataclasses.field(default_factory=list)
+    key_right: List[int] = dataclasses.field(default_factory=list)
+    key_dtypes: List[Any] = dataclasses.field(default_factory=list)
+    residual: bool = False       # ON carries conjuncts beyond the keys
+    lane_k: int = 0              # candidate lane width (bucket mode)
+    lane_buckets: Tuple[int, int] = (0, 0)   # per-side lane-table rows
+    ring_caps: Tuple[int, int] = (0, 0)      # per-side retention bound
+    # shared key->slot allocator (both sides; carried across replans)
+    join_key_allocator: Optional[Any] = None
+    # table mode: which side is the table and the probe columns
+    table_is_left: bool = False
+    table_pos: int = -1          # indexed table column
+    stream_key_pos: int = -1     # stream-side key column
 
     @staticmethod
     def _describe_side(s: "JoinSide") -> Dict:
@@ -115,12 +142,54 @@ class PlannedJoinQuery:
                 if self.slot_allocator2 is not None else None)
         if self.per_duration is not None:
             d["aggregation_per"] = self.per_duration
+        d["equi_fastpath"] = self.fastpath_facts()
         return d
+
+    def fastpath_facts(self) -> Dict:
+        """Bucket stats for EXPLAIN / lint: the fast-path mode, the key
+        attributes it buckets on, the candidate lane capacity, and
+        whether a residual predicate rides the probe."""
+        node: Dict[str, Any] = {"active": self.fastpath is not None}
+        if self.fastpath is not None:
+            node["mode"] = self.fastpath
+            node["key_attrs"] = [list(p) for p in self.key_attrs]
+            node["residual_predicate"] = bool(self.residual)
+            if self.fastpath == "bucket":
+                node["lane_k"] = int(self.lane_k)
+                node["lane_buckets"] = list(self.lane_buckets)
+                node["key_capacity"] = (
+                    self.join_key_allocator.capacity
+                    if self.join_key_allocator is not None else None)
+        elif self.fastpath_reason is not None:
+            node["reason"] = self.fastpath_reason
+        return node
+
+
+# A-B kill switch: bench `--mode join_compare` and the parity tests plan
+# one runtime with the fast path off to prove byte-identical outputs.
+# Consulted once at plan time; never flipped on a live runtime.
+FASTPATH_ENABLED = True
+
+JSLOT_COL = "#jslot"
+
+
+def _probe_schema(schema: ev.Schema) -> ev.Schema:
+    """The window-buffer schema of a bucketed join side: the stream's
+    columns plus one synthetic INT column carrying the key's bucket
+    slot.  The column rides the buffer through every window gather, so
+    EXPIRED trigger rows keep the slot they were bucketed under at
+    arrival — no re-hashing of buffered rows, ever."""
+    d = StreamDefinition(f"{schema.id}{JSLOT_COL}")
+    for n, t in zip(schema.names, schema.types):
+        d.attribute(n, t)
+    d.attribute(JSLOT_COL, "INT")
+    return ev.Schema(d, schema.interner)
 
 
 def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
              scope: Scope, window_capacity_hint: int,
-             aggregations=None, named_windows=None) -> JoinSide:
+             aggregations=None, named_windows=None,
+             probe_col: bool = False) -> JoinSide:
     sid = sis.stream_id
     key = sis.stream_reference_id or sid
     if aggregations and sid in aggregations:
@@ -153,14 +222,17 @@ def _mk_side(sis: SingleInputStream, schemas, tables, batch_capacity,
     win = None
     if not is_table:
         wh = sis.window_handler
+        # bucketed sides build their buffers with the key-slot column
+        # appended (the side's visible schema stays the original)
+        win_schema = _probe_schema(schema) if probe_col else schema
         if wh is None:
             # windowless stream side: valid when probing a table-like side
             # (reference: JoinInputStreamParser wraps it in an empty window)
-            win = NoWindow(schema, [], batch_capacity)
+            win = NoWindow(win_schema, [], batch_capacity)
         else:
             win = create_window(
                 (wh.namespace + ":" if wh.namespace else "") + wh.name,
-                schema, wh.parameters, batch_capacity,
+                win_schema, wh.parameters, batch_capacity,
                 capacity_hint=window_capacity_hint)
             if win.name not in ("length", "time"):
                 raise CompileError(
@@ -199,16 +271,36 @@ def plan_join_query(
     named_windows=None,
     mesh=None,
     emit_rows_override: Optional[int] = None,
+    lane_k_override: Optional[int] = None,
 ) -> PlannedJoinQuery:
     jis = query.input_stream
     assert isinstance(jis, JoinInputStream)
+
+    # equi-join fast path: decided from the AST BEFORE the sides build,
+    # so bucketed windows can carry the key-slot column from birth
+    def _side_kind(sid: str) -> str:
+        if aggregations and sid in aggregations:
+            return "aggregation"
+        if named_windows and sid in named_windows:
+            return "named_window"
+        if sid in tables:
+            return "table"
+        return "stream"
+
+    fp_mode, fp_pairs, fp_reason = join_fastpath(
+        jis, _side_kind,
+        lambda sid: table_probe_attrs_of(tables[sid].definition))
+    if not FASTPATH_ENABLED and fp_mode is not None:
+        fp_mode, fp_reason = None, "fast path disabled (A-B comparison)"
+
     scope = Scope()
     scope.interner = interner
     left = _mk_side(jis.left_input_stream, schemas, tables, batch_capacity,
-                    scope, window_capacity_hint, aggregations, named_windows)
+                    scope, window_capacity_hint, aggregations, named_windows,
+                    probe_col=fp_mode == "bucket")
     right = _mk_side(jis.right_input_stream, schemas, tables, batch_capacity,
                      scope, window_capacity_hint, aggregations,
-                     named_windows)
+                     named_windows, probe_col=fp_mode == "bucket")
     if left.is_table and right.is_table and \
             not (left.is_named_window or right.is_named_window):
         raise CompileError("cannot join two tables in a streaming query")
@@ -240,6 +332,57 @@ def plan_join_query(
     if jis.on_compare is not None:
         on = compile_expression(jis.on_compare, scope)
 
+    # ---- equi-join fast-path plan details ---------------------------------
+    key_attrs: List[Tuple[str, str]] = []
+    key_left: List[int] = []
+    key_right: List[int] = []
+    key_dtypes: List[Any] = []
+    lane_k = 0
+    lane_buckets = (0, 0)
+    ring_caps = (0, 0)
+    jk_alloc = None
+    table_is_left = False
+    table_pos = -1
+    stream_key_pos = -1
+    if fp_mode == "bucket":
+        for _c, lv, rv in fp_pairs:
+            lp = left.schema.position(lv.attribute_name)
+            rp = right.schema.position(rv.attribute_name)
+            key_left.append(lp)
+            key_right.append(rp)
+            key_attrs.append((lv.attribute_name, rv.attribute_name))
+            # both sides hash the PROMOTED encoding, so any two values
+            # the compiled `==` would call equal land in one bucket
+            key_dtypes.append(np.promote_types(
+                ev.np_dtype(left.schema.types[lp]),
+                ev.np_dtype(right.schema.types[rp])))
+        ring_caps = (_retention_rows(left.window),
+                     _retention_rows(right.window))
+        lane_buckets = (_lane_bucket_count(ring_caps[0]),
+                        _lane_bucket_count(ring_caps[1]))
+        # initial lane width: cover small windows outright (occupancy
+        # can never exceed the retention bound, so tiny-window joins
+        # never pay a growth recompile) and start larger shapes at the
+        # K a roughly-uniform key spread settles into
+        auto_k = 1 << (max(1, min(max(ring_caps), 16)) - 1).bit_length()
+        lane_k = max(JOIN_LANE_K_MIN, auto_k, int(lane_k_override or 0))
+        # key slots live while EITHER ring retains them plus one batch
+        # of new arrivals in flight (JoinKeyTracker evicts before it
+        # allocates, so this bound holds transiently too)
+        jk_alloc = SlotAllocator(
+            ring_caps[0] + ring_caps[1] + 2 * max(batch_capacity, 8192),
+            name=f"{name}:joinkey")
+    elif fp_mode == "table":
+        tside, sside = (left, right) if left.is_table else (right, left)
+        table_is_left = left.is_table
+        _c, lv, rv = fp_pairs[0]
+        t_var, s_var = (lv, rv) if table_is_left else (rv, lv)
+        table_pos = tside.schema.position(t_var.attribute_name)
+        stream_key_pos = sside.schema.position(s_var.attribute_name)
+        key_attrs = [(lv.attribute_name, rv.attribute_name)]
+    n_conj = _conjunct_count(jis.on_compare)
+    fp_residual = fp_mode is not None and n_conj > len(key_attrs)
+
     # group-by in joins (reference: JoinProcessor + QuerySelector
     # processGroupBy, JoinProcessor.java:107-190): group attrs resolve to
     # per-side slot ids at ingestion; the joined row's slot composes the two
@@ -269,7 +412,6 @@ def plan_join_query(
         Kl, Kr = 0, 2047
     else:
         Kl = Kr = 0
-    from .keyslots import SlotAllocator
     gl_alloc = SlotAllocator(Kl, name=f"{name}:gl") if gl_pos else None
     gr_alloc = SlotAllocator(Kr, name=f"{name}:gr") if gr_pos else None
     sel = SelectorExec(query.selector, scope, left.schema,
@@ -307,9 +449,17 @@ def plan_join_query(
             (jt == "RIGHT_OUTER_JOIN" and not this_is_left) or
             jt == "FULL_OUTER_JOIN")
         K_other = Kr if this_is_left else Kl
+        # fast-path shape facts baked into the trace
+        bucket = fp_mode == "bucket"
+        table_probe = fp_mode == "table" and not this.is_table
+        nbl_other = (lane_buckets[1] if this_is_left else
+                     lane_buckets[0]) if bucket else 0
 
-        def step(state, ts, kind, valid, cols, gslot, other_table_cols,
-                 now):
+        def step(state, ts, kind, valid, cols, gslot, *rest):
+            if bucket or table_probe:
+                probe, other_table_cols, now = rest
+            else:
+                other_table_cols, now = rest
             wl_state, wr_state, sel_state = state
             this_state = wl_state if this_is_left else wr_state
             other_state = wr_state if this_is_left else wl_state
@@ -320,10 +470,26 @@ def plan_join_query(
             for f in this.pre_filters:
                 keep = jnp.logical_and(keep, jnp.logical_or(
                     jnp.logical_not(is_cur), f.fn(env0)))
+            in_cols = cols
+            if bucket:
+                # key bucket slot rides the window buffer as a column
+                in_cols = cols + (probe,)
+            elif table_probe:
+                # original batch row index rides the (windowless) window
+                # so compacted trigger rows can find their host-computed
+                # table candidates
+                in_cols = cols + (jnp.arange(ts.shape[0],
+                                             dtype=jnp.int32),)
             rows = Rows(ts=ts, kind=kind, valid=keep,
-                        seq=jnp.zeros_like(ts), gslot=gslot, cols=cols)
+                        seq=jnp.zeros_like(ts), gslot=gslot, cols=in_cols)
             this_state, wout = this.window.process(this_state, rows, now)
             orows = wout.rows                       # [R]
+            if bucket or table_probe:
+                trig_extra = orows.cols[-1]
+                t_cols = orows.cols[:-1]
+            else:
+                trig_extra = None
+                t_cols = orows.cols
 
             # other side's buffer (gslot rides the window buffer rows)
             if other.is_table:
@@ -333,30 +499,76 @@ def plan_join_query(
                 obuf: Buffer = other_state[0]
                 o_cols, o_ts, o_alive = obuf.cols, obuf.ts, obuf.alive
                 o_gslot = obuf.gslot
+                if bucket:
+                    o_jslot = o_cols[-1]
+                    o_cols = o_cols[:-1]
 
             R = orows.ts.shape[0]
             C = o_ts.shape[0]
-            env = {
-                this.key: tuple(c[:, None] for c in orows.cols),
-                other.key: tuple(c[None, :] for c in o_cols),
-                "__ts__": orows.ts[:, None],
-                "__now__": now,
-            }
-            if on is None:
-                m = jnp.ones((R, C), jnp.bool_)
-            else:
-                m = jnp.broadcast_to(on.fn(env), (R, C))
             data_row = jnp.logical_and(
                 orows.valid,
                 jnp.logical_or(orows.kind == ev.CURRENT,
                                orows.kind == ev.EXPIRED))
+            if bucket:
+                # [R, K] same-bucket candidates instead of the [R, C]
+                # grid: the lane table is re-derived from the buffer's
+                # slot column each dispatch (O(C log C), never O(R*C)),
+                # the full ON-condition re-verifies every candidate, so
+                # hash/lane collisions only cost work, never matches
+                lanes = _bucket_lanes(o_jslot, o_alive, nbl_other,
+                                      lane_k)
+                tb = trig_extra.astype(jnp.int32) % nbl_other
+                cand = lanes[tb]                       # [R, K]
+                cand_ok = cand < C
+                ri2 = jnp.minimum(cand, C - 1)
+                env = {
+                    this.key: tuple(c[:, None] for c in t_cols),
+                    other.key: tuple(c[ri2] for c in o_cols),
+                    "__ts__": orows.ts[:, None],
+                    "__now__": now,
+                }
+                m = jnp.broadcast_to(on.fn(env), ri2.shape)
+                m = jnp.logical_and(m, cand_ok)
+                m = jnp.logical_and(m, o_alive[ri2])
+            elif table_probe:
+                cand_b, ok_b = probe                   # [B, K] host probe
+                B = cand_b.shape[0]
+                bix = jnp.clip(trig_extra, 0, B - 1)
+                cand = cand_b[bix]                     # [R, K]
+                cand_ok = jnp.logical_and(ok_b[bix], cand >= 0)
+                ri2 = jnp.clip(cand, 0, C - 1)
+                env = {
+                    this.key: tuple(c[:, None] for c in t_cols),
+                    other.key: tuple(c[ri2] for c in o_cols),
+                    "__ts__": orows.ts[:, None],
+                    "__now__": now,
+                }
+                m = jnp.broadcast_to(on.fn(env), ri2.shape)
+                m = jnp.logical_and(m, cand_ok)
+                m = jnp.logical_and(m, o_alive[ri2])
+            else:
+                env = {
+                    this.key: tuple(c[:, None] for c in t_cols),
+                    other.key: tuple(c[None, :] for c in o_cols),
+                    "__ts__": orows.ts[:, None],
+                    "__now__": now,
+                }
+                if on is None:
+                    m = jnp.ones((R, C), jnp.bool_)
+                else:
+                    m = jnp.broadcast_to(on.fn(env), (R, C))
+                m = jnp.logical_and(m, o_alive[None, :])
+                ri2 = jnp.broadcast_to(
+                    jnp.arange(C, dtype=jnp.int32)[None, :], (R, C))
             m = jnp.logical_and(m, data_row[:, None])
-            m = jnp.logical_and(m, o_alive[None, :])
 
-            # matched pair rows [R*C] + unmatched rows [R] for outer joins
+            # matched pair rows [R*Q] + unmatched rows [R] for outer
+            # joins; ri carries REAL buffer positions so seq/order match
+            # the grid path bit for bit
+            Q = m.shape[1]
             pair_valid = m.reshape(-1)
-            left_idx = jnp.repeat(jnp.arange(R), C)
-            right_idx = jnp.tile(jnp.arange(C), R)
+            left_idx = jnp.repeat(jnp.arange(R), Q)
+            right_idx = ri2.astype(jnp.int32).reshape(-1)
             unmatched = jnp.logical_and(data_row, jnp.logical_not(
                 jnp.any(m, axis=1)))
             if emit_unmatched_this:
@@ -364,14 +576,14 @@ def plan_join_query(
                 li = jnp.concatenate([left_idx, jnp.arange(R)])
                 ri = jnp.concatenate([right_idx, jnp.zeros((R,), jnp.int32)])
                 null_tail = jnp.concatenate(
-                    [jnp.zeros((R * C,), jnp.bool_), unmatched])
+                    [jnp.zeros((R * Q,), jnp.bool_), unmatched])
             else:
                 all_valid = pair_valid
                 li, ri = left_idx, right_idx
-                null_tail = jnp.zeros((R * C,), jnp.bool_)
+                null_tail = jnp.zeros((R * Q,), jnp.bool_)
 
             N = all_valid.shape[0]
-            this_cols = tuple(c[li] for c in orows.cols)
+            this_cols = tuple(c[li] for c in t_cols)
             # unmatched outer-join rows carry REAL nulls on the other side
             # (reference: JoinProcessor.java:107-190 emits null attributes;
             # numerics use the reserved in-band null, core/event.py)
@@ -452,9 +664,9 @@ def plan_join_query(
         raw_right = make_step(right, left, False)
     # non-triggering stream sides still need their window maintained
     if not left.is_table and raw_left is None:
-        raw_left = _make_feed_only(left, True, mesh)
+        raw_left = _make_feed_only(left, True, mesh, fp_mode)
     if not right.is_table and raw_right is None:
-        raw_right = _make_feed_only(right, False, mesh)
+        raw_right = _make_feed_only(right, False, mesh, fp_mode)
     if raw_left is not None:
         step_left = jit_step(raw_left, owner=name, donate_argnums=(0,))
     if raw_right is not None:
@@ -483,11 +695,25 @@ def plan_join_query(
                     (right.window is not None and right.window.needs_timer),
         emits_uuid=scope.uses_uuid,
         compact_rows=emit_rows, emit_explicit=emit_explicit,
-        raw_left=raw_left, raw_right=raw_right)
+        raw_left=raw_left, raw_right=raw_right,
+        fastpath=fp_mode, fastpath_reason=fp_reason,
+        key_attrs=key_attrs, key_left=key_left, key_right=key_right,
+        key_dtypes=key_dtypes, residual=fp_residual,
+        lane_k=lane_k, lane_buckets=lane_buckets, ring_caps=ring_caps,
+        join_key_allocator=jk_alloc,
+        table_is_left=table_is_left, table_pos=table_pos,
+        stream_key_pos=stream_key_pos)
 
 
-def _make_feed_only(side: JoinSide, is_left: bool, mesh=None):
-    def step(state, ts, kind, valid, cols, gslot, other_table_cols, now):
+def _make_feed_only(side: JoinSide, is_left: bool, mesh=None,
+                    fp_mode: Optional[str] = None):
+    takes_probe = fp_mode in ("bucket", "table")
+
+    def step(state, ts, kind, valid, cols, gslot, *rest):
+        if takes_probe:
+            probe, other_table_cols, now = rest
+        else:
+            other_table_cols, now = rest
         wl_state, wr_state, sel_state = state
         this_state = wl_state if is_left else wr_state
         env0 = {side.key: cols, "__ts__": ts, "__now__": now}
@@ -496,8 +722,13 @@ def _make_feed_only(side: JoinSide, is_left: bool, mesh=None):
         for f in side.pre_filters:
             keep = jnp.logical_and(keep, jnp.logical_or(
                 jnp.logical_not(is_cur), f.fn(env0)))
+        in_cols = cols
+        if fp_mode == "bucket":
+            in_cols = cols + (probe,)
+        elif fp_mode == "table":
+            in_cols = cols + (jnp.arange(ts.shape[0], dtype=jnp.int32),)
         rows = Rows(ts=ts, kind=kind, valid=keep, seq=jnp.zeros_like(ts),
-                    gslot=gslot, cols=cols)
+                    gslot=gslot, cols=in_cols)
         this_state, wout = side.window.process(this_state, rows, now)
         out_empty = (
             jnp.zeros((1,), jnp.int64), jnp.zeros((1,), jnp.int32),
@@ -508,3 +739,184 @@ def _make_feed_only(side: JoinSide, is_left: bool, mesh=None):
             wout.next_wakeup
 
     return step
+
+
+# ---------------------------------------------------------------------------
+# equi-join fast path machinery (ROADMAP item 2)
+# ---------------------------------------------------------------------------
+
+def _retention_rows(win: Optional[WindowProcessor]) -> int:
+    """Upper bound on rows a join window retains: length windows keep
+    exactly `length`; time windows drop-oldest above `capacity`."""
+    if win is None:
+        return 0
+    n = getattr(win, "length", None)
+    if n is None:
+        n = getattr(win, "capacity", None)
+    return int(n if n is not None else win.batch_capacity)
+
+
+def _lane_bucket_count(ring: int) -> int:
+    """Power-of-two lane-table rows for a buffer bound: ~2 buckets per
+    resident row keeps slot-modulo collisions (which only widen lanes,
+    never lose matches) rare while the device table stays small."""
+    return max(64, min(1 << 17, 1 << (2 * max(ring, 1) - 1).bit_length()))
+
+
+def _conjunct_count(on) -> int:
+    from ..query_api.expression import And
+    if on is None:
+        return 0
+    if isinstance(on, And):
+        return _conjunct_count(on.left) + _conjunct_count(on.right)
+    return 1
+
+
+def _bucket_lanes(jslot, alive, nbl: int, k: int):
+    """Derive the per-bucket candidate lane table [nbl, k] from a window
+    buffer's key-slot column: entries are buffer positions ascending
+    within each bucket (grid-path emission order), `C` where a lane is
+    empty.  O(C log C) work on the buffer only — never on the grid.
+    Lane overflow cannot happen by construction: the host
+    JoinKeyTracker grows the planned `k` past the worst same-bucket
+    occupancy BEFORE the batch that would need it dispatches."""
+    C = jslot.shape[0]
+    bkt = jnp.where(alive, jslot.astype(jnp.int32) % nbl, nbl)
+    order = jnp.argsort(bkt, stable=True).astype(jnp.int32)
+    sb = bkt[order]
+    first = jnp.searchsorted(sb, sb, side="left")
+    rank = jnp.arange(C, dtype=jnp.int32) - first.astype(jnp.int32)
+    lanes = jnp.full((nbl + 1, k + 1), C, jnp.int32)
+    lanes = lanes.at[jnp.minimum(sb, nbl),
+                     jnp.minimum(rank, k)].set(order)
+    return lanes[:nbl, :k]
+
+
+def _norm_key_cols(staged_cols, positions, dtypes) -> List[np.ndarray]:
+    """Key columns normalized to the promoted compare dtype so both
+    sides of `L.a == R.b` hash identically (float -0.0 folds into +0.0,
+    same as table_index.AttributeIndex._key_cols)."""
+    out = []
+    for pos, dt in zip(positions, dtypes):
+        c = np.asarray(staged_cols[pos]).astype(dt, copy=False)
+        if np.issubdtype(dt, np.floating):
+            c = c + np.dtype(dt).type(0.0)
+        out.append(np.ascontiguousarray(c))
+    return out
+
+
+class _TrackSide:
+    """One side's retention ring: slot ids of the last `cap` admitted
+    arrivals, plus per-lane (slot % nbl) occupancy counts."""
+
+    __slots__ = ("cap", "nbl", "ring", "head", "n", "lane")
+
+    def __init__(self, cap: int, nbl: int):
+        self.cap = max(1, int(cap))
+        self.nbl = max(1, int(nbl))
+        self.ring = np.full(self.cap, -1, np.int64)
+        self.head = 0
+        self.n = 0
+        self.lane = np.zeros(self.nbl, np.int64)
+
+    def oldest(self, k: int) -> np.ndarray:
+        idx = (self.head + np.arange(k)) % self.cap
+        return self.ring[idx]
+
+    def pop(self, k: int) -> None:
+        self.head = (self.head + k) % self.cap
+        self.n -= k
+
+    def push(self, arr: np.ndarray) -> None:
+        idx = (self.head + self.n + np.arange(arr.size)) % self.cap
+        self.ring[idx] = arr
+        self.n += arr.size
+
+
+class JoinKeyTracker:
+    """Host mirror of per-key window retention for the bucketed
+    equi-join fast path.
+
+    Conservative invariant: each side's ring holds the key slots of the
+    last `cap` admitted arrivals — a SUPERSET of the rows alive in that
+    side's device buffer (length windows retain exactly the last
+    `length` arrivals; time windows drop-oldest above `cap` and time
+    expiry only shrinks the alive set further).  Two guarantees ride on
+    it: (1) the max same-lane occupancy across both rings never
+    under-counts the device buffers, so the planned lane width K always
+    covers every candidate — an under-sized K would silently diverge
+    from the grid path; (2) a key slot recycles only when NEITHER ring
+    retains it, so no alive buffer row can be left holding a slot that
+    a new key re-binds (which would hide its future matches)."""
+
+    def __init__(self, alloc: SlotAllocator, ring_caps, lane_buckets):
+        self.alloc = alloc
+        self.sides = (
+            _TrackSide(ring_caps[0], lane_buckets[0]),
+            _TrackSide(ring_caps[1], lane_buckets[1]),
+        )
+        self.refs = np.zeros(alloc.capacity, np.int64)
+
+    def needed_k(self) -> int:
+        return max(int(s.lane.max(initial=0)) for s in self.sides)
+
+    def _evict(self, s: _TrackSide, incoming: int, dead: set) -> None:
+        k = min(max(s.n + incoming - s.cap, 0), s.n)
+        if k <= 0:
+            return
+        old = s.oldest(k)
+        s.pop(k)
+        np.subtract.at(self.refs, old, 1)
+        np.subtract.at(s.lane, old % s.nbl, 1)
+        for sl in np.unique(old):
+            if self.refs[sl] <= 0:
+                dead.add(int(sl))
+
+    def track(self, is_left: bool, key_cols, valid) -> np.ndarray:
+        """Allocate bucket slots for one batch and fold it into the
+        side's ring.  Evicts BEFORE allocating so the allocator's
+        capacity bound (ring_l + ring_r + one batch) holds transiently,
+        and purges any slot neither ring retains afterwards."""
+        s = self.sides[0 if is_left else 1]
+        nv = int(valid.sum())
+        dead: set = set()
+        if nv:
+            self._evict(s, min(nv, s.cap), dead)
+        slots = self.alloc.slots_for(key_cols, valid)
+        ins = slots[valid].astype(np.int64)
+        skipped = None
+        if ins.size > s.cap:
+            # a batch larger than the window: only its last `cap` rows
+            # survive the step's own eviction — earlier rows join
+            # transiently within the step but retain nothing
+            skipped, ins = ins[:-s.cap], ins[-s.cap:]
+        if ins.size:
+            np.add.at(self.refs, ins, 1)
+            np.add.at(s.lane, ins % s.nbl, 1)
+            s.push(ins)
+        if skipped is not None:
+            dead.update(int(x) for x in np.unique(skipped))
+        gone = [d for d in dead if self.refs[d] <= 0]
+        if gone:
+            self.alloc.purge(gone)
+        return slots
+
+    def rebuild(self, per_side_slots) -> None:
+        """Restore path: re-seed both rings from the snapshot's buffer
+        contents (alive rows in arrival order) and drop every allocator
+        binding neither window retains."""
+        self.refs[:] = 0
+        self.sides = tuple(
+            _TrackSide(s.cap, s.nbl) for s in self.sides)
+        for s, slots in zip(self.sides, per_side_slots):
+            arr = np.asarray(slots, np.int64)[-s.cap:]
+            if arr.size:
+                np.add.at(self.refs, arr, 1)
+                np.add.at(s.lane, arr % s.nbl, 1)
+                s.push(arr)
+        live = np.zeros(self.alloc.capacity, bool)
+        for key, slot in self.alloc.snapshot().items():
+            live[slot] = True
+        gone = np.nonzero(live & (self.refs <= 0))[0]
+        if gone.size:
+            self.alloc.purge([int(x) for x in gone])

@@ -235,13 +235,34 @@ def query_state_components(app, q, kind: str, part,
         return {"window": per_key * (keys if part is not None else 1)}
     if kind == "join":
         out: Dict[str, int] = {}
+
+        def _kind_of(sid):
+            if sid in app.aggregation_definition_map:
+                return "aggregation"
+            if sid in app.window_definition_map:
+                return "named_window"
+            if sid in app.table_definition_map:
+                return "table"
+            return "stream"
+
+        def _probe_attrs(sid):
+            d = app.table_definition_map.get(sid)
+            return table_probe_attrs_of(d) if d is not None else []
+
+        try:
+            fp_mode, _, _ = join_fastpath(q.input_stream, _kind_of,
+                                          _probe_attrs)
+        except Exception:  # noqa: BLE001 — estimator must not throw
+            fp_mode = None
+        # bucketed sides carry one extra i32 key-slot column per row
+        extra = 4 if fp_mode == "bucket" else 0
         for side, sis in (("join.left", q.input_stream.left_input_stream),
                           ("join.right",
                            q.input_stream.right_input_stream)):
             win = window_handler(sis)
             if win is not None:
                 out[side] = window_capacity(win, WINDOW_HINT) * \
-                    row_bytes(stream_def(sis.stream_id))
+                    (row_bytes(stream_def(sis.stream_id)) + extra)
         return out
     # pattern: per-key NFA slot block — `slots` pending matches per key,
     # each capturing one row per pattern state
@@ -273,6 +294,145 @@ def static_state_bytes(app) -> int:
     """Total static state estimate across the app's queries."""
     return sum(sum(c.values())
                for c in static_state_components(app).values())
+
+
+# ---------------------------------------------------------------------------
+# equi-join fast-path facts (shared by the join planner, lint JOIN002,
+# and EXPLAIN — one implementation, one set of reason strings, so lint
+# prints exactly the condition the wiring tested)
+# ---------------------------------------------------------------------------
+
+def join_equi_pairs(jis) -> List[Tuple[object, object, object]]:
+    """Top-level `==` conjuncts of a join ON-condition comparing one
+    side-qualified attribute from each side: [(Compare node, left
+    Variable, right Variable)], the left side's variable first whatever
+    the written order.  The same shape analysis/typeflow._equi_conjuncts
+    reports — kept AST-only so the planner can run it pre-compile."""
+    from ..query_api import expression as ex
+    on = getattr(jis, "on_compare", None)
+    if on is None:
+        return []
+    ls, rs = jis.left_input_stream, jis.right_input_stream
+    left_keys = {ls.stream_reference_id or ls.stream_id, ls.stream_id}
+    right_keys = {rs.stream_reference_id or rs.stream_id, rs.stream_id}
+
+    def conjuncts(e):
+        if isinstance(e, ex.And):
+            yield from conjuncts(e.left)
+            yield from conjuncts(e.right)
+        else:
+            yield e
+
+    def side_of(v):
+        if v.stream_id in left_keys:
+            return "left"
+        if v.stream_id in right_keys:
+            return "right"
+        return None
+
+    out: List[Tuple[object, object, object]] = []
+    for c in conjuncts(on):
+        if not isinstance(c, ex.Compare) or c.operator != "==":
+            continue
+        if not (isinstance(c.left, ex.Variable) and
+                isinstance(c.right, ex.Variable)):
+            continue
+        sides = (side_of(c.left), side_of(c.right))
+        if sides == ("left", "right"):
+            out.append((c, c.left, c.right))
+        elif sides == ("right", "left"):
+            out.append((c, c.right, c.left))
+    return out
+
+
+# lane width floor for the bucketed join probe; host occupancy tracking
+# grows it in power-of-two steps (core/join.py JoinKeyTracker)
+JOIN_LANE_K_MIN = 8
+
+
+def join_fastpath(jis, side_kind, table_probe_attrs=None
+                  ) -> Tuple[Optional[str], List, Optional[str]]:
+    """Equi-join fast-path decision: (mode, pairs, reason).
+
+    mode 'bucket' — both sides are stream windows: key slots ride the
+    window buffers and the step probes only same-bucket pairs.
+    mode 'table' — one side is an indexed table and the trigger side is
+    a windowless stream: the table's AttributeIndex/primary-key hash
+    answers candidates host-side.  mode None + reason — an equality
+    conjunct exists but the fast path cannot apply (lint JOIN002 WARNs
+    with exactly this string).  mode None + reason None — no equality
+    conjunct (nothing to accelerate, JOIN002 stays silent).
+
+    `side_kind(sid)` -> 'stream'|'table'|'named_window'|'aggregation';
+    `table_probe_attrs(sid)` -> attribute names probe-able through a
+    single-column @PrimaryKey or an @Index (table mode only)."""
+    pairs = join_equi_pairs(jis)
+    if not pairs:
+        return None, [], None
+    sides = {}
+    for label, sis in (("left", jis.left_input_stream),
+                       ("right", jis.right_input_stream)):
+        sides[label] = (sis, side_kind(sis.stream_id))
+    kinds = {label: k for label, (_, k) in sides.items()}
+    for label, (sis, kind) in sides.items():
+        if kind in ("named_window", "aggregation"):
+            return None, pairs, (
+                f"{label} side {sis.stream_id!r} is a {kind} — its rows "
+                f"are probed from a shared buffer the join cannot carry "
+                f"key slots through")
+    if kinds["left"] == "stream" and kinds["right"] == "stream":
+        from ..query_api.query import Filter
+        for label, (sis, _) in sides.items():
+            if any(isinstance(h, Filter) for h in sis.stream_handlers):
+                return None, pairs, (
+                    f"{label} side {sis.stream_id!r} has a stream filter "
+                    f"— host key-retention tracking would under-count "
+                    f"the window and could free live key buckets")
+        return "bucket", pairs, None
+    # stream-table: the stream side triggers, the table answers probes
+    t_label = "left" if kinds["left"] == "table" else "right"
+    s_label = "right" if t_label == "left" else "left"
+    t_sis = sides[t_label][0]
+    s_sis = sides[s_label][0]
+    if kinds[s_label] != "stream":
+        return None, pairs, "cannot join two table-like sides"
+    if window_handler(s_sis) is not None:
+        return None, pairs, (
+            f"windowed stream side {s_sis.stream_id!r} joining table "
+            f"{t_sis.stream_id!r} — buffered rows cannot re-probe the "
+            f"table index at step time")
+    probe_attrs = set(table_probe_attrs(t_sis.stream_id)) \
+        if table_probe_attrs is not None else set()
+    usable = []
+    for c, lv, rv in pairs:
+        t_var = lv if t_label == "left" else rv
+        if t_var.attribute_name in probe_attrs:
+            usable.append((c, lv, rv))
+    if not usable:
+        attrs = ", ".join(
+            repr((lv if t_label == "left" else rv).attribute_name)
+            for _, lv, rv in pairs)
+        return None, pairs, (
+            f"table {t_sis.stream_id!r} has no single-column @PrimaryKey "
+            f"or @Index on join key {attrs} — equality probes stay "
+            f"linear scans")
+    return "table", usable, None
+
+
+def table_probe_attrs_of(tdef) -> List[str]:
+    """Attribute names of a TableDefinition probe-able by hash: a
+    single-column @PrimaryKey plus every @Index attribute (reference:
+    EventHolderPasser.java builds exactly these maps)."""
+    out: List[str] = []
+    pk = tdef.get_annotation("PrimaryKey")
+    if pk is not None:
+        names = pk.positional_elements()
+        if len(names) == 1:
+            out.append(names[0])
+    idx = tdef.get_annotation("Index")
+    if idx is not None:
+        out.extend(n for n in idx.positional_elements() if n not in out)
+    return out
 
 
 def format_component_bytes(comps: Dict[str, int],

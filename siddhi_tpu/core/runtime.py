@@ -1200,6 +1200,16 @@ class JoinQueryRuntime(_MeshResolved):
         self._replan = None
         # @fuse(batches=K): stack buffer for scan-fused dispatch, or None
         self._fuse = None
+        # equi-join bucket fast path: host retention mirror + the lane
+        # width the NEXT replan must keep (core/join.py JoinKeyTracker)
+        self._jk = None
+        self._lane_k = 0
+        if planned.fastpath == "bucket":
+            from .join import JoinKeyTracker
+            self._jk = JoinKeyTracker(planned.join_key_allocator,
+                                      planned.ring_caps,
+                                      planned.lane_buckets)
+            self._lane_k = planned.lane_k
 
     @property
     def name(self):
@@ -1247,8 +1257,104 @@ class JoinQueryRuntime(_MeshResolved):
         # read self.planned once; they must never observe empty allocators)
         newp.slot_allocator = old.slot_allocator
         newp.slot_allocator2 = old.slot_allocator2
+        newp.join_key_allocator = old.join_key_allocator
         self.planned = newp
         return True
+
+    def _join_key_probe(self, is_left: bool,
+                        staged: ev.StagedBatch) -> np.ndarray:
+        """Key bucket slots for one arriving batch (bucket fast path).
+        Cached on the staged batch — keyed by (runtime, side), since a
+        junction hands ONE staged object to every subscriber and a
+        self-join sees it on both sides — so fused-drain re-entries and
+        deferred dispatches can never double-count the retention
+        mirror.  Grows the planned lane width BEFORE the dispatch that
+        would overflow it."""
+        cache = staged.jprobe
+        if cache is None:
+            cache = staged.jprobe = {}
+        key = (id(self), is_left)
+        cached = cache.get(key)
+        if cached is not None:
+            return cached
+        from .join import _norm_key_cols
+        p = self.planned
+        kvalid = staged.valid & (staged.kind == ev.CURRENT)
+        pos = p.key_left if is_left else p.key_right
+        slots = self._jk.track(
+            is_left, _norm_key_cols(staged.cols, pos, p.key_dtypes),
+            kvalid)
+        need = self._jk.needed_k()
+        if need > p.lane_k:
+            self._grow_lane_k(need)
+        out = np.where(kvalid, slots, -1).astype(np.int32)
+        cache[key] = out
+        return out
+
+    def _grow_lane_k(self, need: int) -> None:
+        """Recompile the side steps with wider candidate lanes.  Called
+        BEFORE the batch that needs them dispatches, so the device
+        program can never silently drop same-bucket candidates (which
+        would diverge from the grid path).  State shapes are
+        lane-independent — window/selector state carries over live."""
+        new_k = 1 << (max(need, 1) - 1).bit_length()
+        logging.getLogger("siddhi_tpu").info(
+            "%s: growing equi-join candidate lanes to %d (max same-"
+            "bucket window occupancy %d)", self.name, new_k, need)
+        stats = self.app.stats
+        if stats.enabled:
+            stats.counter_inc(f"{self.name}.lane_growths")
+        fb = self._fuse
+        if fb is not None:
+            # the pending stack was offered under the old lane width;
+            # drain it sequentially first (byte-identical by contract)
+            fb.drain()
+        self._lane_k = new_k
+        old = self.planned
+        newp = self._replan(None if old.emit_explicit
+                            else old.compact_rows)
+        newp.slot_allocator = old.slot_allocator
+        newp.slot_allocator2 = old.slot_allocator2
+        newp.join_key_allocator = old.join_key_allocator
+        self.planned = newp
+
+    def _table_probe(self, staged: ev.StagedBatch):
+        """Host-side table-index candidates for one trigger batch
+        (table fast path): [B, K] row ids ascending per row (the grid
+        path's emission order) + their validity."""
+        p = self.planned
+        tid = (p.left if p.table_is_left else p.right).stream_id
+        table = self.app.tables[tid]
+        vals = np.asarray(staged.cols[p.stream_key_pos])
+        with table._lock:
+            cand, ok = table.probe_rows(p.table_pos, vals)
+        big = np.int32(np.iinfo(np.int32).max)
+        cand = np.where(ok, cand, big)
+        cand.sort(axis=1)
+        ok = cand < big
+        return np.where(ok, cand, -1).astype(np.int32), ok
+
+    def _after_restore(self, host_state) -> None:
+        """Re-seed the key retention mirror from restored window
+        buffers (alive rows in arrival order) and re-widen lanes if the
+        snapshot needs more than the current plan carries."""
+        p = self.planned
+        if p.fastpath != "bucket" or self._jk is None:
+            return
+        sides = []
+        for st in (host_state[0], host_state[1]):
+            slots = np.empty(0, np.int64)
+            buf = st[0] if isinstance(st, tuple) and st else None
+            if buf is not None and hasattr(buf, "alive"):
+                alive = np.asarray(buf.alive)
+                add_seq = np.asarray(buf.add_seq)[alive]
+                slots = np.asarray(buf.cols[-1])[alive][
+                    np.argsort(add_seq, kind="stable")].astype(np.int64)
+            sides.append(slots)
+        self._jk.rebuild(sides)
+        need = self._jk.needed_k()
+        if need > p.lane_k:
+            self._grow_lane_k(need)
 
     def place_state(self, state):
         """GSPMD scale-out: shard window buffers / selector slabs on axis 0
@@ -1301,6 +1407,12 @@ class JoinQueryRuntime(_MeshResolved):
     def process_staged(self, is_left: bool, staged: ev.StagedBatch,
                        now: int) -> None:
         p = self.planned
+        probe = None
+        if p.fastpath == "bucket":
+            # slot binding + retention mirror BEFORE the fuse offer: a
+            # lane-width growth must replan before this batch dispatches
+            probe = self._join_key_probe(is_left, staged)
+            p = self.planned          # _grow_lane_k may have swapped it
         side = p.left if is_left else p.right
         step = p.step_left if is_left else p.step_right
         if step is None:
@@ -1311,12 +1423,17 @@ class JoinQueryRuntime(_MeshResolved):
             return
         gslot = self._join_slots(is_left, staged)
         batch = staged.to_device(side.schema)
+        args = [self.state, batch.ts, batch.kind, batch.valid, batch.cols,
+                jax.numpy.asarray(gslot)]
+        if p.fastpath == "bucket":
+            args.append(jax.numpy.asarray(probe))
+        elif p.fastpath == "table":
+            cand, ok = self._table_probe(staged)
+            args.append((jax.numpy.asarray(cand), jax.numpy.asarray(ok)))
+        args += [self._other_table(is_left),
+                 jax.numpy.asarray(now, jax.numpy.int64)]
         with _maybe_span("step", query=self.name, kind="join"):
-            self.state, out, wake = step(
-                self.state, batch.ts, batch.kind, batch.valid, batch.cols,
-                jax.numpy.asarray(gslot),
-                self._other_table(is_left),
-                jax.numpy.asarray(now, jax.numpy.int64))
+            self.state, out, wake = step(*args)
         _emit_output(self, out, now,
                      wake=wake if p.needs_timer else None)
 
@@ -2661,8 +2778,15 @@ class SiddhiAppRuntime:
             named_windows=self.named_windows, mesh=self.mesh)
         planned = plan()
         runtime = JoinQueryRuntime(planned, self)
-        # the SAME partial replans on emission-cap growth
-        runtime._replan = lambda rows, _p=plan: _p(emit_rows_override=rows)
+
+        # the SAME partial replans on emission-cap growth AND equi-join
+        # lane growth; the runtime's current lane width always rides
+        # along so one growth can never silently reset the other
+        def _join_replan(rows=None, _p=plan, _rt=runtime, **kw):
+            if getattr(_rt, "_lane_k", 0):
+                kw.setdefault("lane_k_override", _rt._lane_k)
+            return _p(emit_rows_override=rows, **kw)
+        runtime._replan = _join_replan
         runtime.async_emit = self._async_enabled(q)
         runtime.pipeline_emit = self._pipeline_enabled(q)
         self._maybe_fuse(runtime, q, "join")
@@ -3538,11 +3662,13 @@ class SiddhiAppRuntime:
                 host_state = jax.tree.map(lambda x: np.asarray(x), qr.state)
                 alloc = _allocator_of(qr)
                 alloc2 = getattr(qr.planned, "slot_allocator2", None)
+                jk = getattr(qr.planned, "join_key_allocator", None)
                 states[name] = {
                     "state": host_state,
                     "slots": alloc.snapshot() if alloc is not None else None,
                     "slots2": alloc2.snapshot()
                     if alloc2 is not None else None,
+                    "slots_jk": jk.snapshot() if jk is not None else None,
                     "slots_pairs": [
                         a.snapshot() for a, _ in
                         getattr(qr.planned, "pair_allocs", [])] or None,
@@ -3608,6 +3734,7 @@ class SiddhiAppRuntime:
                     dirty[:] = False
                 else:
                     alloc2 = getattr(qr.planned, "slot_allocator2", None)
+                    jk = getattr(qr.planned, "join_key_allocator", None)
                     deltas[name] = {
                         "kind": "full",
                         "state": jax.tree.map(
@@ -3616,6 +3743,8 @@ class SiddhiAppRuntime:
                         if alloc is not None else None,
                         "slots2": alloc2.snapshot()
                         if alloc2 is not None else None,
+                        "slots_jk": jk.snapshot()
+                        if jk is not None else None,
                         "slots_pairs": [
                             a.snapshot() for a, _ in
                             getattr(qr.planned, "pair_allocs", [])] or None,
@@ -3711,12 +3840,17 @@ class SiddhiAppRuntime:
                     alloc2 = getattr(qr.planned, "slot_allocator2", None)
                     if d.get("slots2") is not None and alloc2 is not None:
                         alloc2.restore(d["slots2"])
+                    jk = getattr(qr.planned, "join_key_allocator", None)
+                    if d.get("slots_jk") is not None and jk is not None:
+                        jk.restore(d["slots_jk"])
                     pairs = d.get("slots_pairs")
                     if pairs:
                         for (a, _), snap in zip(
                                 getattr(qr.planned, "pair_allocs", []),
                                 pairs):
                             a.restore(snap)
+                    if hasattr(qr, "_after_restore"):
+                        qr._after_restore(host_state)
                 w = d.get("wake")
                 if w is not None and hasattr(qr, "_apply_wake"):
                     qr._apply_wake(int(w))
@@ -3743,11 +3877,16 @@ class SiddhiAppRuntime:
                 alloc2 = getattr(qr.planned, "slot_allocator2", None)
                 if data.get("slots2") is not None and alloc2 is not None:
                     alloc2.restore(data["slots2"])
+                jk = getattr(qr.planned, "join_key_allocator", None)
+                if data.get("slots_jk") is not None and jk is not None:
+                    jk.restore(data["slots_jk"])
                 pairs = data.get("slots_pairs")
                 if pairs:
                     for (a, _), snap in zip(
                             getattr(qr.planned, "pair_allocs", []), pairs):
                         a.restore(snap)
+                if hasattr(qr, "_after_restore"):
+                    qr._after_restore(host_state)
                 # re-arm pending timers (absent deadlines, window expiry):
                 # the scheduler of this fresh runtime knows nothing of the
                 # wakeups the snapshotted state still expects

@@ -247,6 +247,15 @@ class TableRuntime:
         cand = slots.astype(np.int32)[:, None]
         return cand, cand >= 0
 
+    def probe_rows(self, pos: int, values: np.ndarray):
+        """Public index probe for the equi-join fast path (and tests):
+        candidate row ids per value via the @Index lane table or the
+        primary-key allocator — one vectorized lookup, no device work.
+        Candidates narrow; the caller's full-condition re-check decides
+        (exactly the `_match` contract)."""
+        self.index_stats["indexed"] += 1
+        return self._probe_candidates(pos, values)
+
     def _match(self, cond, other_key: str, batch: ev.EventBatch,
                staged: Optional[ev.StagedBatch] = None):
         """Unified match for delete/update paths.
@@ -387,13 +396,6 @@ class TableRuntime:
                         tuple(batch.cols[i] for i in insert_map)
                         if insert_map else batch.cols)
                     self.insert(sub_batch, sub_staged)
-
-    def contains_fn(self, compiled: CompiledExpr, other_key: str):
-        """Probe for the `in` operator: fn(batch)->[B] bool."""
-        def probe(batch: ev.EventBatch):
-            m = self.match_matrix(compiled, other_key, batch)
-            return jnp.any(m, axis=1)
-        return probe
 
     def snapshot_rows(self) -> List[ev.Event]:
         with self._lock:

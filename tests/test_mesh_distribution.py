@@ -74,10 +74,13 @@ def test_join_step_hlo_has_collectives(manager):
     staged = ev.pack_np(side.schema, [ev.Event(2000, [1, 1.0])])
     batch = staged.to_device(side.schema)
     gslot = jnp.zeros((staged.ts.shape[0],), jnp.int32)
-    hlo = jqr.planned.step_left.lower(
-        jqr.state, batch.ts, batch.kind, batch.valid, batch.cols, gslot,
-        jqr._other_table(True), jnp.asarray(2000, jnp.int64)
-    ).compile().as_text()
+    args = [jqr.state, batch.ts, batch.kind, batch.valid, batch.cols,
+            gslot]
+    if jqr.planned.fastpath == "bucket":
+        # equi-join fast path: key bucket slots ride as an extra arg
+        args.append(jnp.zeros((staged.ts.shape[0],), jnp.int32))
+    args += [jqr._other_table(True), jnp.asarray(2000, jnp.int64)]
+    hlo = jqr.planned.step_left.lower(*args).compile().as_text()
     assert any(tok in hlo for tok in (
         "all-gather", "all-reduce", "collective-permute", "all-to-all",
         "reduce-scatter")), "sharded join step compiled without collectives"

@@ -193,9 +193,35 @@ def test_join002_fires_on_equality_conjunct():
     found = _findings(OUTER.format(jt="join"), "JOIN002")
     assert len(found) == 1
     f = found[0]
+    # fast path applies to this shape -> INFO naming the key attrs
     assert f.severity == "INFO" and f.query == "oj"
-    assert "L.id == R.id" in f.message and "item 2" in f.message
+    assert "L.id == R.id" in f.message and "ACTIVE" in f.message
     assert f.pos is not None              # cites the condition
+
+
+def test_join002_warns_when_fastpath_inapplicable():
+    # a side [filter] blocks the bucket fast path: the equality conjunct
+    # exists but the grid stays -> WARN with the wiring's reason
+    src = """
+    define stream L (id int, price float);
+    define stream R (id int, qty int);
+    @info(name='fj')
+    from L[price > 0.0]#window.length(8) join R#window.length(8)
+      on L.id == R.id
+    select L.id as id insert into J;
+    """
+    found = _findings(src, "JOIN002")
+    assert len(found) == 1
+    f = found[0]
+    assert f.severity == "WARN"
+    assert "filter" in f.message and "grid" in f.message
+    # the reason string is the planner's own (core/plan_facts)
+    from siddhi_tpu import SiddhiManager
+    m = SiddhiManager()
+    rt = m.create_siddhi_app_runtime(src)
+    p = rt.query_runtimes["fj"].planned
+    assert p.fastpath is None and p.fastpath_reason in f.message
+    m.shutdown()
 
 
 def test_join002_silent_on_pure_range_join():
