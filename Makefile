@@ -5,11 +5,11 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: test lint lint-apps lint-smoke dryrun bench metrics-smoke \
 	fuse-smoke explain-smoke chaos-smoke multichip-smoke soak-smoke \
 	admission-smoke audit audit-update audit-smoke docgen-check \
-	join-smoke all
+	join-smoke mqo-smoke all
 
 all: lint lint-apps docgen-check audit test dryrun metrics-smoke \
 	fuse-smoke explain-smoke lint-smoke chaos-smoke multichip-smoke \
-	soak-smoke admission-smoke audit-smoke join-smoke
+	soak-smoke admission-smoke audit-smoke join-smoke mqo-smoke
 
 # static gate on our own code: ruff (rule set in pyproject.toml) when
 # available, with compileall kept as the syntax floor for samples and
@@ -115,6 +115,17 @@ soak-smoke:
 # collapsed vs the grid plan (README "Equi-join fast path")
 join-smoke:
 	$(CPU_ENV) $(PY) samples/join_smoke.py
+
+# multi-query optimizer (ROADMAP item 3) in <60 s: a 7-query app merges
+# into one dispatch group with byte-identical per-query outputs vs the
+# unmerged plan, the shared window buffer counted ONCE under the group,
+# EXPLAIN/MQO001/static lint agreeing on the grouping, snapshots
+# round-tripping merged<->unmerged, and per-query accounting + an
+# admission quota surviving the merge (README "Multi-query
+# optimization"); plus the quick dispatch/throughput A-B
+mqo-smoke:
+	$(CPU_ENV) $(PY) samples/mqo_smoke.py
+	$(CPU_ENV) $(PY) bench.py --mode mqo_compare --quick
 
 # overload is decided, not discovered, in <30 s: an over-ceiling deploy
 # denied BEFORE any compile, exact shed accounting (offered == accepted

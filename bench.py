@@ -506,6 +506,180 @@ def run_join_compare(B=1 << 10, n_batches=8, out_path=None):
     return payload
 
 
+def _mqo_ql(n_queries):
+    """The mqo_compare app: N co-resident queries on ONE stream — half
+    plain filters (each its own threshold), half window aggregations
+    sharing the identical pre-filter + window.length(128) + group-by
+    (the surveillance/fraud/IoT tenant shape ROADMAP item 3 names).
+    The multi-query optimizer merges all of them into one dispatch; the
+    aggregation half additionally shares ONE window buffer."""
+    aggs = ["sum(v) as a", "max(v) as a", "min(v) as a", "avg(v) as a",
+            "count() as a"]
+    lines = ["define stream S (key long, v double, c int);"]
+    for i in range(n_queries):
+        if i % 2 == 0:
+            t = 1.0 + (i % 7)
+            lines.append(
+                f"@info(name='q{i}') from S[v > {t} and c != {i % 5}] "
+                f"select key, v insert into F{i};")
+        else:
+            lines.append(
+                f"@info(name='q{i}') from S[v > 0.0]"
+                f"#window.length(128) select key, {aggs[i % 5]} "
+                f"group by key insert into W{i};")
+    return "\n".join(lines)
+
+
+def run_mqo_compare(n_queries=50, B=1 << 11, n_batches=24,
+                    out_path=None, check_bars=True):
+    """--mode mqo_compare: the ROADMAP item-3 A-B artifact — a
+    {n_queries}-query single-stream app served with the multi-query
+    optimizer ON (merged dispatch, default) vs OFF
+    (optimizer.merge.enabled=false), byte-identical per-query outputs
+    asserted on a seeded prefix, then throughput + dispatch counts
+    measured with a counting batch callback on EVERY query (each
+    emission is consumed, as a dashboard tenant would)."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.utils.config import InMemoryConfigManager
+
+    ql = _mqo_ql(n_queries)
+    qnames = [f"q{i}" for i in range(n_queries)]
+    rng = np.random.default_rng(11)
+    sends = []
+    for i in range(n_batches + 4):
+        sends.append((
+            [rng.integers(0, 64, B).astype(np.int64),
+             rng.random(B).astype(np.float64) * 10.0,
+             rng.integers(0, 8, B).astype(np.int32)],
+            1000 + i * 50 + np.arange(B, dtype=np.int64) % 50))
+
+    # -- parity: byte-identical per-query outputs on a seeded prefix ----
+    def capture(merge, k=6):
+        manager = SiddhiManager()
+        if not merge:
+            manager.set_config_manager(InMemoryConfigManager(
+                {"optimizer.merge.enabled": "false"}))
+        rt = manager.create_siddhi_app_runtime(ql)
+        outs = {q: [] for q in qnames}
+        for q in qnames:
+            rt.add_callback(q, lambda ts, cur, exp, _q=q: outs[_q].append(
+                ([e.data for e in (cur or [])],
+                 [e.data for e in (exp or [])])))
+        rt.start()
+        h = rt.get_input_handler("S")
+        for cols, ts in sends[:k]:
+            h.send_columns([c.copy() for c in cols],
+                           timestamps=ts.copy())
+        rt.flush()
+        groups = sorted(getattr(rt, "merged_groups", {}))
+        manager.shutdown()
+        return outs, groups
+
+    merged_outs, groups = capture(True)
+    unmerged_outs, _ = capture(False)
+    identical = merged_outs == unmerged_outs
+    print(f"mqo_compare parity: byte-identical={identical} over "
+          f"{sum(len(v) for v in merged_outs.values())} emissions / "
+          f"{n_queries} queries (groups={groups})", file=sys.stderr)
+    assert identical, "merged vs unmerged per-query outputs diverged"
+
+    # -- throughput + dispatch count A/B --------------------------------
+    results = {}
+    for tag, merge in (("merged", True), ("unmerged", False)):
+        manager = SiddhiManager()
+        if not merge:
+            manager.set_config_manager(InMemoryConfigManager(
+                {"optimizer.merge.enabled": "false"}))
+        rt = manager.create_siddhi_app_runtime(ql)
+        counts = {q: 0 for q in qnames}
+        for q in qnames:
+            rt.add_batch_callback(q, lambda ts, b, _q=q: counts.__setitem__(
+                _q, counts[_q] + b["n_valid"]))
+        # count ACTUAL jitted-step invocations in both modes by wrapping
+        # the compiled entry points (in-process bench, zero steady cost)
+        disp = [0]
+
+        def _wrap(fn):
+            def counted(*a, **kw):
+                disp[0] += 1
+                return fn(*a, **kw)
+            return counted
+        if merge:
+            for mg in rt.merged_groups.values():
+                mg._step = _wrap(mg._step)
+        else:
+            for q in qnames:
+                qr = rt.query_runtimes[q]
+                qr.planned.step = _wrap(qr.planned.step)
+        rt.start()
+        h = rt.get_input_handler("S")
+        for cols, ts in sends[:4]:          # warmup / compile
+            h.send_columns([c.copy() for c in cols],
+                           timestamps=ts.copy())
+        rt.flush()
+        warm_counts = dict(counts)
+        warm_disp = disp[0]
+        lat = []
+        t0 = time.perf_counter()
+        for cols, ts in sends[4:4 + n_batches]:
+            tb = time.perf_counter()
+            h.send_columns([c.copy() for c in cols],
+                           timestamps=ts.copy())
+            lat.append(time.perf_counter() - tb)
+        rt.flush()
+        dt = time.perf_counter() - t0
+        events = n_batches * B
+        dispatches = disp[0] - warm_disp
+        rows = sum(counts[q] - warm_counts[q] for q in qnames)
+        eps = events / dt
+        stats = _lat_stats(lat)
+        results[tag] = {
+            "value": round(eps), "unit": "events/sec",
+            "aggregate_query_events_per_sec": round(eps * n_queries),
+            "dispatches": int(dispatches),
+            "rows_delivered": int(rows),
+            "state_bytes": sum(
+                n for comps in rt.state_memory().values()
+                for n in comps.values()),
+            **stats,
+        }
+        print(f"mqo_compare[{tag}]: {eps:,.0f} ev/s x {n_queries} "
+              f"queries, {dispatches} dispatches, "
+              f"p50={stats['p50_ms']}ms p99={stats['p99_ms']}ms",
+              file=sys.stderr)
+        manager.shutdown()
+    base = results["unmerged"]["value"]
+    fast = results["merged"]["value"]
+    disp_ratio = results["merged"]["dispatches"] / \
+        max(1, results["unmerged"]["dispatches"])
+    payload = {
+        "metric": "mqo_compare_events_per_sec",
+        "queries": n_queries, "batch": B, "n_batches": n_batches,
+        "speedup": round(fast / max(base, 1), 2),
+        "dispatch_ratio": round(disp_ratio, 4),
+        "outputs_byte_identical": identical,
+        "merge_groups": groups,
+        "state_bytes_saved": results["unmerged"]["state_bytes"] -
+        results["merged"]["state_bytes"],
+        "configs": results,
+        "shape": "bench._mqo_ql (half filters, half shared-window "
+                 "aggregations on one stream)",
+        "bars": {"dispatch_ratio<=0.25": disp_ratio <= 0.25,
+                 "aggregate_speedup>=4x": fast / max(base, 1) >= 4.0},
+    }
+    print(json.dumps(payload))
+    ok = payload["bars"]["dispatch_ratio<=0.25"] and \
+        payload["bars"]["aggregate_speedup>=4x"]
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"wrote {out_path}", file=sys.stderr)
+    if check_bars and not ok:
+        print(f"MQO BARS MISSED: {payload['bars']}", file=sys.stderr)
+        sys.exit(1)
+    return payload
+
+
 def _join_cost_fingerprint():
     """Hot-path flops/bytes of the CURRENT windowed_join plan (both side
     steps summed) via the audit extractor — traffic-free, synthesized
@@ -1553,7 +1727,7 @@ if __name__ == "__main__":
     ap.add_argument("--mode", default="full",
                     choices=["full", "device_loop", "fuse_compare",
                              "cost_analysis", "multichip", "soak",
-                             "join_compare"],
+                             "join_compare", "mqo_compare"],
                     help="full: the flagship suite (default); "
                          "device_loop: tunnel-independent chip-side "
                          "events/sec via fused dispatch re-execution; "
@@ -1567,7 +1741,11 @@ if __name__ == "__main__":
                          "(SOAK artifact); "
                          "join_compare: windowed_join equi-join fast "
                          "path ON vs OFF + bytes-accessed delta "
-                         "(JOIN artifact)")
+                         "(JOIN artifact); "
+                         "mqo_compare: 50-query single-stream app with "
+                         "the multi-query optimizer ON vs OFF — "
+                         "byte-identical outputs asserted, dispatch "
+                         "count + aggregate ev/s A/B (MQO artifact)")
     ap.add_argument("--k", type=int, default=16,
                     help="fused stack depth (device_loop/fuse_compare)")
     ap.add_argument("--batch", type=int, default=1 << 11,
@@ -1611,6 +1789,14 @@ if __name__ == "__main__":
         run_join_compare(B=1 << 8 if args.quick else 1 << 10,
                          n_batches=2 if args.quick else 8,
                          out_path=args.out)
+    elif args.mode == "mqo_compare":
+        _enable_compile_cache()
+        # quick mode shrinks the app below the 50-query artifact shape,
+        # so the 4x/quarter-dispatch bars apply only to the full run
+        run_mqo_compare(n_queries=12 if args.quick else 50,
+                        B=1 << 9 if args.quick else 1 << 10,
+                        n_batches=8 if args.quick else 24,
+                        out_path=args.out, check_bars=not args.quick)
     elif args.mode == "multichip":
         _enable_compile_cache()
         run_multichip(quick=args.quick, out_path=args.out)

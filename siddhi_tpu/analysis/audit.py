@@ -95,6 +95,12 @@ METRICS: List[Metric] = [
            "the inapplicability reason) — a silently deactivated fast "
            "path is a 10-100x regression the float metrics would also "
            "catch, this names the cause"),
+    Metric("merge", 0.0, "exact",
+           "multi-query merge fact: group membership, shared/stacked "
+           "mode, and the group's dispatch-program count (or the "
+           "planner's ineligibility reason) — an accidentally unmerged "
+           "group re-pays N dispatches per batch, which wall-clock "
+           "benchmarks would catch late and this pins in CI"),
 ]
 
 DEFAULT_TOLERANCES: Dict[str, float] = {m.name: m.tolerance
@@ -108,6 +114,18 @@ _MEM_FLOAT_METRICS = ("argument_bytes", "output_bytes", "temp_bytes",
 # ---------------------------------------------------------------------------
 # fingerprint extraction
 # ---------------------------------------------------------------------------
+
+def _merge_fact(qr) -> Dict:
+    """Multi-query-optimizer fact pinned per query (core/plan_facts.
+    merge_facts): group membership + mode when merged, the exact
+    ineligibility reason otherwise.  Exact-match gated — an accidental
+    unmerge flips `merged` and fails the build."""
+    from ..core.plan_facts import merge_facts
+    try:
+        return merge_facts(qr)
+    except Exception:  # noqa: BLE001 — extraction must not kill audit
+        return {"merged": False}
+
 
 def query_fingerprint(rt, qname: str, typeflow_summary: Optional[Dict]
                       = None, collectives: bool = False) -> Dict:
@@ -172,6 +190,7 @@ def query_fingerprint(rt, qname: str, typeflow_summary: Optional[Dict]
             "cap_explicit": bool(getattr(p, "emit_explicit", False)),
         },
         "fusion": _fusion.eligibility(qr, kind),
+        "merge": _merge_fact(qr),
     }
     if hasattr(p, "fastpath_facts"):
         fp["equi_fastpath"] = p.fastpath_facts()
@@ -416,6 +435,7 @@ def _diff_query(out: List[Delta], shape: str, q: str, b: Dict, c: Dict,
             ("emission_cap", "emission"),
             ("fusion", "fusion"),
             ("equi_fastpath", "equi_fastpath"),
+            ("merge", "merge"),
             ("types", "types")):
         _cmp_exact(out, shape, q, None, metric, b.get(path),
                    c.get(path))

@@ -118,25 +118,11 @@ def window_needs_timer(win: Optional[Window]) -> bool:
 
 
 def fuse_requested(app: SiddhiApp, q: Query) -> int:
-    """Static mirror of runtime._fuse_enabled: @fuse on the query, any
-    input stream definition, or @app:fuse.  Returns K (0 = off)."""
-    ann = q.get_annotation("fuse")
-    if ann is None:
-        ist = q.input_stream
-        sids = getattr(ist, "all_stream_ids", None) or \
-            [getattr(ist, "stream_id", None)]
-        for sid in sids:
-            sdef = app.stream_definition_map.get(sid)
-            if sdef is not None and \
-                    sdef.get_annotation("fuse") is not None:
-                ann = sdef.get_annotation("fuse")
-                break
-    if ann is None:
-        ann = app.get_annotation("app:fuse")
-    if ann is None:
-        return 0
-    k = ann.element("batches", ann.element(None, 8)) or 8
-    return max(1, int(k))
+    """@fuse on the query, any input stream definition, or @app:fuse.
+    Returns K (0 = off).  Delegates to core.plan_facts.fuse_depth — the
+    one implementation runtime wiring and the merge planner also use."""
+    from ..core.plan_facts import fuse_depth
+    return fuse_depth(app, q)
 
 
 def emit_annotation_rows(q: Query) -> Optional[int]:
@@ -191,6 +177,15 @@ def _static_exclusion(app: SiddhiApp, q: Query, kind: str,
 
 
 def facts_from_app(app: SiddhiApp) -> List[QueryFacts]:
+    # merge-aware static estimate (core/plan_facts): a window buffer the
+    # multi-query optimizer will share across a group appears ONCE under
+    # its `merged:<group>` owner, so per-query facts carry exclusive
+    # bytes only and totals (ADM001) agree with the deploy gate
+    from ..core.plan_facts import static_state_components
+    try:
+        merged_comps = static_state_components(app)
+    except Exception:  # noqa: BLE001 — estimator must not kill lint
+        merged_comps = None
     out: List[QueryFacts] = []
     for name, q, part in iter_named_queries(app):
         kind = query_kind(q)
@@ -228,8 +223,14 @@ def facts_from_app(app: SiddhiApp) -> List[QueryFacts]:
 
         k = fuse_requested(app, q)
         # the ONE static estimator shared with the admission deploy gate
-        # (core/plan_facts.query_state_components)
-        comps = query_state_components(app, q, kind, part, caps, keys)
+        # (core/plan_facts.query_state_components; merge-aware when the
+        # app-level pass computed — the merged view drops a shared
+        # window from members and reports it under the group owner)
+        if merged_comps is not None:
+            comps = merged_comps.get(name, {})
+        else:
+            comps = query_state_components(app, q, kind, part, caps,
+                                           keys)
         f = QueryFacts(
             name=name, query=q, kind=kind, origin="static",
             partition=part, needs_timer=needs_timer, keyed_window=keyed,

@@ -124,6 +124,31 @@ def _state_over_budget(ctx: AnalysisContext) -> Iterator[Finding]:
                      f"{_mb(f.state_bytes)} exceeds the "
                      f"{_mb(budget)} budget{detail}", query=f.name,
                      node=f.query)
+    # merge-group shared buffers live under `merged:<group>` owners
+    # (counted once, never per member) — grade them against the same
+    # budget so sharing can't hide an oversized window from MEM001
+    try:
+        if ctx.runtime is not None:
+            from ..observability.memory import component_bytes
+            owners = component_bytes(ctx.runtime)
+            origin = "measured"
+        else:
+            from ..core.plan_facts import static_state_components
+            owners = static_state_components(ctx.app)
+            origin = "estimated"
+    except Exception:  # noqa: BLE001 — accounting must not kill lint
+        owners = {}
+        origin = "estimated"
+    for owner in sorted(owners):
+        if not owner.startswith("merged:"):
+            continue
+        comps = owners[owner]
+        total = sum(comps.values())
+        if total > budget:
+            yield _f(f"{origin} shared device state {_mb(total)} of "
+                     f"merge group {owner[len('merged:'):]!r} exceeds "
+                     f"the {_mb(budget)} budget "
+                     f"({format_component_bytes(comps)})")
 
 
 # ---------------------------------------------------------------------------
@@ -723,8 +748,75 @@ def _admission_hazards(ctx: AnalysisContext) -> Iterator[Finding]:
                      query=None, node=ann)
 
 
+@rule("MQO001", "INFO",
+      "multi-query merge: groups formed (and why queries stay out)",
+      "N co-resident queries on one stream normally cost N device "
+      "dispatches, N emission fetches, and N recompile owners per "
+      "batch.  The whole-app optimizer (siddhi_tpu/optimizer) merges "
+      "eligible queries into ONE jitted dispatch per group — and "
+      "queries with identical pre-window chains + window specs + "
+      "group-by layouts additionally share one window buffer.  This "
+      "rule reports each group the planner will form and, for every "
+      "query left out, the planner's exact ineligibility reason "
+      "(core/plan_facts.merge_plan — the same single source the "
+      "runtime pass and EXPLAIN's `merge` node read).",
+      "align @async/@pipeline/@fuse decorations, window specs, and "
+      "pre-window filters across co-resident queries to widen merge "
+      "groups; set optimizer.merge.enabled=false to opt out")
+def _merge_groups(ctx: AnalysisContext) -> Iterator[Finding]:
+    from ..core.plan_facts import merge_plan
+    # a single-query app has nothing to merge: stay silent instead of
+    # explaining why one query is alone
+    if len(ctx.queries) < 2:
+        return
+    rt = ctx.runtime
+    if rt is not None and hasattr(rt, "merged_groups"):
+        # live runtime: report what the pass ACTUALLY did (config may
+        # have disabled it; dynamic demotions may have shrunk groups)
+        by_name = {f.name: f for f in ctx.queries}
+        for gid in sorted(rt.merged_groups):
+            mg = rt.merged_groups[gid]
+            shared = sum(1 for mode, _ in mg.units if mode == "shared")
+            first = by_name.get(mg.members[0].name)
+            yield _f(f"merge group {gid!r} compiles "
+                     f"{len(mg.members)} queries into one dispatch "
+                     f"({shared} shared window unit(s)): "
+                     + ", ".join(m.name for m in mg.members),
+                     query=first.name if first is not None else None,
+                     node=first.query if first is not None else None,
+                     hint="no action needed")
+        for name in sorted(getattr(rt, "_merge_reasons", {})):
+            f = by_name.get(name)
+            yield _f(f"not merged: {rt._merge_reasons[name]}",
+                     query=name,
+                     node=f.query if f is not None else None)
+        return
+    try:
+        plan = merge_plan(ctx.app,
+                          mesh_devices=int(getattr(ctx.config,
+                                                   "mesh_devices", 0)
+                                           or 0))
+    except Exception:  # noqa: BLE001 — analysis must not kill lint
+        return
+    by_name = {f.name: f for f in ctx.queries}
+    for g in plan["groups"]:
+        shared = sum(1 for u in g["units"] if u["mode"] == "shared")
+        first = by_name.get(g["members"][0])
+        yield _f(f"merge group {g['group']!r} compiles "
+                 f"{len(g['members'])} queries into one dispatch "
+                 f"({shared} shared window unit(s)): "
+                 + ", ".join(g["members"]),
+                 query=first.name if first is not None else None,
+                 node=first.query if first is not None else None,
+                 hint="no action needed")
+    for name in sorted(plan["reasons"]):
+        f = by_name.get(name)
+        yield _f(f"not merged: {plan['reasons'][name]}", query=name,
+                 node=f.query if f is not None else None)
+
+
 ALL_RULE_IDS: List[str] = [
     "STATE001", "STATE002", "MEM001", "FUSE001", "JOIN001", "JOIN002",
     "DEAD001", "DEAD002", "NULL001", "PART001", "PART002", "TYPE001",
-    "RATE001", "APP001", "SINK001", "ADM001",
+    "RATE001", "APP001", "SINK001", "ADM001", "MQO001",
 ]

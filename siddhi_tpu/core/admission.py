@@ -281,10 +281,15 @@ def _count_denied() -> None:
         _denied_deploys += 1
 
 
-def _flat_components(app) -> Dict[str, int]:
-    """{'query/component': bytes} — the deploy gate's breakdown keys."""
+def _flat_components(app, mesh_devices: int = 0,
+                     merged: bool = True) -> Dict[str, int]:
+    """{'query/component': bytes} — the deploy gate's breakdown keys.
+    Merge-aware (core/plan_facts): a window buffer the multi-query
+    optimizer will share across a group is charged ONCE, under its
+    `merged:<group>` owner, exactly as the live accounting reports it."""
     out: Dict[str, int] = {}
-    for qname, comps in static_state_components(app).items():
+    for qname, comps in static_state_components(
+            app, mesh_devices=mesh_devices, merged=merged).items():
         for comp, nb in comps.items():
             out[f"{qname}/{comp}"] = nb
     return out
@@ -335,21 +340,27 @@ def resident_state_bytes(manager, exclude=None) -> int:
     return total
 
 
-def check_deploy(app, manager) -> None:
+def check_deploy(app, manager, mesh=None) -> None:
     """Deploy-time memory gate: runs BEFORE SiddhiAppRuntime is
     constructed, so a denial provably precedes any planning, tracing,
     or device allocation.  Raises AdmissionDeniedError listing the
     offending components (the MEM001 breakdown) when the app's static
     state estimate exceeds `admission.max.state.bytes`, or would push
     the box past `admission.global.max.state.bytes` on top of the
-    measured resident state of the already-deployed apps."""
+    measured resident state of the already-deployed apps.  `mesh` is
+    the deploy target (merge-aware sharing is off on a multi-device
+    mesh, matching the optimizer pass)."""
     per_app = _opt_float(_resolve(app, manager, "max.state.bytes",
                                   "admission.max.state.bytes"))
     global_ceiling = _opt_float(
         _prop(manager, "admission.global.max.state.bytes"))
     if per_app is None and global_ceiling is None:
         return
-    comps = _flat_components(app)
+    mesh_n = int(mesh.devices.size) if mesh is not None else 0
+    merge_prop = _prop(manager, "optimizer.merge.enabled")
+    merged = merge_prop is None or \
+        str(merge_prop).strip().lower() not in ("false", "0", "off", "no")
+    comps = _flat_components(app, mesh_devices=mesh_n, merged=merged)
     estimate = sum(comps.values())
     name = app.name or "SiddhiApp"
     if per_app is not None and estimate > per_app:

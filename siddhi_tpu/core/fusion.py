@@ -51,6 +51,10 @@ jnp = jax.numpy
 def ineligible_reason(qr, kind: str):
     """Why this runtime cannot fuse (None = eligible).  Static properties
     only; per-batch variation is handled by the stack signature."""
+    if kind == "merged":
+        # a merge group only admits timer-free, unsharded plain members
+        # (optimizer/mqo.py), so the merged body always fuses
+        return None
     p = qr.planned
     if kind == "plain":
         if p.needs_timer:
@@ -265,8 +269,17 @@ def _adapt_join(body):
     return fused_body
 
 
+def _adapt_merged(body):
+    def fused_body(carry, x, const):
+        ts, kind, valid, cols, gslots, now, pslots = x
+        carry, out, _wake = body(carry, ts, kind, valid, cols, gslots,
+                                 now, const, pslots)
+        return carry, out
+    return fused_body
+
+
 _ADAPTERS = {"plain": _adapt_plain, "pattern": _adapt_pattern,
-             "join": _adapt_join}
+             "join": _adapt_join, "merged": _adapt_merged}
 
 
 # ---------------------------------------------------------------------------
@@ -402,8 +415,62 @@ def _dispatch_join(qr, items) -> None:
     _deliver_fused(qr, outs, [now for _, _, now in items])
 
 
+def _dispatch_merged(qr, items) -> None:
+    """Fused dispatch of a MERGE GROUP's stack (optimizer/mqo.py): K
+    staged batches × N member queries in ONE lax.scan device dispatch,
+    then one combined fetch feeds the per-batch, per-query demux."""
+    from . import runtime as _rt
+    stats = qr.app.stats
+    t0 = time.perf_counter_ns() if stats.enabled else 0
+    preps = [qr._prep(staged, now) for staged, now in items]
+    stack = ev.StackedBatch([staged for staged, _ in items])
+    batch = stack.to_device(qr.in_schema)
+    n_units = len(qr.units)
+    gslots_k = tuple(
+        jnp.asarray(np.stack([np.asarray(p[0][u]) for p in preps]))
+        for u in range(n_units))
+    pslots_k = tuple(
+        tuple(jnp.asarray(np.stack([np.asarray(p[1][i][j])
+                                    for p in preps]))
+              for j in range(len(qr.members[i].planned.pair_allocs)))
+        for i in range(len(qr.members)))
+    xs = (batch.ts, batch.kind, batch.valid, batch.cols, gslots_k,
+          _now_stack(items), pslots_k)
+    fn = _fused_fn(qr, "merged", qr.raw_body)
+    qr._state, outs = fn(qr._state, xs, qr._in_tabs())
+    if stats.enabled:
+        stats.counter_inc(f"merged.{qr.group}.dispatches")
+        stats.counter_inc(f"merged.{qr.group}.member_batches",
+                          len(qr.members) * len(items))
+    ingests = qr.__dict__.pop("_fused_ingests", None)
+    K = len(items)
+    if ingests is None or len(ingests) != K:
+        ingests = [None] * K
+    consumers = [i for i, m in enumerate(qr.members)
+                 if _rt._has_consumers(m)]
+    deferred = (getattr(qr.members[0], "async_emit", False) and
+                qr.app._drainer is not None) or \
+        bool(getattr(qr.members[0], "pipeline_emit", 0) or 0)
+    if consumers and not deferred:
+        # ONE fetch for every consumed member's whole [K, ...] block;
+        # per-batch views below are then numpy slices
+        host = jax.device_get([outs[i] for i in consumers])
+        outs = list(outs)
+        for i, h in zip(consumers, host):
+            outs[i] = h
+        outs = tuple(outs)
+    batches = []
+    for k, (staged, now) in enumerate(items):
+        out_k = tuple(
+            (o[0][k], o[1][k], o[2][k], tuple(c[k] for c in o[3]))
+            if i in consumers else None
+            for i, o in enumerate(outs))
+        batches.append((out_k, staged, now, ingests[k]))
+    qr._demux(batches, t0)
+
+
 _DISPATCH = {"plain": _dispatch_plain, "pattern": _dispatch_pattern,
-             "join": _dispatch_join}
+             "join": _dispatch_join, "merged": _dispatch_merged}
 
 
 # ---------------------------------------------------------------------------

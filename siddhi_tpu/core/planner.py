@@ -76,6 +76,13 @@ class PlannedQuery:
     # un-jitted step body for @fuse(batches=K) scan fusion (core/fusion.py);
     # None on the keyed-window and sharded paths, which don't fuse
     raw_step: Optional[Callable] = None
+    # the two halves of raw_step, exposed for the whole-app multi-query
+    # optimizer (siddhi_tpu/optimizer): stage_body runs the pre-window
+    # chain + window (shared once per merge group), select_body runs the
+    # post-chain + selector over the window's output rows (stacked per
+    # member).  raw_step == stage_body ∘ select_body by construction.
+    stage_body: Optional[Callable] = None
+    select_body: Optional[Callable] = None
 
     def describe(self) -> Dict:
         """Compiled-plan facts for EXPLAIN (observability/explain.py):
@@ -458,15 +465,23 @@ def plan_single_query(
     # ---- the fused step -----------------------------------------------------
     wproc = window_proc
 
-    def step(state, ts, kind, valid, cols, gslot, now, in_tabs=(),
-             pslots=()):
-        wstate, astate = state
-        env = {sid: cols, "__ts__": ts, "__now__": now, "__kind__": kind}
+    def _probe_env(in_tabs):
+        """`x in Table` probe closures for this query's table deps —
+        pure functions of the snapshot columns, rebuilt identically in
+        both step halves."""
+        env = {}
         for dep, (tcol0, tvalid) in zip(in_deps, in_tabs):
             def probe(vals, _tc=tcol0, _tv=tvalid):
                 return jnp.any(jnp.logical_and(
                     vals[:, None] == _tc[None, :], _tv[None, :]), axis=1)
             env["__in__:" + dep] = probe
+        return env
+
+    def stage_body(wstate, ts, kind, valid, cols, gslot, now, in_tabs):
+        """Pre-window chain + window advance: the half of the step a
+        merge group shares (one buffer, staged once per dispatch)."""
+        env = {sid: cols, "__ts__": ts, "__now__": now, "__kind__": kind}
+        env.update(_probe_env(in_tabs))
         keep = valid
         is_current = kind == ev.CURRENT
         if named_window_input:
@@ -478,12 +493,14 @@ def plan_single_query(
         rows = Rows(ts=ts, kind=kind, valid=keep,
                     seq=jnp.zeros_like(ts), gslot=gslot, cols=cols)
         wstate, wout = wproc.process(wstate, rows, now)
-        orows = wout.rows
+        return wstate, wout.rows, wout.next_wakeup
+
+    def select_body(astate, orows, now, in_tabs, pslots):
+        """Post-window chain + selector over the window's output rows:
+        the per-query half, stacked per member in a merged dispatch."""
         env2 = {sid: orows.cols, "__ts__": orows.ts, "__now__": now,
                 "__kind__": orows.kind}
-        for k, v in env.items():
-            if k.startswith("__in__:"):
-                env2[k] = v
+        env2.update(_probe_env(in_tabs))
         # distinctCount pair slots (unwindowed: orows is the input order)
         for j in range(len(pair_allocs)):
             env2[f"__pslot__{j}"] = pslots[j]
@@ -493,9 +510,16 @@ def plan_single_query(
             env2, ocols, keep2 = _apply_chain(
                 post_chain, env2, sid, orows.cols, orows.valid, data_row)
             orows = orows._replace(valid=keep2, cols=ocols)
-        astate, (ots, okind, ovalid, ocols) = sel.process(astate, orows, env2)
-        return ((wstate, astate), (ots, okind, ovalid, ocols),
-                wout.next_wakeup)
+        return sel.process(astate, orows, env2)
+
+    def step(state, ts, kind, valid, cols, gslot, now, in_tabs=(),
+             pslots=()):
+        wstate, astate = state
+        wstate, orows, wake = stage_body(wstate, ts, kind, valid, cols,
+                                         gslot, now, in_tabs)
+        astate, (ots, okind, ovalid, ocols) = select_body(
+            astate, orows, now, in_tabs, pslots)
+        return ((wstate, astate), (ots, okind, ovalid, ocols), wake)
 
     plain_mesh = None
     keyed_mesh = None
@@ -631,4 +655,6 @@ def plan_single_query(
         keyed_mesh=keyed_mesh,
         emits_uuid=scope.uses_uuid,
         raw_step=raw_step,
+        stage_body=stage_body if raw_step is not None else None,
+        select_body=select_body if raw_step is not None else None,
     )

@@ -264,9 +264,13 @@ class QueryRuntime(_MeshResolved):
     def __init__(self, planned: PlannedQuery, app: "SiddhiAppRuntime"):
         self.planned = planned
         self.app = app
+        # set by optimizer.apply_merge when this query joins a merge
+        # group: state then lives in the group's stacked pytree and the
+        # `state` property serves this member's view of it
+        self._merged = None
         # force-copy every leaf: constant-folding can alias identical init
         # arrays into one buffer, which breaks donated-argument execution
-        self.state = jax.tree.map(
+        self._state = jax.tree.map(
             lambda x: jax.numpy.array(x, copy=True), planned.init_state())
         self.callbacks: List[Callable] = []
         self.batch_callbacks: List[Callable] = []
@@ -284,6 +288,24 @@ class QueryRuntime(_MeshResolved):
     @property
     def name(self):
         return self.planned.name
+
+    @property
+    def state(self):
+        """This query's state pytree.  Unmerged: the runtime's own
+        tuple.  Merged (optimizer/mqo.py): a view into the merge
+        group's stacked state — snapshots, restores, EXPLAIN, and
+        memory accounting keep addressing the member by name and see
+        exactly the (window, selector) tuple an unmerged plan holds."""
+        mg = self._merged
+        return self._state if mg is None else mg.member_state(self)
+
+    @state.setter
+    def state(self, v):
+        mg = self._merged
+        if mg is None:
+            self._state = v
+        else:
+            mg.set_member_state(self, v)
 
     def _slots_for_batch(self, staged: ev.StagedBatch,
                          now: int) -> Tuple[np.ndarray, Tuple]:
@@ -2526,6 +2548,16 @@ class SiddhiAppRuntime:
             elif isinstance(element, Partition):
                 qi = self._add_partition(element, qi)
 
+        # whole-app multi-query optimizer (siddhi_tpu/optimizer): merge
+        # co-resident queries on one junction into shared dispatches.
+        # Runs AFTER per-query planning (it stacks the planned step
+        # bodies) and BEFORE admission registration (merged owners get
+        # compile-gate labels too).
+        self.merged_groups: Dict[str, object] = {}
+        self._merge_reasons: Dict[str, str] = {}
+        from ..optimizer import apply_merge
+        apply_merge(self)
+
         # admission control: per-app quotas + overload ladder
         # (core/admission.py).  Registered with the shared CompileGate
         # HERE (not start()) — the first trace can happen before start()
@@ -2815,18 +2847,8 @@ class SiddhiAppRuntime:
         """@async at app level, on the query, or on any input stream
         definition (reference: @async is a stream-level annotation,
         StreamJunction.startProcessing :276-313)."""
-        if self.app.get_annotation("async") is not None:
-            return True
-        if q.get_annotation("async") is not None:
-            return True
-        ist = q.input_stream
-        sids = getattr(ist, "all_stream_ids", None) or \
-            [getattr(ist, "stream_id", None)]
-        for sid in sids:
-            sdef = self.app.stream_definition_map.get(sid)
-            if sdef is not None and sdef.get_annotation("async") is not None:
-                return True
-        return False
+        from .plan_facts import async_enabled
+        return async_enabled(self.app, q)
 
     def _pipeline_enabled(self, q) -> int:
         """@pipeline(depth='k') on the app or the query: deferred emission
@@ -2841,13 +2863,10 @@ class SiddhiAppRuntime:
         Timer-bearing (time/cron-window, absent-pattern) queries are
         excluded in _emit_output.  Returns the depth (0 = off)."""
         # the query's own annotation wins (it may carry a depth the
-        # app-level blanket annotation lacks)
-        ann = q.get_annotation("pipeline")
-        if ann is None:
-            ann = self.app.get_annotation("app:pipeline")
-        if ann is None:
-            return 0
-        return max(1, int(ann.element("depth", 1) or 1))
+        # app-level blanket annotation lacks); plan_facts.pipeline_depth
+        # is the one implementation, shared with the merge planner
+        from .plan_facts import pipeline_depth
+        return pipeline_depth(self.app, q)
 
     def _fuse_enabled(self, q) -> int:
         """@fuse(batches='K') on the query, any input stream definition,
@@ -2856,23 +2875,8 @@ class SiddhiAppRuntime:
         overhead divide by K (core/fusion.py).  Composes with @pipeline/
         @async (per-batch emissions re-enter their paths) and @emit.
         Returns the stack depth K (0 = off)."""
-        ann = q.get_annotation("fuse")
-        if ann is None:
-            ist = q.input_stream
-            sids = getattr(ist, "all_stream_ids", None) or \
-                [getattr(ist, "stream_id", None)]
-            for sid in sids:
-                sdef = self.app.stream_definition_map.get(sid)
-                if sdef is not None and \
-                        sdef.get_annotation("fuse") is not None:
-                    ann = sdef.get_annotation("fuse")
-                    break
-        if ann is None:
-            ann = self.app.get_annotation("app:fuse")
-        if ann is None:
-            return 0
-        k = ann.element("batches", ann.element(None, 8)) or 8
-        return max(1, int(k))
+        from .plan_facts import fuse_depth
+        return fuse_depth(self.app, q)
 
     def _maybe_fuse(self, runtime, q, kind: str) -> None:
         # every query runtime passes through here with its AST and path
@@ -3205,10 +3209,10 @@ class SiddhiAppRuntime:
                     self._idle_thread.join(timeout=2.0)
             for j in self.junctions.values():
                 j.stop_async()       # drain accepted sends, stop workers
-            for qr in self.query_runtimes.values():
-                # buffered @fuse stacks and held @pipeline emissions
-                # deliver before teardown: an accepted send's output must
-                # not vanish (at-least-once)
+            for qr in self._step_runtimes():
+                # buffered @fuse stacks (per-query AND merged-group) and
+                # held @pipeline emissions deliver before teardown: an
+                # accepted send's output must not vanish (at-least-once)
                 _fusion.drain(qr)
                 _drain_pending_emit(qr)
             for sk in self.sinks:
@@ -3238,19 +3242,26 @@ class SiddhiAppRuntime:
         for _ in range(64):
             for j in self.junctions.values():
                 j.flush_async()
-            for qr in self.query_runtimes.values():
+            for qr in self._step_runtimes():
                 _fusion.drain(qr)   # partial @fuse stacks process NOW
                 _drain_pending_emit(qr)
             self._drainer.flush()
             if all(j.pending_async() == 0 for j in self.junctions.values()) \
                     and not any(getattr(qr, "_pending_emit", None) or
                                 _fusion.pending(qr)
-                                for qr in self.query_runtimes.values()):
+                                for qr in self._step_runtimes()):
                 return
         import logging
         logging.getLogger("siddhi_tpu").warning(
             "flush() gave up after 64 rounds with async batches still "
             "pending (sustained re-ingestion?)")
+
+    def _step_runtimes(self):
+        """Every runtime that can hold a @fuse stack or deferred
+        emissions: the per-query runtimes plus merged-group dispatchers
+        (optimizer/mqo.py) — flush/quiesce/shutdown drain them all."""
+        return list(self.query_runtimes.values()) + \
+            list(getattr(self, "merged_groups", {}).values())
 
     def in_probe_tables(self, deps):
         """Snapshots for `x in Table` probes: (first column, validity) per
@@ -3305,7 +3316,7 @@ class SiddhiAppRuntime:
             for _ in range(64):
                 for j in self.junctions.values():
                     j.flush_async()
-                for qr in self.query_runtimes.values():
+                for qr in self._step_runtimes():
                     # @fuse stacks hold UNPROCESSED events — they must
                     # land in the snapshotted state, not vanish
                     _fusion.drain(qr)
@@ -3314,7 +3325,7 @@ class SiddhiAppRuntime:
                        for j in self.junctions.values()) and \
                         not any(getattr(qr, "_pending_emit", None) or
                                 _fusion.pending(qr)
-                                for qr in self.query_runtimes.values()):
+                                for qr in self._step_runtimes()):
                     break
             locks = [self._lock]
             for qname in sorted(self.query_runtimes):
@@ -4038,7 +4049,7 @@ class SiddhiManager:
         # runtime is constructed — a denial provably precedes any
         # planning, tracing, or device allocation (core/admission.py)
         from .admission import check_deploy
-        check_deploy(app, self)
+        check_deploy(app, self, mesh=mesh)
         runtime = SiddhiAppRuntime(app, self, mesh=mesh)
         self.runtimes[runtime.name] = runtime
         return runtime

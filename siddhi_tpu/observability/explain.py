@@ -283,6 +283,16 @@ def _steps_of(qr, kind: str) -> List[Tuple[str, Any]]:
         steps.append(("step", p.step))
     for (fkind, _), (body, fn) in getattr(qr, "_fused_cache", {}).items():
         steps.append((f"fused_step[{fkind}]", fn))
+    mg = getattr(qr, "_merged", None)
+    if mg is not None:
+        # the program a merged member ACTUALLY dispatches through
+        # (optimizer/mqo.py); costs appear once it has traced — the
+        # audit gate pins merging via the `merge` fact instead, so this
+        # traced-only entry can never make fingerprints nondeterministic
+        steps.append(("merged_step", mg._step))
+        for (fkind, _), (body, fn) in \
+                getattr(mg, "_fused_cache", {}).items():
+            steps.append((f"merged_fused_step[{fkind}]", fn))
     return steps
 
 
@@ -305,6 +315,18 @@ def _runtime_kind(qr) -> str:
 def _fusion_node(qr, kind: str) -> Dict:
     from ..core import fusion as _fusion
     return _fusion.eligibility(qr, kind)
+
+
+def _merge_node(qr) -> Dict:
+    """Multi-query-optimizer fact for this query (core/plan_facts.
+    merge_facts): group/owner/mode/members when merged, the planner's
+    exact ineligibility reason otherwise — the same single source lint
+    MQO001 prints."""
+    from ..core.plan_facts import merge_facts
+    try:
+        return merge_facts(qr)
+    except Exception:  # noqa: BLE001 — diagnostics must not throw
+        return {"merged": False}
 
 
 def _sharding_entry(qr, kind: str, deep: bool) -> Dict:
@@ -426,6 +448,7 @@ def explain_query(rt, query_name: str, deep: bool = True) -> Dict:
         },
         "emission": _emission_node(qr, kind),
         "fusion": _fusion_node(qr, kind),
+        "merge": _merge_node(qr),
         **_sharding_entry(qr, kind, deep),
         "recompiles": RECOMPILES.snapshot(
             [query_name, f"fused:{query_name}"]),

@@ -183,6 +183,17 @@ def render_prometheus(runtimes: Dict) -> str:
     a_shed = fam("siddhi_async_shed_total", "counter",
                  "Events shed by a full bounded @async ingress queue "
                  "under queue.policy='shed', per stream")
+    mrg_d = fam("siddhi_merged_dispatches_total", "counter",
+                "Merged-group device dispatches (one jitted step runs "
+                "every member query's stacked body — "
+                "siddhi_tpu/optimizer)")
+    mrg_b = fam("siddhi_merged_member_batches_total", "counter",
+                "Per-query batches served through merged dispatches "
+                "(members x dispatches) — divide by "
+                "siddhi_merged_dispatches_total for the amortization "
+                "factor")
+    mrg_q = fam("siddhi_merged_queries", "gauge",
+                "Member queries compiled into each merge group")
 
     for app_name, rt in sorted(runtimes.items()):
         st = rt.stats
@@ -223,6 +234,19 @@ def render_prometheus(runtimes: Dict) -> str:
             elif name.startswith("async.") and name.endswith(".shed"):
                 a_shed.sample(n, app=app_name,
                               stream=name[len("async."):-len(".shed")])
+            elif name.startswith("merged.") and \
+                    name.endswith(".dispatches"):
+                mrg_d.sample(n, app=app_name,
+                             group=name[len("merged."):
+                                        -len(".dispatches")])
+            elif name.startswith("merged.") and \
+                    name.endswith(".member_batches"):
+                mrg_b.sample(n, app=app_name,
+                             group=name[len("merged."):
+                                        -len(".member_batches")])
+        for gid, mg in sorted(getattr(rt, "merged_groups", {}).items()):
+            mrg_q.sample(len(getattr(mg, "members", ())), app=app_name,
+                         group=gid)
         buf_e.sample(rt.buffered_emissions(), app=app_name)
         for sid, n in sorted(rt.buffered_ingress().items()):
             buf_i.sample(n, app=app_name, stream=sid)

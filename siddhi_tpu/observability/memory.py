@@ -56,6 +56,13 @@ def _kind_components(qr) -> Dict[str, int]:
     ((b32, b64, scalars), selector) for patterns, and the join's
     (left window, right window, selector...) tuple; anything that doesn't
     match falls back to positional names so the total always adds up."""
+    mg = getattr(qr, "_merged", None)
+    if mg is not None:
+        # merged member (optimizer/mqo.py): report only this query's
+        # EXCLUSIVE bytes — the shared window buffer is accounted ONCE,
+        # under the group owner (component_bytes adds `merged:<group>`),
+        # never per member (the MEM001 double-count fix)
+        return mg.member_components(qr)
     state = qr.state
     p = qr.planned
     names = None
@@ -105,6 +112,13 @@ def component_bytes(rt) -> Dict[str, Dict[str, int]]:
         comps = query_component_bytes(qr)
         if comps:
             out[name] = comps
+    for gid, mg in list(getattr(rt, "merged_groups", {}).items()):
+        try:
+            comps = mg.shared_components()
+        except Exception:  # noqa: BLE001 — metrics must not throw
+            comps = {}
+        if comps:
+            out[f"merged:{gid}"] = comps
     for tid, t in list(getattr(rt, "tables", {}).items()):
         n = sum(leaf_nbytes(c) for c in getattr(t, "cols", ())) + \
             leaf_nbytes(getattr(t, "ts", None)) + \

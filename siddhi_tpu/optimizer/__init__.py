@@ -1,0 +1,14 @@
+"""Whole-app multi-query optimizer (ROADMAP item 3).
+
+Merges co-resident queries that hang off one stream junction into
+shared device dispatches: one jitted step runs every member's selector
+stack, one combined emission fetch serves the whole group, and members
+with identical pre-window chains + window specs + group-by layouts
+reference ONE window buffer and ONE group-slot space instead of per
+query duplicates.  `core/plan_facts.merge_plan` is the single source of
+truth for grouping (shared with lint MQO001 and EXPLAIN); this package
+applies it to a live runtime.
+"""
+from .mqo import MergedGroupRuntime, apply_merge, merge_enabled
+
+__all__ = ["MergedGroupRuntime", "apply_merge", "merge_enabled"]

@@ -1,0 +1,564 @@
+"""Multi-query optimizer (siddhi_tpu/optimizer): merge groups, parity,
+shared state, snapshots, accounting, lint/audit/EXPLAIN facts.
+
+The contract under test: merging co-resident queries into one dispatch
+is INVISIBLE per query — byte-identical outputs, unchanged snapshot
+format, per-query metrics/blame — while state accounting reports shared
+buffers once and the plan surfaces (EXPLAIN, MQO001, audit) pin the
+grouping.
+"""
+import numpy as np
+import pytest
+
+from siddhi_tpu import SiddhiManager
+from siddhi_tpu.utils.config import InMemoryConfigManager
+
+
+def _build(ql, merge=True, mesh=None, props=None):
+    manager = SiddhiManager()
+    cfg = dict(props or {})
+    if not merge:
+        cfg["optimizer.merge.enabled"] = "false"
+    if cfg:
+        manager.set_config_manager(InMemoryConfigManager(cfg))
+    rt = manager.create_siddhi_app_runtime(ql, mesh=mesh) if mesh \
+        else manager.create_siddhi_app_runtime(ql)
+    return manager, rt
+
+
+def _capture(rt, queries):
+    # the callback `ts` argument is the wall-clock delivery time —
+    # excluded from the parity payload (event rows carry their own
+    # timestamps through the data)
+    outs = {q: [] for q in queries}
+    for q in queries:
+        rt.add_callback(q, lambda ts, cur, exp, _q=q: outs[_q].append(
+            ([e.data for e in (cur or [])],
+             [e.data for e in (exp or [])])))
+    return outs
+
+
+def _drive(rt, n_batches=10, b=48, t0=1000, seed=3, keys=6):
+    rng = np.random.default_rng(seed)
+    h = rt.get_input_handler("S")
+    for i in range(n_batches):
+        batch = [[int(rng.integers(0, keys)),
+                  float(rng.integers(-20, 80)) / 10.0,
+                  int(rng.integers(0, 4))] for _ in range(b)]
+        h.send(batch, timestamp=t0 + i * 100)
+    rt.flush()
+
+
+def _parity(ql, queries, drive=_drive, props=None):
+    """Outputs with the optimizer ON vs OFF must be byte-identical."""
+    ma, ra = _build(ql, merge=True, props=props)
+    mb, rb = _build(ql, merge=False, props=props)
+    try:
+        oa, ob = _capture(ra, queries), _capture(rb, queries)
+        ra.start()
+        rb.start()
+        drive(ra)
+        drive(rb)
+        assert oa == ob
+        assert any(oa.values()), "parity over zero emissions proves nothing"
+        return ra, rb, oa
+    finally:
+        ma.shutdown()
+        mb.shutdown()
+
+
+BASE_QL = """
+define stream S (key long, v double, c int);
+@info(name='f1') from S[v > 3.0] select key, v insert into F1;
+@info(name='f2') from S[c == 2 and v < 6.0] select key, c insert into F2;
+@info(name='g1') from S select key, count() as n group by key
+insert into G1;
+@info(name='w1') from S[v > 0.0]#window.length(16)
+select key, sum(v) as s group by key insert into W1;
+@info(name='w2') from S[v > 0.0]#window.length(16)
+select key, max(v) as m group by key having m > 2.0 insert into W2;
+@info(name='lb') from S#window.lengthBatch(8)
+select count() as n, avg(v) as a insert into LB;
+"""
+BASE_QUERIES = ["f1", "f2", "g1", "w1", "w2", "lb"]
+
+
+# ---------------------------------------------------------------------------
+# grouping
+# ---------------------------------------------------------------------------
+
+def test_merge_groups_and_modes():
+    m, rt = _build(BASE_QL)
+    try:
+        assert list(rt.merged_groups) == ["S#0"]
+        mg = rt.merged_groups["S#0"]
+        assert [q.name for q in mg.members] == BASE_QUERIES
+        modes = {q.name: mg.mode_of(q) for q in mg.members}
+        # w1+w2 share (same pre-filter + window + group-by); the
+        # lengthBatch window differs -> solo; windowless ones solo
+        assert modes == {"f1": "stacked", "f2": "stacked",
+                         "g1": "stacked", "w1": "shared",
+                         "w2": "shared", "lb": "stacked"}
+        # shared unit members resolve group slots through ONE allocator
+        w1 = rt.query_runtimes["w1"].planned
+        w2 = rt.query_runtimes["w2"].planned
+        assert w1.slot_allocator is w2.slot_allocator
+        # junction has ONE subscriber where six queries used to sit
+        assert rt.junctions["S"].queries == [mg]
+    finally:
+        m.shutdown()
+
+
+def test_config_disable_records_reason():
+    m, rt = _build(BASE_QL, merge=False)
+    try:
+        assert not rt.merged_groups
+        assert all("disabled" in why
+                   for why in rt._merge_reasons.values())
+        assert len(rt.junctions["S"].queries) == len(BASE_QUERIES)
+    finally:
+        m.shutdown()
+
+
+def test_residual_reasons_and_decoration_split():
+    ql = """
+define stream S (key long, v double, c int);
+@info(name='plain1') from S[v > 1.0] select key insert into O1;
+@info(name='plain2') from S[v > 2.0] select key insert into O2;
+@fuse(batches='4')
+@info(name='fq') from S[v > 3.0] select key insert into O3;
+@info(name='tw') from S#window.time(1 sec) select count() as n
+insert into O4;
+@info(name='sess') from S#window.session(1 sec, key)
+select count() as n insert into O5;
+"""
+    m, rt = _build(ql)
+    try:
+        mg = rt.merged_groups["S#0"]
+        assert [q.name for q in mg.members] == ["plain1", "plain2"]
+        r = rt._merge_reasons
+        assert "decorations" in r["fq"]          # @fuse differs
+        assert "timer-bearing" in r["tw"]
+        assert "session" in r["sess"] or "timer-bearing" in r["sess"]
+    finally:
+        m.shutdown()
+
+
+def test_mesh_disables_merging():
+    import jax
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(jax.devices()[:4]), ("shard",))
+    m, rt = _build(BASE_QL, mesh=mesh)
+    try:
+        assert not rt.merged_groups
+        assert all("mesh" in why for why in rt._merge_reasons.values())
+    finally:
+        m.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# byte-identical parity across decorations and shapes.  The broad
+# matrix (full BASE_QL × @fuse/@async/@pipeline, snapshots, accounting)
+# compiles many programs and rides the slow lane for CI's full run; the
+# tier-1 core below covers merged dispatch + shared window + solo
+# member + fused dispatch + partial-stack drain in one small app.
+# ---------------------------------------------------------------------------
+
+SMALL_QL = """
+define stream S (key long, v double, c int);
+@info(name='p1') from S[v > 2.0] select key, v insert into P1;
+@info(name='p2') from S[v > 0.0]#window.length(8)
+select key, sum(v) as s group by key insert into P2;
+@info(name='p3') from S[v > 0.0]#window.length(8)
+select key, count() as n group by key insert into P3;
+"""
+
+
+def test_parity_small_fused():
+    # 7 batches at K=3: two fused merged dispatches + a partial-stack
+    # drain at flush — the whole merged hot path in one cheap app
+    ql = "@app:fuse(batches='3')\n" + SMALL_QL
+    ra, _rb, _outs = _parity(
+        ql, ["p1", "p2", "p3"],
+        drive=lambda rt: _drive(rt, n_batches=7, b=32))
+    mg = ra.merged_groups["S#0"]
+    assert mg._fuse is not None and mg._fuse.k == 3
+    assert {mg.mode_of(q) for q in mg.members} == {"stacked", "shared"}
+
+
+@pytest.mark.slow
+def test_parity_base_shapes():
+    _parity(BASE_QL, BASE_QUERIES)
+
+
+@pytest.mark.slow
+def test_parity_fuse():
+    ql = "@app:fuse(batches='4')\n" + BASE_QL
+    _parity(ql, BASE_QUERIES)
+
+
+@pytest.mark.slow
+def test_parity_fuse_partial_stack_flush():
+    ql = "@app:fuse(batches='8')\n" + BASE_QL
+
+    def drive(rt):
+        _drive(rt, n_batches=3)      # < K: flush drains a partial stack
+    _parity(ql, BASE_QUERIES, drive=drive)
+
+
+@pytest.mark.slow
+def test_parity_async():
+    ql = BASE_QL.replace("define stream S",
+                         "@async(buffer.size='32')\ndefine stream S")
+    _parity(ql, BASE_QUERIES)
+
+
+@pytest.mark.slow
+def test_parity_pipeline():
+    ql = "@app:pipeline(depth='2')\n" + BASE_QL
+    _parity(ql, BASE_QUERIES)
+
+
+def test_parity_rate_limit():
+    ql = """
+define stream S (key long, v double, c int);
+@info(name='r1') from S[v > 0.0] select key, v
+output every 3 events insert into R1;
+@info(name='r2') from S select key, count() as n group by key
+output last every 4 events insert into R2;
+"""
+    _parity(ql, ["r1", "r2"])
+
+
+def test_parity_stream_function_chain():
+    ql = """
+define stream S (key long, v double, c int);
+@info(name='s1') from S#log('a') select key, v insert into L1;
+@info(name='s2') from S[v > 1.0] select key, v * 2.0 as d
+insert into L2;
+"""
+    _parity(ql, ["s1", "s2"])
+
+
+def test_parity_table_output_and_in_probe():
+    """A query probing a table a co-resident query WRITES is demoted
+    (unmerged it observes same-batch writes; merging would snapshot
+    the table once per dispatch) — so outputs stay byte-identical and
+    the planner's reason names the writer."""
+    ql = """
+define stream S (key long, v double, c int);
+define table T (key long, v double);
+@info(name='ins') from S[c == 1] select key, v insert into T;
+@info(name='probe') from S[key in T] select key, v insert into P;
+@info(name='other') from S[v > 5.0] select key insert into O;
+"""
+    ra, rb, _outs = _parity(ql, ["probe", "other"],
+                            drive=lambda rt: _drive(rt, n_batches=8,
+                                                    b=16))
+    mg = ra.merged_groups.get("S#0")
+    assert mg is not None and \
+        [q.name for q in mg.members] == ["ins", "other"]
+    why = ra._merge_reasons["probe"]
+    assert "read-your-writes" in why and "'ins'" in why, why
+
+
+def test_feedback_loop_demoted():
+    """A member inserting into its own input stream keeps its own
+    dispatch: the unmerged fan-out interleaves the feedback recursion
+    mid-batch, which a merged demux would reorder."""
+    ql = """
+define stream S (key long, v double, c int);
+@info(name='loop') from S[c == 9] select key, v, c insert into S;
+@info(name='q1') from S[v > 1.0] select key insert into O1;
+@info(name='q2') from S[v > 2.0] select key insert into O2;
+"""
+    m, rt = _build(ql, merge=True)
+    try:
+        mg = rt.merged_groups["S#0"]
+        assert [q.name for q in mg.members] == ["q1", "q2"]
+        assert "feedback" in rt._merge_reasons["loop"]
+    finally:
+        m.shutdown()
+
+
+def test_fault_stream_isolation():
+    """A member whose delivery raises routes through the junction's
+    fault stream WITHOUT breaking its co-members — same per-query error
+    semantics as the unmerged plan."""
+    ql = """
+@OnError(action='STREAM')
+define stream S (key long, v double, c int);
+@info(name='bad') from S[v > 0.0] select key, v insert into B;
+@info(name='good') from S[v > 2.0] select key, v insert into G;
+"""
+    for merge in (True, False):
+        m, rt = _build(ql, merge=merge)
+        try:
+            boom = []
+            faults = []
+            good = []
+            rt.add_callback("bad", lambda ts, cur, exp:
+                            (_ for _ in ()).throw(RuntimeError("boom")))
+            rt.add_callback("!S", lambda events: faults.append(
+                len(events)))
+            rt.add_callback("good", lambda ts, cur, exp: good.append(
+                len(cur or [])))
+            rt.start()
+            _drive(rt, n_batches=4, b=8)
+            assert sum(faults) > 0, f"merge={merge}: no fault routing"
+            assert sum(good) > 0, f"merge={merge}: co-member starved"
+        finally:
+            m.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# snapshots
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_snapshot_roundtrip_merged_and_cross():
+    ma, ra = _build(BASE_QL, merge=True)
+    outs = _capture(ra, BASE_QUERIES)
+    ra.start()
+    _drive(ra)
+    blob = ra.snapshot()
+    ma.shutdown()
+    results = {}
+    for tag, merge in (("merged", True), ("unmerged", False)):
+        m2, r2 = _build(BASE_QL, merge=merge)
+        o2 = _capture(r2, BASE_QUERIES)
+        r2.restore(blob)
+        r2.start()
+        _drive(r2, n_batches=4, t0=50_000, seed=9)
+        results[tag] = o2
+        m2.shutdown()
+    assert results["merged"] == results["unmerged"]
+    assert any(results["merged"].values())
+
+
+def test_incremental_snapshot_chain_merged():
+    ma, ra = _build(BASE_QL, merge=True)
+    ra.start()
+    _drive(ra, n_batches=4)
+    base = ra.snapshot()
+    _drive(ra, n_batches=4, t0=9000, seed=5)
+    inc = ra.snapshot_incremental()
+    ref = ra.snapshot()          # ground truth after both phases
+    ma.shutdown()
+    m2, r2 = _build(BASE_QL, merge=True)
+    r2.restore(base)
+    r2.restore_increment(inc)
+    m3, r3 = _build(BASE_QL, merge=True)
+    r3.restore(ref)
+    try:
+        import jax
+        for q in BASE_QUERIES:
+            a = jax.tree.map(np.asarray, r2.query_runtimes[q].state)
+            b = jax.tree.map(np.asarray, r3.query_runtimes[q].state)
+            la = jax.tree_util.tree_leaves(a)
+            lb = jax.tree_util.tree_leaves(b)
+            assert len(la) == len(lb)
+            for x, y in zip(la, lb):
+                assert np.array_equal(np.asarray(x), np.asarray(y)), q
+    finally:
+        m2.shutdown()
+        m3.shutdown()
+
+
+def test_mesh_resize_restore_into_merged():
+    """A snapshot cut on a 4-way mesh (merging disabled there) restores
+    into a single-device MERGED runtime through the existing ShardRouter
+    re-bucketing — zero state loss, byte-identical continuation."""
+    import jax
+    from jax.sharding import Mesh
+    ql = """
+define stream S (key long, v double, c int);
+@info(name='a') from S select key, count() as n group by key
+insert into A;
+@info(name='b') from S select key, sum(v) as s group by key
+insert into B;
+"""
+    mesh = Mesh(np.array(jax.devices()[:4]), ("shard",))
+    mm, rm = _build(ql, mesh=mesh)
+    assert not rm.merged_groups
+    rm.start()
+    _drive(rm, n_batches=6, keys=32)
+    blob = rm.snapshot()
+    mm.shutdown()
+    results = {}
+    for tag, merge in (("merged", True), ("unmerged", False)):
+        m2, r2 = _build(ql, merge=merge)
+        if merge:
+            assert r2.merged_groups
+        o2 = _capture(r2, ["a", "b"])
+        r2.restore(blob)
+        r2.start()
+        _drive(r2, n_batches=3, t0=70_000, seed=4, keys=32)
+        results[tag] = o2
+        m2.shutdown()
+    assert results["merged"] == results["unmerged"]
+    assert any(results["merged"].values())
+
+
+# ---------------------------------------------------------------------------
+# state accounting (MEM001 double-count fix)
+# ---------------------------------------------------------------------------
+
+def test_shared_window_counted_once():
+    ma, ra = _build(BASE_QL, merge=True)
+    mb, rb = _build(BASE_QL, merge=False)
+    try:
+        mm, mu = ra.state_memory(), rb.state_memory()
+        shared = mm["merged:S#0"]["window[shared]"]
+        assert shared == mu["w1"]["window"] > 0
+        assert "window" not in mm["w1"] and "window" not in mm["w2"]
+        tot_m = sum(n for c in mm.values() for n in c.values())
+        tot_u = sum(n for c in mu.values() for n in c.values())
+        assert tot_m == tot_u - shared
+    finally:
+        ma.shutdown()
+        mb.shutdown()
+
+
+def test_static_estimator_matches_deploy_gate():
+    from siddhi_tpu.compiler import SiddhiCompiler
+    from siddhi_tpu.core.plan_facts import static_state_components
+    app = SiddhiCompiler.parse(BASE_QL)
+    merged = static_state_components(app)
+    unmerged = static_state_components(app, merged=False)
+    assert "merged:S#0" in merged and "merged:S#0" not in unmerged
+    tm = sum(sum(c.values()) for c in merged.values())
+    tu = sum(sum(c.values()) for c in unmerged.values())
+    assert tm < tu
+    # a ceiling between the two admits the merged plan and denies the
+    # unmerged one — gate and estimator share the merge-aware numbers
+    ceiling = (tm + tu) // 2
+    props = {"admission.max.state.bytes": str(ceiling)}
+    m1, r1 = _build(BASE_QL, merge=True, props=props)
+    m1.shutdown()
+    from siddhi_tpu.core.admission import AdmissionDeniedError
+    m2 = SiddhiManager()
+    m2.set_config_manager(InMemoryConfigManager(
+        {**props, "optimizer.merge.enabled": "false"}))
+    with pytest.raises(AdmissionDeniedError):
+        m2.create_siddhi_app_runtime(BASE_QL)
+    m2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# accounting / observability / plan surfaces
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_per_query_accounting_survives_merge():
+    ql = "@app:statistics('BASIC')\n" + BASE_QL
+    ma, ra = _build(ql, merge=True)
+    mb, rb = _build(ql, merge=False)
+    try:
+        _capture(ra, BASE_QUERIES)
+        _capture(rb, BASE_QUERIES)
+        ra.start()
+        rb.start()
+        _drive(ra)
+        _drive(rb)
+        sa = ra.stats.exposition_snapshot()
+        sb = rb.stats.exposition_snapshot()
+        for q in BASE_QUERIES:
+            assert sa["counters"].get(f"{q}.emitted_rows", 0) == \
+                sb["counters"].get(f"{q}.emitted_rows", 0) > 0, q
+            assert q in sa["query_hist"], q
+        assert sa["counters"]["merged.S#0.dispatches"] == 10
+        assert sa["counters"]["merged.S#0.member_batches"] == 60
+        from siddhi_tpu.observability.timeseries import tenant_account
+        acct = tenant_account(ra)
+        assert acct["events_out"] == tenant_account(rb)["events_out"] > 0
+        assert acct["dispatch_wall_ns"] > 0
+        # merged owner registered for recompile blame / compile gate
+        rec = ra.stats.recompiles(ra)
+        assert any(o.startswith("merged:S#0") for o in rec), rec
+    finally:
+        ma.shutdown()
+        mb.shutdown()
+
+
+@pytest.mark.slow
+def test_admission_quota_ledger_exact_under_merge():
+    ql = ("@app:admission(max.events.per.sec='64', burst='128', "
+          "overload='shed')\n") + BASE_QL
+    m, rt = _build(ql, merge=True)
+    try:
+        assert rt.merged_groups
+        rt.start()
+        h = rt.get_input_handler("S")
+        offered = 1024
+        for i in range(offered // 64):
+            h.send([[j % 4, 1.0, j % 3] for j in range(64)],
+                   timestamp=1000 + i)
+        rt.flush()
+        adm = rt.admission
+        assert adm.shed_total > 0
+        assert adm.shed_total <= offered
+    finally:
+        m.shutdown()
+
+
+def test_explain_and_lint_and_audit_facts():
+    m, rt = _build(BASE_QL, merge=True)
+    try:
+        node = rt.explain("w1", deep=False)["merge"]
+        assert node == {"merged": True, "group": "S#0",
+                        "owner": "merged:S#0", "mode": "shared",
+                        "members": BASE_QUERIES,
+                        "group_dispatch_programs": 1}
+        findings = [f for f in rt.analyze()["findings"]
+                    if f["rule"] == "MQO001"]
+        assert any("merge group 'S#0'" in f["message"] for f in findings)
+        from siddhi_tpu.analysis.audit import query_fingerprint
+        fp = query_fingerprint(rt, "f1")
+        assert fp["merge"]["merged"] and fp["merge"]["group"] == "S#0"
+        # static lint (no runtime) reports the same grouping
+        from siddhi_tpu.analysis import analyze
+        static = [f for f in analyze(BASE_QL) if f.rule_id == "MQO001"]
+        assert any("merge group 'S#0'" in f.message and
+                   "6 queries" in f.message for f in static)
+    finally:
+        m.shutdown()
+
+
+@pytest.mark.slow
+def test_explain_merged_step_cost_after_traffic():
+    m, rt = _build(BASE_QL, merge=True)
+    try:
+        _capture(rt, ["w1"])
+        rt.start()
+        _drive(rt, n_batches=2)
+        rep = rt.explain("w1", deep=False)
+        assert "merged_step" in rep["steps"]
+        assert rep["steps"]["merged_step"].get("available") is True
+    finally:
+        m.shutdown()
+
+
+def test_quiesce_and_ondemand_under_merge():
+    """On-demand store queries quiesce through the shared member locks;
+    a merged app must not deadlock or lose fuse-stacked events."""
+    ql = "@app:fuse(batches='4')\n" + """
+define stream S (key long, v double, c int);
+define table T (key long, v double);
+@info(name='ins') from S[v > 0.0] select key, v insert into T;
+@info(name='w1') from S[v > 0.0]#window.length(16)
+select key, sum(v) as s group by key insert into W1;
+@info(name='w2') from S[v > 0.0]#window.length(16)
+select key, max(v) as m group by key insert into W2;
+"""
+    m, rt = _build(ql, merge=True)
+    try:
+        assert rt.merged_groups
+        rt.start()
+        h = rt.get_input_handler("S")
+        for i in range(3):      # partial fuse stack outstanding
+            h.send([[i, 1.5, 1]], timestamp=1000 + i)
+        rows = rt.query("from T select *")
+        assert len(rows) == 3   # quiesce drained the stack first
+    finally:
+        m.shutdown()
