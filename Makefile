@@ -5,11 +5,12 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: test lint lint-apps lint-smoke dryrun bench metrics-smoke \
 	fuse-smoke explain-smoke chaos-smoke multichip-smoke soak-smoke \
 	admission-smoke audit audit-update audit-smoke docgen-check \
-	join-smoke mqo-smoke all
+	join-smoke mqo-smoke serve-smoke all
 
 all: lint lint-apps docgen-check audit test dryrun metrics-smoke \
 	fuse-smoke explain-smoke lint-smoke chaos-smoke multichip-smoke \
-	soak-smoke admission-smoke audit-smoke join-smoke mqo-smoke
+	soak-smoke admission-smoke audit-smoke join-smoke mqo-smoke \
+	serve-smoke
 
 # static gate on our own code: ruff (rule set in pyproject.toml) when
 # available, with compileall kept as the syntax floor for samples and
@@ -126,6 +127,15 @@ join-smoke:
 mqo-smoke:
 	$(CPU_ENV) $(PY) samples/mqo_smoke.py
 	$(CPU_ENV) $(PY) bench.py --mode mqo_compare --quick
+
+# device-resident serving (ROADMAP item 2) in <30 s: @serve parity with
+# the blocking fetch (zero send-path device_get, asserted), ring
+# overflow growth with zero loss, quiesce draining rings to empty,
+# EXPLAIN/metrics/healthz serving surfaces, SERVE001 lint (README
+# "Device-resident serving"); plus the quick blocking-vs-served A-B
+serve-smoke:
+	$(CPU_ENV) $(PY) samples/serve_smoke.py
+	$(CPU_ENV) $(PY) bench.py --mode serve_compare --quick
 
 # overload is decided, not discovered, in <30 s: an over-ceiling deploy
 # denied BEFORE any compile, exact shed accounting (offered == accepted

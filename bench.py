@@ -33,24 +33,28 @@ from siddhi_tpu.analysis.corpus import (  # noqa: E402
 )
 
 
-def run_tpu(async_ingest: bool = False, pipeline: bool = False):
-    """One flagship measurement.  All three ingestion/emission modes are
+def run_tpu(async_ingest: bool = False, pipeline: bool = False,
+            serve: bool = False):
+    """One flagship measurement.  All four ingestion/emission modes are
     legitimate configurations (@async = the reference's Disruptor opt-in;
     @pipeline = one-deep deferred emission overlapping host staging with
-    the device step on the producer thread).  On a single-core driver host
-    the sync path beats @async (the worker thread contends with the
-    producer) while @pipeline should win on a tunneled device (the
-    emission fetch of batch N hides behind the dispatch of N+1), so
-    main() measures all and reports the best.  Each runtime reuses the
-    in-process jit cache (the device program is identical — the modes
-    only change host threading/ordering).
+    the device step on the producer thread; @serve = the device-resident
+    serving loop, emissions ring on-device and the async drainer pays
+    every fetch off the send path).  On a single-core driver host the
+    sync path beats @async (the worker thread contends with the
+    producer) while @pipeline/@serve should win on a tunneled device
+    (the emission fetch never blocks a send), so main() measures all
+    and reports the best.  Each runtime reuses the in-process jit cache
+    (the device program is identical — the modes only change host
+    threading/ordering).
     """
     from siddhi_tpu import SiddhiManager
 
     manager = SiddhiManager()
     rt = manager.create_siddhi_app_runtime(QL_TEMPLATE.format(
         async_ann="@async" if async_ingest else "",
-        pipe_ann="@pipeline(depth='8')" if pipeline else "",
+        pipe_ann="@serve" if serve else
+        ("@pipeline(depth='8')" if pipeline else ""),
         n_keys=N_KEYS, slots=SLOTS))
     matches = [0]
     # n_current is the device-computed count of valid CURRENT rows riding
@@ -102,8 +106,8 @@ def run_tpu(async_ingest: bool = False, pipeline: bool = False):
     dt = time.perf_counter() - t0
     eps = total / dt
     stats = _lat_stats(lat)
-    mode = "async" if async_ingest else (
-        "pipeline" if pipeline else "sync")
+    mode = "served" if serve else ("async" if async_ingest else (
+        "pipeline" if pipeline else "sync"))
     print(f"tpu[{mode}]: {total} events in {dt:.2f}s -> {eps:,.0f} ev/s; "
           f"matches={matches[0]}; batch p50={stats['p50_ms']}ms "
           f"p99={stats['p99_ms']}ms", file=sys.stderr)
@@ -463,6 +467,59 @@ def run_fuse_compare(k=8, B=1 << 11, n_batches=64):
     return results
 
 
+def run_serve_compare(k=8, B=1 << 11, n_batches=64, iters=20,
+                      out_path=None):
+    """--mode serve_compare: the device-resident serving loop A/B.
+
+    The identical @fuse(batches=K) sequence workload end-to-end, twice:
+    blocking (every fused drain pays the emission fetch on the send
+    path) vs @serve (emissions append into the on-device ring; the
+    async drainer pays the fetch off-path).  Match counts must agree —
+    serving changes WHEN the fetch happens, never the outputs.  The
+    device_loop chip ceiling for the same (K, B) closes the triangle:
+    `served_over_device_loop` is the fraction of pure chip throughput
+    the served send path sustains (the SERVE artifact's headline gap)."""
+    results = {}
+    for tag, ann in (("blocking", f"@fuse(batches='{k}')"),
+                     ("served", f"@serve\n@fuse(batches='{k}')")):
+        rng = np.random.default_rng(4)
+
+        def mk(i):
+            return ([np.zeros(B, np.int64),
+                     rng.random(B, np.float32),
+                     np.tile(np.array([1, 2], np.int32), B // 2)],
+                    {"timestamps": 1000 + i * 50 +
+                     np.arange(B, dtype=np.int64) % 50})
+        eps, count, lat = _drive(SEQUENCE_QL.format(ann=ann), "q", "S",
+                                 mk, n_batches, warmup=max(2, k))
+        results[tag] = {"value": round(eps), "unit": "events/sec",
+                        "matches": count, **lat}
+        print(f"serve_compare[{tag}]: {eps:,.0f} ev/s "
+              f"p50={lat['p50_ms']}ms p99={lat['p99_ms']}ms "
+              f"matches={count}", file=sys.stderr)
+    assert results["served"]["matches"] == \
+        results["blocking"]["matches"], \
+        "serving changed the outputs — ring delivery lost or duplicated"
+    ceiling = run_device_loop(k=k, B=B, iters=iters)
+    base = results["blocking"]["value"]
+    served = results["served"]["value"]
+    payload = {
+        "metric": "serve_compare_sequence_events_per_sec",
+        "k": k, "batch": B, "n_batches": n_batches,
+        "speedup": round(served / max(base, 1), 2),
+        "device_loop_events_per_sec": round(ceiling),
+        "served_over_device_loop": round(served / max(ceiling, 1), 4),
+        "configs": results,
+        "shape": "analysis/corpus.py SEQUENCE_QL (+@serve)",
+    }
+    print(json.dumps(payload))
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+        print(f"wrote {out_path}", file=sys.stderr)
+    return payload
+
+
 def run_join_compare(B=1 << 10, n_batches=8, out_path=None):
     """--mode join_compare: the windowed_join corpus shape with the
     equi-join fast path ON vs OFF (full [R,C] grid), plus the
@@ -788,7 +845,8 @@ def main():
     results = {}
     errors = {}
     for mode_name, kw in (("sync", {}), ("pipeline", {"pipeline": True}),
-                          ("async", {"async_ingest": True})):
+                          ("async", {"async_ingest": True}),
+                          ("served", {"serve": True})):
         try:
             results[mode_name] = run_tpu(**kw)
         except Exception as exc:  # noqa: BLE001 — isolate mode failures
@@ -1727,7 +1785,8 @@ if __name__ == "__main__":
     ap.add_argument("--mode", default="full",
                     choices=["full", "device_loop", "fuse_compare",
                              "cost_analysis", "multichip", "soak",
-                             "join_compare", "mqo_compare"],
+                             "join_compare", "mqo_compare",
+                             "serve_compare"],
                     help="full: the flagship suite (default); "
                          "device_loop: tunnel-independent chip-side "
                          "events/sec via fused dispatch re-execution; "
@@ -1745,7 +1804,10 @@ if __name__ == "__main__":
                          "mqo_compare: 50-query single-stream app with "
                          "the multi-query optimizer ON vs OFF — "
                          "byte-identical outputs asserted, dispatch "
-                         "count + aggregate ev/s A/B (MQO artifact)")
+                         "count + aggregate ev/s A/B (MQO artifact); "
+                         "serve_compare: blocking emission fetch vs "
+                         "@serve device ring + async drain, plus the "
+                         "device_loop ceiling gap (SERVE artifact)")
     ap.add_argument("--k", type=int, default=16,
                     help="fused stack depth (device_loop/fuse_compare)")
     ap.add_argument("--batch", type=int, default=1 << 11,
@@ -1797,6 +1859,13 @@ if __name__ == "__main__":
                         B=1 << 9 if args.quick else 1 << 10,
                         n_batches=8 if args.quick else 24,
                         out_path=args.out, check_bars=not args.quick)
+    elif args.mode == "serve_compare":
+        _enable_compile_cache()
+        run_serve_compare(k=4 if args.quick else 8,
+                          B=1 << 9 if args.quick else args.batch,
+                          n_batches=8 if args.quick else 64,
+                          iters=5 if args.quick else 20,
+                          out_path=args.out)
     elif args.mode == "multichip":
         _enable_compile_cache()
         run_multichip(quick=args.quick, out_path=args.out)

@@ -125,6 +125,11 @@ def bench_shapes() -> List[Tuple[str, str, int]]:
                                      n_keys=512, slots=4), 1),
         ("bench/windowed_join", WINDOWED_JOIN_QL, 1),
         ("bench/block_nfa", SEQUENCE_QL.format(ann=""), 1),
+        # the served variant bench --mode serve_compare drives: same NFA
+        # with emissions routed through the device ring (its fingerprint
+        # pins the serve_ring state component plan_facts adds)
+        ("bench/block_nfa_served",
+         SEQUENCE_QL.format(ann="@serve\n@fuse(batches='8')"), 1),
         ("bench/flagship_sharded", MC_FLAGSHIP_QL.format(keys=512), 4),
     ]
 

@@ -760,7 +760,8 @@ def _admission_hazards(ctx: AnalysisContext) -> Iterator[Finding]:
       "query left out, the planner's exact ineligibility reason "
       "(core/plan_facts.merge_plan — the same single source the "
       "runtime pass and EXPLAIN's `merge` node read).",
-      "align @async/@pipeline/@fuse decorations, window specs, and "
+      "align @async/@pipeline/@fuse/@serve decorations, window specs, "
+      "and "
       "pre-window filters across co-resident queries to widen merge "
       "groups; set optimizer.merge.enabled=false to opt out")
 def _merge_groups(ctx: AnalysisContext) -> Iterator[Finding]:
@@ -815,8 +816,58 @@ def _merge_groups(ctx: AnalysisContext) -> Iterator[Finding]:
                  node=f.query if f is not None else None)
 
 
+@rule("SERVE001", "WARN",
+      "@serve query drains into a synchronous-blocking sink",
+      "Device-resident serving (siddhi_tpu/serving) moves delivery onto "
+      "ONE shared drainer thread per app: the send path only appends to "
+      "an on-device ring, and the drainer fetches and publishes later.  "
+      "A sink with on.error='wait' blocks its publish call until the "
+      "transport recovers — on the drainer thread that stall is "
+      "head-of-line blocking for EVERY serving query's ring: occupancy "
+      "climbs to high-water, producers fall back to bounded ring "
+      "backpressure, and the app's serving path degrades to the "
+      "synchronous behavior @serve was meant to remove.",
+      "use @sink(on.error='retry'|'store'|'stream') on streams fed by "
+      "@serve queries so the drainer never parks, or drop @serve from "
+      "the query feeding the 'wait' sink")
+def _serve_blocking_sink(ctx: AnalysisContext) -> Iterator[Finding]:
+    from ..core.plan_facts import serve_enabled
+    app = ctx.app
+    rt = ctx.runtime
+    for f in ctx.queries:
+        q = f.query
+        # serving? live runtime wins (serving.enabled config can turn
+        # the app on wholesale); statically only annotations decide
+        if rt is not None:
+            qr = getattr(rt, "query_runtimes", {}).get(f.name)
+            serving = bool(getattr(qr, "serve_emit", False))
+        else:
+            try:
+                serving = bool(serve_enabled(app, q))
+            except Exception:  # noqa: BLE001 — analysis must not die
+                serving = False
+        if not serving:
+            continue
+        out = q.output_stream
+        tgt = getattr(out, "target_id", None)
+        sdef = app.stream_definition_map.get(tgt) if tgt else None
+        if sdef is None:
+            continue
+        for ann in sdef.annotations:
+            if ann.name.lower() != "sink":
+                continue
+            if str(ann.element("on.error", "log")).lower() != "wait":
+                continue
+            stype = ann.element("type") or ann.element(None)
+            yield _f(f"@serve query {f.name!r} feeds "
+                     f"@sink(type={str(stype)!r}, on.error='wait') on "
+                     f"{tgt!r} — a transport stall parks the shared "
+                     "drainer thread and backpressures every serving "
+                     "ring in the app", query=f.name, node=ann)
+
+
 ALL_RULE_IDS: List[str] = [
     "STATE001", "STATE002", "MEM001", "FUSE001", "JOIN001", "JOIN002",
     "DEAD001", "DEAD002", "NULL001", "PART001", "PART002", "TYPE001",
-    "RATE001", "APP001", "SINK001", "ADM001", "MQO001",
+    "RATE001", "APP001", "SINK001", "ADM001", "MQO001", "SERVE001",
 ]

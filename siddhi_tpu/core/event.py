@@ -350,7 +350,7 @@ class StagedBatch:
     """Host (numpy) staging of a batch: used for group-key/partition-key slot
     computation before the single host->device transfer."""
 
-    __slots__ = ("ts", "kind", "valid", "cols", "n", "jprobe")
+    __slots__ = ("ts", "kind", "valid", "cols", "n", "jprobe", "dev")
 
     def __init__(self, ts, kind, valid, cols, n):
         self.ts, self.kind, self.valid, self.cols, self.n = ts, kind, valid, cols, n
@@ -358,8 +358,16 @@ class StagedBatch:
         # replayed verbatim by drains/dispatch (core/runtime.py
         # JoinQueryRuntime._join_key_probe)
         self.jprobe = None
+        # (schema, EventBatch) prestaged by the serving double-buffer
+        # (serving/staging.py): the H2D transfer started at the junction
+        # accept edge; to_device adopts it instead of re-transferring
+        self.dev = None
 
     def to_device(self, schema: Schema) -> EventBatch:
+        dev = self.dev
+        if dev is not None and (dev[0] is schema or
+                                dev[0].dtypes == schema.dtypes):
+            return dev[1]
         cols = tuple(jnp.asarray(c).astype(d)
                      for c, d in zip(self.cols, schema.dtypes))
         return EventBatch(jnp.asarray(self.ts), jnp.asarray(self.kind),

@@ -450,7 +450,8 @@ def _dispatch_merged(qr, items) -> None:
                  if _rt._has_consumers(m)]
     deferred = (getattr(qr.members[0], "async_emit", False) and
                 qr.app._drainer is not None) or \
-        bool(getattr(qr.members[0], "pipeline_emit", 0) or 0)
+        bool(getattr(qr.members[0], "pipeline_emit", 0) or 0) or \
+        getattr(qr.members[0], "serve_emit", False)
     if consumers and not deferred:
         # ONE fetch for every consumed member's whole [K, ...] block;
         # per-batch views below are then numpy slices
@@ -484,11 +485,12 @@ def _deliver_fused(qr, outs, nows: List[int]) -> None:
     Sync mode fetches ONE combined header ([K, 2] for compacted
     pattern/join outputs; the whole capacity-bounded block for plain
     outputs) and feeds per-batch numpy slices through the standard
-    emission path.  @async/@pipeline compose by re-entering
-    `_emit_output` per batch — the drainer/deque already batch their
-    header fetches.  A per-batch failure (emission-cap overflow,
-    callback error) defers until every batch has been delivered, then
-    the first error propagates to the junction's fault routing."""
+    emission path.  @serve/@async/@pipeline compose by re-entering
+    `_emit_output` per batch — the serving ring appends stay
+    dispatch-only and the drainer/deque already batch their header
+    fetches.  A per-batch failure (emission-cap overflow, callback
+    error) defers until every batch has been delivered, then the first
+    error propagates to the junction's fault routing."""
     from . import runtime as _rt
     ingests = qr.__dict__.pop("_fused_ingests", None)
     if not _rt._has_consumers(qr):
@@ -496,7 +498,9 @@ def _deliver_fused(qr, outs, nows: List[int]) -> None:
     K = len(nows)
     if ingests is None or len(ingests) != K:
         ingests = [None] * K
-    if getattr(qr, "async_emit", False) and qr.app._drainer is not None \
+    if getattr(qr, "serve_emit", False) \
+            or getattr(qr, "async_emit", False) and \
+            qr.app._drainer is not None \
             or getattr(qr, "pipeline_emit", 0):
         for i in range(K):
             # per-batch stamp restored so _emit_output's deferred queues

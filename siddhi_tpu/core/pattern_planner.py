@@ -199,6 +199,10 @@ class PlannedPatternQuery:
         if self.mesh is not None:
             d["sharded_over_devices"] = int(self.mesh.devices.size)
             d["shard_fused_step"] = self.shard_fused_steps is not None
+        # @serve (serving/): patterns are ring-eligible — wake-bearing
+        # batches (within-window timers) still deliver inline, everything
+        # else appends to the device ring
+        d["serve_eligible"] = True
         return d
 
 

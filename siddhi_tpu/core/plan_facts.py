@@ -86,6 +86,11 @@ WINDOW_HINT = 2048
 PARTITION_WINDOW_HINT = 128
 PARTITION_KEYS = 4096
 NFA_SLOTS = 8
+# default serving emission-ring slot count (serving/ring.py) when
+# neither @serve(ring.capacity=) nor `serving.ring.capacity` says
+# otherwise — kept here so the static state estimator and the runtime
+# agree on the ring's footprint
+SERVE_RING_SLOTS = 8
 # columnar buffer overhead per row beyond the payload columns:
 # ts i64 + seq i64 + gslot i32 + alive bool (core/window.py empty_buffer)
 ROW_OVERHEAD = 8 + 8 + 4 + 1
@@ -295,6 +300,13 @@ def static_state_components(app, mesh_devices: int = 0,
         caps = capacity_annotation(q, part)
         keys = caps.get("keys", PARTITION_KEYS)
         comps = query_state_components(app, q, kind, part, caps, keys)
+        if serve_enabled(app, q):
+            # serving emission ring (serving/ring.py): device-resident,
+            # so it counts against the same MEM001/deploy-gate budget
+            # window buffers do
+            comps = dict(comps)
+            comps["serve_ring"] = serve_ring_bytes(app, q, kind, part,
+                                                   caps)
         if comps:
             out[name] = comps
     if merged and mesh_devices <= 1:
@@ -595,12 +607,73 @@ def fuse_depth(app, q) -> int:
     return max(1, int(k))
 
 
+def serve_enabled(app, q) -> bool:
+    """@serve on the query, any input stream definition, or @app:serve —
+    the device-resident serving loop (siddhi_tpu/serving): emissions
+    append to an on-device ring and the async drainer delivers them;
+    the send path never fetches.  `enabled='false'` opts a query out of
+    an app-wide @app:serve.  The ONE implementation runtime wiring
+    (`_serve_enabled`), the merge planner, EXPLAIN, and lint SERVE001
+    share.  (The `serving.enabled` config property enables serving at
+    the runtime level without annotations — that path is resolved in
+    runtime wiring, not here: plan facts stay pure AST.)"""
+    ann = q.get_annotation("serve")
+    if ann is None:
+        ist = q.input_stream
+        sids = getattr(ist, "all_stream_ids", None) or \
+            [getattr(ist, "stream_id", None)]
+        for sid in sids:
+            sdef = app.stream_definition_map.get(sid)
+            if sdef is not None and \
+                    sdef.get_annotation("serve") is not None:
+                ann = sdef.get_annotation("serve")
+                break
+    if ann is None:
+        ann = app.get_annotation("app:serve")
+    if ann is None:
+        return False
+    flag = str(ann.element("enabled", "true") or "true").lower()
+    return flag not in ("false", "0", "no", "off")
+
+
+def serve_ring_capacity(app, q) -> int:
+    """@serve(ring.capacity=S) on the query (wins) or @app:serve; 0
+    means "use the `serving.ring.capacity` config property / default"."""
+    ann = q.get_annotation("serve")
+    if ann is None:
+        ann = app.get_annotation("app:serve")
+    if ann is None:
+        return 0
+    try:
+        return max(0, int(ann.element("ring.capacity", 0) or 0))
+    except Exception:  # noqa: BLE001 — malformed element reads as unset
+        return 0
+
+
+def serve_ring_bytes(app, q, kind: str, part, caps: Dict[str, int]) -> int:
+    """Static estimate of one query's serving emission ring
+    (serving/ring.py): SERVE_RING_SLOTS stacked output blocks.  Output
+    rows bound by the window/batch capacity; row width is ts i64 +
+    kind i32 + valid bool + one device word per selected column."""
+    hint = caps.get(
+        "window",
+        PARTITION_WINDOW_HINT if part is not None else WINDOW_HINT)
+    if kind == "plain":
+        rows = window_capacity(window_handler(q.input_stream), hint)
+    else:
+        rows = hint
+    slots = serve_ring_capacity(app, q) or SERVE_RING_SLOTS
+    ncols = max(1, len(q.selector.selection_list))
+    return slots * rows * (12 + 1 + 8 * ncols)
+
+
 def merge_decorations(app, q) -> Tuple:
     """The emission/dispatch decorations that must agree across a merge
     group: members of one dispatch share the demux path, so @async,
-    @pipeline depth, and @fuse K cannot differ within a group."""
+    @pipeline depth, @fuse K, and @serve cannot differ within a
+    group."""
     return (async_enabled(app, q), pipeline_depth(app, q),
-            fuse_depth(app, q))
+            fuse_depth(app, q), serve_enabled(app, q))
 
 
 def merge_ineligibility(app, q, kind: str, part,
@@ -733,7 +806,7 @@ def merge_plan(app, mesh_devices: int = 0) -> Dict:
             for name, _q in members:
                 reasons[name] = (
                     f"no co-resident query shares stream {sid!r} and "
-                    f"its @async/@pipeline/@fuse decorations")
+                    f"its @async/@pipeline/@fuse/@serve decorations")
             continue
         gi = per_stream.get(sid, 0)
         per_stream[sid] = gi + 1
@@ -774,7 +847,8 @@ def merge_plan(app, mesh_devices: int = 0) -> Dict:
             "members": [n for n, _ in members],
             "decorations": {"async": bool(deco[0]),
                             "pipeline": int(deco[1]),
-                            "fuse": int(deco[2])},
+                            "fuse": int(deco[2]),
+                            "serve": bool(deco[3])},
             "units": resolved,
         })
     return {"groups": groups, "reasons": reasons}

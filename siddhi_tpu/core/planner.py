@@ -111,6 +111,9 @@ class PlannedQuery:
             d["sharded_over_devices"] = int(m.devices.size)
         if self.in_deps:
             d["table_probes"] = list(self.in_deps)
+        # @serve (serving/): timer-bearing windows deliver inline so wake
+        # scheduling stays synchronous — same exclusion as @pipeline
+        d["serve_eligible"] = not self.needs_timer
         return d
 
 

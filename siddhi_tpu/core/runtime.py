@@ -782,6 +782,18 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
     # so the `<query>:e2e` histogram includes queue wait — None when
     # statistics are OFF or the batch arrived outside a junction dispatch
     ingest_ns = qr.__dict__.get("_ingest_ns")
+    if getattr(qr, "serve_emit", False) and wake is None and \
+            not getattr(qr.planned, "needs_timer", False):
+        # device-resident serving loop (siddhi_tpu/serving): the output
+        # pytree appends into the query's on-device emission ring — a
+        # single jitted dispatch, zero fetches — and the per-app drainer
+        # thread delivers it through _emit_output_sync later.  Timer-
+        # bearing queries keep their inline path (same exclusion as
+        # @pipeline: a deferred wake scalar would stall expiry), and
+        # serving takes precedence over @async/@pipeline below.
+        from ..serving import ring_append
+        ring_append(qr, out, now, ingest_ns)
+        return
     if getattr(qr, "async_emit", False) and qr.app._drainer is not None:
         qr.app._drainer.enqueue(qr, out, now, wake, ingest_ns)
         return
@@ -1676,8 +1688,26 @@ class StreamJunction:
             t.start()
             self._async_workers.append(t)
 
+    def _serve_stage(self, staged) -> None:
+        """Double-buffered H2D staging (serving/staging.py): when any
+        subscriber runs the serving loop, the batch's device upload
+        starts HERE at the accept edge — batch N+1's transfer overlaps
+        batch N's compute (and, on the @async path, the queue wait)."""
+        on = getattr(self, "_serve_staging", None)
+        if on is None:
+            # memoized on first dispatch: wiring is complete by then
+            on = self._serve_staging = any(
+                getattr(getattr(q, "_qr", q), "serve_emit", False)
+                for q in self.queries)
+        if on and self.app is not None:
+            st = getattr(self.app, "_serve_stager", None)
+            if st is not None:
+                st.stage(staged, self.schema)
+
     def enqueue(self, tag: str, payload, now: int) -> None:
         q = self._async_q
+        if tag == "staged":
+            self._serve_stage(payload)
         # ingest stamp taken BEFORE the queue put: the `<query>:e2e`
         # histogram must include @async queue wait, not start at dispatch
         stats = self.app.stats if self.app is not None else None
@@ -1821,6 +1851,7 @@ class StreamJunction:
         """Run every subscribed query over a staged batch, serialized per
         QUERY (not per app) so queries on different streams — or workers of
         different streams — process concurrently."""
+        self._serve_stage(staged)   # idempotent (skips if prestaged)
         stats = self.app.stats if self.app is not None else None
         if stats is None or not stats.enabled:
             for q in self.queries:
@@ -1861,6 +1892,7 @@ class StreamJunction:
                 cb(events)
             if self.queries:
                 staged = ev.pack_np(self.schema, events)
+                self._serve_stage(staged)
                 for q in self.queries:
                     try:
                         self._dispatch_one(q, staged, now, None, 0, False)
@@ -1885,6 +1917,7 @@ class StreamJunction:
                 with (_tracing.span("ingest", stream=self.stream_id)
                       if tr is not None else _NULL_CM):
                     staged = ev.pack_np(self.schema, events)
+                self._serve_stage(staged)
                 for q in self.queries:
                     try:
                         self._dispatch_one(q, staged, now, stats,
@@ -2371,6 +2404,13 @@ class SiddhiAppRuntime:
         self._ingress_gate.set()
         self._scheduler = _Scheduler(self)
         self._drainer = _EmissionDrainer()
+        # device-resident serving loop (siddhi_tpu/serving): ring drainer
+        # (thread lazy-starts on the first ring) + H2D staging pipeline
+        from ..serving import (DoubleBufferedStager, ServingDrainer,
+                               serving_config)
+        self._serve_drainer = ServingDrainer(
+            self, serving_config(self)["drain_interval_ms"])
+        self._serve_stager = DoubleBufferedStager()
         # on-demand plan LRU: query string -> (parsed AST, OnDemandPlanMemo)
         self._ondemand_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
@@ -2633,6 +2673,7 @@ class SiddhiAppRuntime:
                 compact_rows_override=cap)
             runtime.async_emit = self._async_enabled(q)
             runtime.pipeline_emit = self._pipeline_enabled(q)
+            self._wire_serve(runtime, q)
             self._maybe_fuse(runtime, q, "pattern")
             self.query_runtimes[name] = runtime
             for sid in planned.spec.stream_ids:
@@ -2686,6 +2727,7 @@ class SiddhiAppRuntime:
         runtime = QueryRuntime(planned, self)
         runtime.async_emit = self._async_enabled(q)
         runtime.pipeline_emit = self._pipeline_enabled(q)
+        self._wire_serve(runtime, q)
         self._maybe_fuse(runtime, q, "plain")
         self.query_runtimes[name] = runtime
         if from_window:
@@ -2821,6 +2863,7 @@ class SiddhiAppRuntime:
         runtime._replan = _join_replan
         runtime.async_emit = self._async_enabled(q)
         runtime.pipeline_emit = self._pipeline_enabled(q)
+        self._wire_serve(runtime, q)
         self._maybe_fuse(runtime, q, "join")
         self.query_runtimes[name] = runtime
         for side, is_left in ((planned.left, True), (planned.right, False)):
@@ -2867,6 +2910,36 @@ class SiddhiAppRuntime:
         # is the one implementation, shared with the merge planner
         from .plan_facts import pipeline_depth
         return pipeline_depth(self.app, q)
+
+    def _serve_enabled(self, q) -> bool:
+        """Device-resident serving loop (siddhi_tpu/serving): emissions
+        append to an on-device ring (dispatch-only send path) and the
+        per-app drainer thread delivers them asynchronously.  Enabled by
+        @serve on the query / any input stream / @app:serve
+        (plan_facts.serve_enabled — the one implementation, shared with
+        the merge planner and lint) or app-wide by the `serving.enabled`
+        config property; @serve(enabled='false') opts a query out of
+        either blanket.  Takes precedence over @async/@pipeline in
+        _emit_output; timer-bearing queries fall back to inline
+        delivery there (same exclusion @pipeline has)."""
+        from .plan_facts import serve_enabled
+        if serve_enabled(self.app, q):
+            return True
+        # any explicit @serve annotation that did NOT enable is an
+        # opt-out — the config blanket must not override it
+        if q.get_annotation("serve") is not None or \
+                self.app.get_annotation("app:serve") is not None:
+            return False
+        from ..serving import serving_config
+        return bool(serving_config(self)["enabled"])
+
+    def _wire_serve(self, runtime, q) -> None:
+        """Stash the serving decision + ring sizing on the runtime at
+        wiring time (the emission hot path reads attributes only)."""
+        runtime.serve_emit = self._serve_enabled(q)
+        if runtime.serve_emit:
+            from .plan_facts import serve_ring_capacity
+            runtime.serve_ring_capacity = serve_ring_capacity(self.app, q)
 
     def _fuse_enabled(self, q) -> int:
         """@fuse(batches='K') on the query, any input stream definition,
@@ -3008,6 +3081,7 @@ class SiddhiAppRuntime:
                     compact_rows_override=cap)
                 runtime.async_emit = self._async_enabled(q)
                 runtime.pipeline_emit = self._pipeline_enabled(q)
+                self._wire_serve(runtime, q)
                 self._maybe_fuse(runtime, q, "pattern")
                 self.query_runtimes[qname] = runtime
                 part_runtimes.append(runtime)
@@ -3094,6 +3168,7 @@ class SiddhiAppRuntime:
                 runtime = QueryRuntime(planned, self)
                 runtime.async_emit = self._async_enabled(q)
                 runtime.pipeline_emit = self._pipeline_enabled(q)
+                self._wire_serve(runtime, q)
                 self._maybe_fuse(runtime, q, "plain")
                 self.query_runtimes[qname] = runtime
                 part_runtimes.append(runtime)
@@ -3215,6 +3290,10 @@ class SiddhiAppRuntime:
                 # accepted send's output must not vanish (at-least-once)
                 _fusion.drain(qr)
                 _drain_pending_emit(qr)
+            # serving rings drain BEFORE sinks stop: fuse/pipeline drains
+            # above may have appended, and an accepted send's output must
+            # not die in device memory (at-least-once)
+            self._serve_drainer.stop()
             for sk in self.sinks:
                 sk.stop()
             self._drainer.stop()
@@ -3246,10 +3325,12 @@ class SiddhiAppRuntime:
                 _fusion.drain(qr)   # partial @fuse stacks process NOW
                 _drain_pending_emit(qr)
             self._drainer.flush()
+            self._serve_drainer.drain_all()   # serving rings -> empty
             if all(j.pending_async() == 0 for j in self.junctions.values()) \
                     and not any(getattr(qr, "_pending_emit", None) or
                                 _fusion.pending(qr)
-                                for qr in self._step_runtimes()):
+                                for qr in self._step_runtimes()) \
+                    and self._serve_drainer.pending() == 0:
                 return
         import logging
         logging.getLogger("siddhi_tpu").warning(
@@ -3321,11 +3402,15 @@ class SiddhiAppRuntime:
                     # land in the snapshotted state, not vanish
                     _fusion.drain(qr)
                     _drain_pending_emit(qr)
+                # serving rings drain to EMPTY under quiesce: ring
+                # contents are in-flight output, never snapshotted state
+                self._serve_drainer.drain_all()
                 if all(j.pending_async() == 0
                        for j in self.junctions.values()) and \
                         not any(getattr(qr, "_pending_emit", None) or
                                 _fusion.pending(qr)
-                                for qr in self._step_runtimes()):
+                                for qr in self._step_runtimes()) and \
+                        self._serve_drainer.pending() == 0:
                     break
             locks = [self._lock]
             for qname in sorted(self.query_runtimes):
@@ -3542,6 +3627,38 @@ class SiddhiAppRuntime:
         """Device outputs sitting in the async emission drainer queue
         (siddhi_drainer_queue_depth; 0 on a stopped app)."""
         d = getattr(self, "_drainer", None)
+        if d is None:
+            return 0
+        try:
+            return d.depth()
+        except Exception:  # noqa: BLE001 — metrics must not throw
+            return 0
+
+    def serve_rings(self) -> Dict[str, "object"]:
+        """{query: EmissionRing} for every runtime that has opened a
+        serving ring (host-side attribute reads only)."""
+        out: Dict[str, object] = {}
+        for qname, qr in list(self.query_runtimes.items()):
+            ring = qr.__dict__.get("_serve_ring")
+            if ring is not None:
+                out[qname] = ring
+        return out
+
+    def ring_occupancies(self) -> Dict[str, int]:
+        """Pending (appended, undrained) serving-ring entries per query
+        — the siddhi_ring_occupancy gauge (safe mid-shutdown)."""
+        out: Dict[str, int] = {}
+        for qname, ring in self.serve_rings().items():
+            try:
+                out[qname] = ring.occupancy()
+            except Exception:  # noqa: BLE001 — metrics must not throw
+                out[qname] = 0
+        return out
+
+    def serve_drainer_depth(self) -> int:
+        """Ring entries awaiting the serving drainer across all rings
+        (the serving analog of drainer_depth; 0 on a stopped app)."""
+        d = getattr(self, "_serve_drainer", None)
         if d is None:
             return 0
         try:

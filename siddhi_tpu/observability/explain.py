@@ -357,6 +357,37 @@ def _emission_node(qr, kind: str) -> Dict:
     return node
 
 
+def _serving_node(rt, qr) -> Dict:
+    """Device-resident serving facts (serving/ring.py): whether @serve
+    routes this query's emissions through an on-device ring, the live
+    ring occupancy/overflow counters once traffic has flowed, and the
+    exclusion reason when the planner keeps delivery inline."""
+    enabled = bool(getattr(qr, "serve_emit", False))
+    node: Dict[str, Any] = {"enabled": enabled}
+    if not enabled:
+        return node
+    try:
+        from ..serving import serving_config
+        node["drain_interval_ms"] = \
+            serving_config(rt)["drain_interval_ms"]
+    except Exception:  # noqa: BLE001 — diagnostics must not throw
+        pass
+    if getattr(qr.planned, "needs_timer", False):
+        # same exclusion as @pipeline: timer-bearing queries deliver
+        # inline so wake scheduling stays synchronous
+        node["active"] = False
+        node["excluded"] = "needs_timer"
+        return node
+    node["active"] = True
+    ring = qr.__dict__.get("_serve_ring")
+    if ring is not None:
+        try:
+            node["ring"] = ring.facts()
+        except Exception:  # noqa: BLE001 — diagnostics must not throw
+            pass
+    return node
+
+
 def _tree_for(qr, kind: str) -> Dict:
     """Planned operator tree from the query AST + compiled plan facts."""
     from ..query_api.query import (JoinInputStream, SingleInputStream,
@@ -449,6 +480,7 @@ def explain_query(rt, query_name: str, deep: bool = True) -> Dict:
         "emission": _emission_node(qr, kind),
         "fusion": _fusion_node(qr, kind),
         "merge": _merge_node(qr),
+        "serving": _serving_node(rt, qr),
         **_sharding_entry(qr, kind, deep),
         "recompiles": RECOMPILES.snapshot(
             [query_name, f"fused:{query_name}"]),

@@ -194,6 +194,20 @@ def render_prometheus(runtimes: Dict) -> str:
                 "factor")
     mrg_q = fam("siddhi_merged_queries", "gauge",
                 "Member queries compiled into each merge group")
+    ring_oc = fam("siddhi_ring_occupancy", "gauge",
+                  "Emissions resident in a query's on-device serving "
+                  "ring, awaiting the async drainer "
+                  "(siddhi_tpu/serving)")
+    ring_dr = fam("siddhi_ring_drains_total", "counter",
+                  "Serving-ring emissions delivered by the async "
+                  "drainer, per query")
+    ring_gr = fam("siddhi_ring_overflow_grows_total", "counter",
+                  "Serving-ring overflow growths (full ring doubled "
+                  "via the admission-gated grow-via-replan path), "
+                  "per query")
+    srv_dep = fam("siddhi_serve_drainer_queue_depth", "gauge",
+                  "Ring entries awaiting the serving drainer across "
+                  "all of an app's rings right now")
 
     for app_name, rt in sorted(runtimes.items()):
         st = rt.stats
@@ -244,6 +258,12 @@ def render_prometheus(runtimes: Dict) -> str:
                 mrg_b.sample(n, app=app_name,
                              group=name[len("merged."):
                                         -len(".member_batches")])
+            elif name.endswith(".ring_drains"):
+                ring_dr.sample(n, app=app_name,
+                               query=name[:-len(".ring_drains")])
+            elif name.endswith(".ring_grows"):
+                ring_gr.sample(n, app=app_name,
+                               query=name[:-len(".ring_grows")])
         for gid, mg in sorted(getattr(rt, "merged_groups", {}).items()):
             mrg_q.sample(len(getattr(mg, "members", ())), app=app_name,
                          group=gid)
@@ -256,6 +276,13 @@ def render_prometheus(runtimes: Dict) -> str:
                 q_dep.sample(n, app=app_name, stream=sid)
         if hasattr(rt, "drainer_depth"):
             d_dep.sample(rt.drainer_depth(), app=app_name)
+        # serving-loop gauges: ring occupancy per query + drainer
+        # backlog (host-side deque length reads — never a fetch)
+        if hasattr(rt, "ring_occupancies"):
+            for q, n in sorted(rt.ring_occupancies().items()):
+                ring_oc.sample(n, app=app_name, query=q)
+        if hasattr(rt, "serve_drainer_depth"):
+            srv_dep.sample(rt.serve_drainer_depth(), app=app_name)
         # SLO rule states, attached to the runtime by the sampler tick
         slo = rt.__dict__.get("_slo_state") \
             if hasattr(rt, "__dict__") else None

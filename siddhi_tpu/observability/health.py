@@ -230,6 +230,31 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         except Exception:  # noqa: BLE001 — probe must not throw
             admission = None
 
+    # serving drainer (siddhi_tpu/serving/drain.py): a stalled or dead
+    # drainer flips `degraded`, NOT `live` — producers fall back to
+    # bounded ring backpressure while the app keeps processing, so the
+    # right response is alarm-and-drain, not a restart loop
+    serving = None
+    sd = getattr(rt, "_serve_drainer", None)
+    if sd is not None and getattr(sd, "_started", False):
+        try:
+            stalled = bool(sd.stalled())
+            alive = bool(sd.alive())
+            serving = {
+                "drainer_alive": alive,
+                "drainer_stalled": stalled,
+                "pending": sd.pending(),
+                "drains_total": sd.drains_total,
+                "drained_outputs_total": sd.drained_outputs_total,
+                "rings": {q: r.facts()
+                          for q, r in rt.serve_rings().items()}
+                if hasattr(rt, "serve_rings") else {},
+            }
+            if stalled or not alive:
+                degraded = True
+        except Exception:  # noqa: BLE001 — probe must not throw
+            serving = None
+
     report = {
         "started": started,
         "accepting_ingress": accepting,
@@ -240,6 +265,7 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         "sinks": sinks,
         "degraded": degraded,
         **({"shards": shards} if shards is not None else {}),
+        **({"serving": serving} if serving is not None else {}),
         **({"slo": slo} if slo is not None else {}),
         **({"admission": admission} if admission is not None else {}),
         "buffered_emissions": rt.buffered_emissions(),

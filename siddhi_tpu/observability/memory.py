@@ -93,6 +93,17 @@ def _kind_components(qr) -> Dict[str, int]:
                     total += sum(leaf_nbytes(c) for c in staged.cols)
         if total:
             out["fuse_stack"] = total
+    # serving emission ring (serving/ring.py): device-resident output
+    # slots awaiting the async drainer — metadata-only walk of the
+    # ring's generation buffers
+    ring = qr.__dict__.get("_serve_ring")
+    if ring is not None:
+        try:
+            total = sum(tree_nbytes(s) for s in ring.state_leaves())
+        except Exception:  # noqa: BLE001 — metrics must not throw
+            total = 0
+        if total:
+            out["serve_ring"] = total
     return out
 
 

@@ -298,7 +298,8 @@ class MergedGroupRuntime:
         members = self.members
         deferred = (getattr(members[0], "async_emit", False) and
                     self.app._drainer is not None) or \
-            bool(getattr(members[0], "pipeline_emit", 0) or 0)
+            bool(getattr(members[0], "pipeline_emit", 0) or 0) or \
+            getattr(members[0], "serve_emit", False)
         consumers = [i for i, m in enumerate(members)
                      if _rt._has_consumers(m)]
         hosted: Dict[int, List] = {}
@@ -382,8 +383,8 @@ def apply_merge(rt) -> None:
             for name, _qr in members:
                 reasons[name] = (
                     f"no co-resident query shares stream "
-                    f"{g['stream']!r} and its @async/@pipeline/@fuse "
-                    f"decorations")
+                    f"{g['stream']!r} and its @async/@pipeline/@fuse/"
+                    f"@serve decorations")
             continue
         kept = {n for n, _ in members}
         pos_of = {n: i for i, (n, _) in enumerate(members)}

@@ -107,7 +107,7 @@ def shard_report(rt) -> Optional[Dict[str, Any]]:
                           "state_bytes": by.get(d, 0),
                           "status": status}
     skew = (max(ev.values()) / mean) if total and mean else None
-    return {
+    report: Dict[str, Any] = {
         "devices": n,
         "layout": "round_robin(slot % n_shards)",
         "balanced": all(s["status"] == "ok" for s in shards.values()),
@@ -115,6 +115,26 @@ def shard_report(rt) -> Optional[Dict[str, Any]]:
             round(skew, 3) if skew is not None else None,
         "per_shard": shards,
     }
+    # serving emission rings (serving/ring.py): ring slots carry the
+    # producing step's sharding with a replicated slot axis, so each
+    # device hosts its own segment of every buffered output — report the
+    # per-shard resident bytes next to occupancy so operators can see
+    # drain lag per device
+    rings = {}
+    for q, ring in (rt.serve_rings().items()
+                    if hasattr(rt, "serve_rings") else ()):
+        try:
+            rings[q] = {
+                "occupancy": ring.occupancy(),
+                "capacity": ring.capacity,
+                "shard_bytes": sum(tree_shard_bytes(s)
+                                   for s in ring.state_leaves()),
+            }
+        except Exception:  # noqa: BLE001 — metrics must not throw
+            continue
+    if rings:
+        report["serve_rings"] = rings
+    return report
 
 
 def hlo_collectives(compiled) -> List[str]:

@@ -1,0 +1,386 @@
+"""Device-resident serving loop (siddhi_tpu/serving): on-device emission
+rings + async drain.  The contract under test: @serve changes WHEN the
+D2H fetch happens (drainer thread, never the send path), and nothing
+else — per-query outputs are byte-identical to the blocking fetch, in
+send order; quiesce drains rings to empty; overflow grows via the
+admission-gated replan pattern; a stalled drainer degrades, never dies.
+"""
+import threading
+import time
+
+import jax
+
+
+def _collect(rt, qname):
+    got = []
+
+    def cb(ts, cur, exp):
+        got.append((int(ts),
+                    [tuple(e.data) for e in (cur or [])],
+                    [tuple(e.data) for e in (exp or [])]))
+    rt.add_callback(qname, cb)
+    return got
+
+
+def _run(manager, ql, feeds, qname="q"):
+    """Run one playback app over `feeds` = [(stream, rows), ...] at
+    deterministic timestamps; return the collected (ts, current,
+    expired) tuples after a full flush."""
+    rt = manager.create_siddhi_app_runtime("@app:playback\n" + ql)
+    got = _collect(rt, qname)
+    rt.start()
+    handlers = {}
+    for i, (sid, rows) in enumerate(feeds):
+        h = handlers.get(sid) or rt.get_input_handler(sid)
+        handlers[sid] = h
+        h.send(rows, 1000 + 10 * i)
+    rt.flush()
+    rt.shutdown()
+    return got
+
+
+def _parity(manager, ql_plain, ql_serve, feeds, qname="q"):
+    base = _run(manager, ql_plain, feeds, qname)
+    served = _run(manager, ql_serve, feeds, qname)
+    assert served == base
+    assert base  # the shape must actually emit, or parity is vacuous
+
+
+# ---------------------------------------------------------------------------
+# byte-identical parity vs the blocking fetch
+# ---------------------------------------------------------------------------
+
+def test_serve_parity_filter(manager):
+    plain = """
+    define stream S (v int);
+    @info(name='q') from S[v > 2] select v * 10 as w insert into Out;
+    """
+    feeds = [("S", [v]) for v in range(8)]
+    _parity(manager, plain, plain.replace("@info", "@serve @info"), feeds)
+
+
+def test_serve_parity_window(manager):
+    plain = """
+    define stream S (v int);
+    @info(name='q') from S#window.length(4)
+    select sum(v) as t insert into Out;
+    """
+    feeds = [("S", [v]) for v in range(10)]
+    _parity(manager, plain, plain.replace("@info", "@serve @info"), feeds)
+
+
+def test_serve_parity_join(manager):
+    plain = """
+    define stream L (sym long, price float);
+    define stream R (sym long, qty int);
+    @emit(rows='256')
+    @info(name='q')
+    from L#window.length(8) join R#window.length(8)
+      on L.sym == R.sym
+    select L.sym as s, L.price as p, R.qty as v
+    insert into J;
+    """
+    feeds = []
+    for i in range(6):
+        feeds.append(("L", [i % 3, 1.5 * i]))
+        feeds.append(("R", [i % 3, i]))
+    _parity(manager, plain, plain.replace("@info", "@serve @info"), feeds)
+
+
+def test_serve_parity_pattern(manager):
+    plain = """
+    define stream S (price float, volume int);
+    @capacity(keys='1', slots='8')
+    @emit(rows='16')
+    @info(name='q')
+    from every e1=S[volume == 1] -> e2=S[volume == 2 and price >= e1.price]
+    select e1.price as p1, e2.price as p2
+    insert into M;
+    """
+    feeds = [("S", [float(i), 1 + i % 2]) for i in range(12)]
+    _parity(manager, plain, plain.replace("@info", "@serve @info"), feeds)
+
+
+def test_serve_parity_fuse(manager):
+    plain = """
+    define stream S (v int);
+    @fuse(batches='4')
+    @info(name='q') from S[v % 2 == 0] select v + 1 as w insert into Out;
+    """
+    feeds = [("S", [v]) for v in range(11)]
+    _parity(manager, plain, plain.replace("@info", "@serve @info"), feeds)
+
+
+def test_serve_parity_merged(manager):
+    plain = """
+    define stream S (v int);
+    @info(name='q') from S[v > 1] select v as a insert into OutA;
+    @info(name='q2') from S[v > 3] select v as b insert into OutB;
+    """
+    serve = plain.replace("@info", "@serve @info")
+    feeds = [("S", [v]) for v in range(8)]
+    # confirm the optimizer actually merged the served pair — otherwise
+    # this test silently degrades into a second filter-parity test
+    rt = manager.create_siddhi_app_runtime(serve)
+    merged = bool(getattr(rt, "merged_groups", {}))
+    rt.shutdown()
+    assert merged
+    for qname in ("q", "q2"):
+        _parity(manager, plain, serve, feeds, qname)
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: snapshot/quiesce, shutdown, send-path purity
+# ---------------------------------------------------------------------------
+
+def test_snapshot_quiesce_drains_ring(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @serve @info(name='q') from S select sum(v) as t insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([7])
+    blob = rt.snapshot()     # quiesce must drain the ring to empty
+    assert blob
+    assert [c for _, c, _ in got] == [[(7,)]]
+    ring = rt.query_runtimes["q"].__dict__.get("_serve_ring")
+    assert ring is not None and ring.occupancy() == 0
+    assert rt.serve_drainer_depth() == 0
+    rt.shutdown()
+
+
+def test_shutdown_delivers_pending(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @serve @info(name='q') from S select v * 2 as w insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in range(5):
+        h.send([v])
+    rt.shutdown()            # at-least-once: ring drains before sinks stop
+    assert [c[0][0] for _, c, _ in got] == [0, 2, 4, 6, 8]
+
+
+def test_send_path_never_fetches(manager, monkeypatch):
+    """The serving invariant: jax.device_get / block_until_ready are
+    banned on the producer thread — only the drainer may block on D2H."""
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @serve @info(name='q') from S select v + 1 as w insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    sender = threading.current_thread()
+    orig_get, orig_block = jax.device_get, jax.block_until_ready
+
+    def guard_get(x):
+        assert threading.current_thread() is not sender, \
+            "jax.device_get called in the send path"
+        return orig_get(x)
+
+    def guard_block(x):
+        assert threading.current_thread() is not sender, \
+            "jax.block_until_ready called in the send path"
+        return orig_block(x)
+
+    monkeypatch.setattr(jax, "device_get", guard_get)
+    monkeypatch.setattr(jax, "block_until_ready", guard_block)
+    h = rt.get_input_handler("S")
+    for v in range(20):
+        h.send([v])
+    monkeypatch.setattr(jax, "device_get", orig_get)
+    monkeypatch.setattr(jax, "block_until_ready", orig_block)
+    rt.flush()
+    assert [c[0][0] for _, c, _ in got] == list(range(1, 21))
+    rt.shutdown()
+
+
+def test_timer_queries_deliver_inline(manager):
+    """Same exclusion as @pipeline: time windows need the wake
+    scheduler, so @serve leaves their delivery inline — expiry fires
+    without a flush and the ring is never used."""
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @serve @info(name='q') from S#window.time(60 ms)
+    select v insert into Out;
+    """)
+    pairs = []
+    rt.add_callback("q", lambda ts, cur, exp: pairs.append(
+        ([e.data[0] for e in (cur or [])],
+         [e.data[0] for e in (exp or [])])))
+    rt.start()
+    rt.get_input_handler("S").send([5])
+    deadline = time.monotonic() + 5
+    while not any(exp for _, exp in pairs) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert any(exp == [5] for _, exp in pairs), pairs
+    # the ring was never used for this query
+    assert rt.query_runtimes["q"].__dict__.get("_serve_ring") is None
+    rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# overflow, backpressure, chaos
+# ---------------------------------------------------------------------------
+
+def test_ring_overflow_grows(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @serve(ring.capacity='2')
+    @info(name='q') from S select v as w insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("S")
+    h.send([0])              # first append creates + registers the ring
+    drainer = rt._serve_drainer
+    with drainer._deliver_lock:          # stall every drain cycle
+        for v in range(1, 8):
+            h.send([v])
+    rt.flush()
+    ring = rt.query_runtimes["q"].__dict__["_serve_ring"]
+    assert ring.grows_total >= 1
+    assert ring.capacity > 2
+    assert ring.occupancy() == 0
+    # growth preserved send order and dropped nothing
+    assert [c[0][0] for _, c, _ in got] == list(range(8))
+    rt.shutdown()
+
+
+def test_chaos_sink_kill_does_not_stop_drain(manager):
+    """A dying consumer must not kill the drainer: the failure routes to
+    the exception listener and later batches still deliver."""
+    boom = []
+    rt = manager.create_siddhi_app_runtime(
+        """
+        define stream S (v int);
+        @serve @info(name='q') from S select v as w insert into Out;
+        """,
+        )
+    rt.set_exception_listener(boom.append)
+    got = []
+
+    def cb(ts, cur, exp):
+        vals = [e.data[0] for e in (cur or [])]
+        if vals and vals[0] % 3 == 1:
+            raise RuntimeError(f"sink killed at {vals[0]}")
+        got.extend(vals)
+    rt.add_callback("q", cb)
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in range(9):
+        h.send([v])
+    rt.flush()
+    assert got == [v for v in range(9) if v % 3 != 1]
+    assert len(boom) == 3
+    assert rt._serve_drainer.alive()
+    # the app keeps serving after the faults
+    h.send([30])
+    rt.flush()
+    assert got[-1] == 30
+    rt.shutdown()
+
+
+def test_stalled_drainer_degrades_not_dead(manager):
+    from siddhi_tpu.observability.health import app_health
+    rt = manager.create_siddhi_app_runtime("""
+    define stream S (v int);
+    @serve @info(name='q') from S select v as w insert into Out;
+    """)
+    got = _collect(rt, "q")
+    rt.start()
+    h = rt.get_input_handler("S")
+    sd = rt._serve_drainer
+    with sd._deliver_lock:               # park every drain cycle
+        h.send([1])
+        h.send([2])
+        deadline = time.monotonic() + 5.0
+        while rt.serve_drainer_depth() == 0 and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert rt.serve_drainer_depth() > 0
+        sd.last_tick_ns -= int(60e9)     # pretend no tick for a minute
+        rep = app_health(rt)
+        assert rep["serving"]["drainer_stalled"]
+        assert rep["degraded"] and rep["live"]
+    rt.flush()
+    assert [c[0][0] for _, c, _ in got] == [1, 2]
+    rep = app_health(rt)
+    assert not rep["serving"]["drainer_stalled"]
+    rt.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# enablement surface
+# ---------------------------------------------------------------------------
+
+def test_serve_annotation_opt_out(manager):
+    rt = manager.create_siddhi_app_runtime("""
+    @app:serve
+    define stream S (v int);
+    @info(name='a') from S select v as w insert into OutA;
+    @serve(enabled='false')
+    @info(name='b') from S select v as w insert into OutB;
+    """)
+    assert rt.query_runtimes["a"].serve_emit
+    assert not rt.query_runtimes["b"].serve_emit
+    rt.shutdown()
+
+
+def test_serving_enabled_config_property():
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.utils.config import InMemoryConfigManager
+    m = SiddhiManager()
+    try:
+        m.set_config_manager(InMemoryConfigManager(system_configs={
+            "serving.enabled": "true",
+            "serving.ring.capacity": "3",
+        }))
+        rt = m.create_siddhi_app_runtime("""
+        define stream S (v int);
+        @info(name='q') from S select v + 1 as w insert into Out;
+        """)
+        got = _collect(rt, "q")
+        rt.start()
+        assert rt.query_runtimes["q"].serve_emit
+        h = rt.get_input_handler("S")
+        for v in range(6):
+            h.send([v])
+        rt.flush()
+        assert [c[0][0] for _, c, _ in got] == [1, 2, 3, 4, 5, 6]
+        ring = rt.query_runtimes["q"].__dict__["_serve_ring"]
+        # sized by serving.ring.capacity=3 (doubling under load keeps
+        # the base visible: 3, 6, 12, ... — never the default 8)
+        assert ring.capacity % 3 == 0
+        rt.shutdown()
+    finally:
+        m.shutdown()
+
+
+def test_explain_and_metrics_surfaces(manager):
+    from siddhi_tpu.observability.explain import explain_query
+    rt = manager.create_siddhi_app_runtime("""
+    @app:name('srv')
+    @app:statistics(reporter='prometheus')
+    define stream S (v int);
+    @serve @info(name='q') from S[v > 0] select v as w insert into Out;
+    """)
+    _collect(rt, "q")    # no consumer => emission short-circuits
+    rt.start()
+    h = rt.get_input_handler("S")
+    for v in range(4):
+        h.send([v])
+    rt.flush()
+    node = explain_query(rt, "q", deep=False)["serving"]
+    assert node["enabled"] and node["active"]
+    assert node["ring"]["appends_total"] == 4
+    from siddhi_tpu.observability.exposition import render_prometheus
+    text = render_prometheus(manager.runtimes)
+    assert "siddhi_ring_occupancy" in text
+    assert "siddhi_ring_drains_total" in text
+    assert "siddhi_serve_drainer_queue_depth" in text
+    rt.shutdown()
