@@ -5,12 +5,12 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: test lint lint-apps lint-smoke dryrun bench metrics-smoke \
 	fuse-smoke explain-smoke chaos-smoke multichip-smoke soak-smoke \
 	admission-smoke audit audit-update audit-smoke docgen-check \
-	join-smoke mqo-smoke serve-smoke all
+	join-smoke mqo-smoke serve-smoke phase-smoke all
 
 all: lint lint-apps docgen-check audit test dryrun metrics-smoke \
 	fuse-smoke explain-smoke lint-smoke chaos-smoke multichip-smoke \
 	soak-smoke admission-smoke audit-smoke join-smoke mqo-smoke \
-	serve-smoke
+	serve-smoke phase-smoke
 
 # static gate on our own code: ruff (rule set in pyproject.toml) when
 # available, with compileall kept as the syntax floor for samples and
@@ -136,6 +136,16 @@ mqo-smoke:
 serve-smoke:
 	$(CPU_ENV) $(PY) samples/serve_smoke.py
 	$(CPU_ENV) $(PY) bench.py --mode serve_compare --quick
+
+# phase-level hot-path profiler in <60 s: all 8 taxonomy phases recorded
+# for a @serve query, cross-thread trace handoff (drain spans share the
+# dispatch trace id; /trace.json drain track + flow arrows), sampled
+# deep-mode overhead < 5%, and every surface (/metrics families,
+# phase_report, EXPLAIN phases) touching zero device state (README
+# "Phase profiling"); plus the quick per-phase budget A-B
+phase-smoke:
+	$(CPU_ENV) $(PY) samples/phase_smoke.py
+	$(CPU_ENV) $(PY) bench.py --mode phase_profile --quick --out /tmp/phases_quick.json
 
 # overload is decided, not discovered, in <30 s: an over-ceiling deploy
 # denied BEFORE any compile, exact shed accounting (offered == accepted

@@ -520,6 +520,178 @@ def run_serve_compare(k=8, B=1 << 11, n_batches=64, iters=20,
     return payload
 
 
+def _phase_manager(sample_every):
+    """SiddhiManager with the sampled deep-profiling mode armed
+    (profile.sample.every=N fences every Nth dispatch to split
+    dispatch_submit from device_compute — observability/phases.py)."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.utils.config import InMemoryConfigManager
+    manager = SiddhiManager()
+    manager.set_config_manager(InMemoryConfigManager(
+        {"profile.sample.every": str(sample_every)}))
+    return manager
+
+
+def _phase_flagship(serve, n_keys, n_sends, sample_every):
+    """Flagship pattern (blocking or @serve) with phase attribution on:
+    returns (events/sec, the query's phase_report node).  Warmup phases
+    are dropped (stats.reset after compile) so the table attributes the
+    steady state only."""
+    manager = _phase_manager(sample_every)
+    rt = manager.create_siddhi_app_runtime(QL_TEMPLATE.format(
+        async_ann="", pipe_ann="@serve" if serve else "",
+        n_keys=n_keys, slots=SLOTS))
+    rt.set_statistics_level("BASIC")
+    matches = [0]
+    rt.add_batch_callback(
+        "flagship",
+        lambda ts, b: matches.__setitem__(0, matches[0] + b["n_current"]))
+    rt.start()
+    h = rt.get_input_handler("TradeStream")
+    keys = np.repeat(np.arange(n_keys, dtype=np.int64), 4)
+    vol4 = np.tile(np.array([1, 2, 3, 4], np.int32), n_keys)
+    price4 = vol4.astype(np.float32)
+    clock = [1000]
+
+    def send():
+        clock[0] += 10
+        ts = clock[0] + np.tile(np.arange(4, dtype=np.int64), n_keys)
+        h.send_columns([keys, price4, vol4], timestamps=ts)
+
+    send()
+    rt.flush()
+    rt.stats.reset()
+    t0 = time.perf_counter()
+    for _ in range(n_sends):
+        send()
+    rt.flush()
+    dt = time.perf_counter() - t0
+    rep = rt.phase_report()
+    manager.shutdown()
+    eps = n_sends * 4 * n_keys / dt
+    return eps, rep["queries"].get("flagship", {})
+
+
+def _phase_flagship_sharded(n, keys, B, sweeps, sample_every):
+    """_mc_flagship with phase attribution on: same partitioned
+    @fuse(batches=4) pattern on an n-way mesh, returning the fused
+    group's / query's phase nodes alongside events/sec."""
+    manager = _phase_manager(sample_every)
+    rt = manager.create_siddhi_app_runtime(
+        MC_FLAGSHIP_QL.format(keys=keys), mesh=_mc_mesh(n))
+    rt.set_statistics_level("BASIC")
+    matches = [0]
+    rt.add_batch_callback(
+        "flagship",
+        lambda ts, b: matches.__setitem__(0, matches[0] + b["n_current"]))
+    rt.start()
+    h = rt.get_input_handler("TradeStream")
+    key_col = np.arange(keys, dtype=np.int64)
+    price = ((key_col % 7) + 1).astype(np.float32)
+    clock = [1000]
+
+    def cycle():
+        for stage in (1, 2, 3, 4):
+            vol = np.full(keys, stage, np.int32)
+            pr = price + stage
+            for lo in range(0, keys, B):
+                clock[0] += 10
+                h.send_columns(
+                    [key_col[lo:lo + B].copy(), pr[lo:lo + B].copy(),
+                     vol[lo:lo + B].copy()],
+                    timestamps=np.full(min(B, keys - lo), clock[0],
+                                       np.int64))
+        rt.flush()
+
+    cycle()
+    rt.stats.reset()
+    t0 = time.perf_counter()
+    for _ in range(sweeps):
+        cycle()
+    dt = time.perf_counter() - t0
+    rep = rt.phase_report()
+    manager.shutdown()
+    return sweeps * keys * 4 / dt, rep["queries"]
+
+
+def run_phase_profile(quick=False, out_path=None, sample_every=16):
+    """--mode phase_profile: where the wall time actually goes.
+
+    Three tables from the always-on phase profiler + sampled deep mode
+    (observability/phases.py), all host clocks:
+      1. flagship blocking — every emission fetch on the send path,
+      2. flagship @serve — device ring + async drain pays the fetch,
+      3. sharded flagship at 1/2/4/8 virtual devices.
+    Each table is per-phase {seconds, count, share-of-e2e}; `accounted`
+    is sum(phases)/e2e (the remainder is `other`).  The blocking-vs-
+    @serve pair shows the d2h_drain share MOVING off the send path —
+    the phase-level proof of the serving loop's design claim."""
+    import os
+
+    import jax
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8")
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < 8:
+        try:
+            jax.clear_backends()
+        except Exception:  # noqa: BLE001 — asserted below
+            pass
+    assert len(jax.devices()) >= 8, "need 8 virtual devices " \
+        "(XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+
+    if quick:
+        n_keys, n_sends = 256, 12
+        sh_keys, sh_b, sweeps = 512, 256, 2
+    else:
+        n_keys, n_sends = 1 << 13, 32
+        sh_keys, sh_b, sweeps = 1 << 13, 1 << 11, 3
+
+    flagship = {}
+    for tag, serve in (("blocking", False), ("served", True)):
+        eps, node = _phase_flagship(serve, n_keys, n_sends, sample_every)
+        flagship[tag] = {"events_per_sec": round(eps), **node}
+        print(f"phase_profile[flagship/{tag}]: {eps:,.0f} ev/s "
+              f"accounted={node.get('accounted')}", file=sys.stderr)
+
+    sharded = {}
+    for n in (1, 2, 4, 8):
+        eps, queries = _phase_flagship_sharded(
+            n, sh_keys, sh_b, sweeps, sample_every)
+        sharded[str(n)] = {"events_per_sec": round(eps),
+                           "queries": queries}
+        acc = {q: v.get("accounted") for q, v in queries.items()}
+        print(f"phase_profile[sharded@{n}]: {eps:,.0f} ev/s "
+              f"accounted={acc}", file=sys.stderr)
+
+    payload = {
+        "mode": "phase_profile",
+        "sample_every": sample_every,
+        "quick": quick,
+        "phases": "stage_host h2d dispatch_submit device_compute "
+                  "ring_wait d2h_drain demux sink".split(),
+        "flagship": flagship,
+        "sharded_flagship": sharded,
+        "note": (
+            "per-(query, phase) wall seconds from host clocks only "
+            "(observability/phases.py); device_compute comes from the "
+            "sampled deep mode fencing every Nth dispatch, so its "
+            "count < dispatch count by design.  share = phase/e2e; "
+            "`accounted` = sum(phases)/e2e, remainder `other` "
+            "(scheduler/queue wait).  blocking vs served shows "
+            "d2h_drain leaving the send path for the drainer thread."),
+    }
+    print(json.dumps({k: v for k, v in payload.items() if k != "note"}))
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+            fh.write("\n")
+        print(f"wrote {out_path}", file=sys.stderr)
+    return payload
+
+
 def run_join_compare(B=1 << 10, n_batches=8, out_path=None):
     """--mode join_compare: the windowed_join corpus shape with the
     equi-join fast path ON vs OFF (full [R,C] grid), plus the
@@ -1786,7 +1958,7 @@ if __name__ == "__main__":
                     choices=["full", "device_loop", "fuse_compare",
                              "cost_analysis", "multichip", "soak",
                              "join_compare", "mqo_compare",
-                             "serve_compare"],
+                             "serve_compare", "phase_profile"],
                     help="full: the flagship suite (default); "
                          "device_loop: tunnel-independent chip-side "
                          "events/sec via fused dispatch re-execution; "
@@ -1807,7 +1979,11 @@ if __name__ == "__main__":
                          "count + aggregate ev/s A/B (MQO artifact); "
                          "serve_compare: blocking emission fetch vs "
                          "@serve device ring + async drain, plus the "
-                         "device_loop ceiling gap (SERVE artifact)")
+                         "device_loop ceiling gap (SERVE artifact); "
+                         "phase_profile: per-phase wall-time tables "
+                         "for flagship blocking vs @serve and sharded "
+                         "1/2/4/8 from the always-on phase profiler "
+                         "(PHASES artifact)")
     ap.add_argument("--k", type=int, default=16,
                     help="fused stack depth (device_loop/fuse_compare)")
     ap.add_argument("--batch", type=int, default=1 << 11,
@@ -1866,6 +2042,10 @@ if __name__ == "__main__":
                           n_batches=8 if args.quick else 64,
                           iters=5 if args.quick else 20,
                           out_path=args.out)
+    elif args.mode == "phase_profile":
+        _enable_compile_cache()
+        run_phase_profile(quick=args.quick,
+                          out_path=args.out or "PHASES_r14.json")
     elif args.mode == "multichip":
         _enable_compile_cache()
         run_multichip(quick=args.quick, out_path=args.out)

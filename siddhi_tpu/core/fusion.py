@@ -292,6 +292,7 @@ def _now_stack(items) -> jax.Array:
 
 
 def _dispatch_plain(qr, items) -> None:
+    from . import runtime as _rt
     p = qr.planned
     prep = [qr._slots_for_batch(staged, now) for staged, now in items]
     stack = ev.StackedBatch([staged for staged, _ in items])
@@ -304,7 +305,9 @@ def _dispatch_plain(qr, items) -> None:
           _now_stack(items), pslots_k)
     const = qr.app.in_probe_tables(p.in_deps)
     fn = _fused_fn(qr, "plain", p.raw_step)
-    qr.state, outs = fn(qr.state, xs, const)
+    _st, outs = _rt._step_phase(
+        qr, lambda: fn(qr.state, xs, const), mult=len(items))
+    _rt._rebind_state(qr, _st, mult=len(items))
     _deliver_fused(qr, outs, [now for _, now in items])
 
 
@@ -336,10 +339,13 @@ def _prepare_pattern(qr, items) -> Tuple[Callable, Tuple, Tuple]:
 
 
 def _dispatch_pattern(qr, items) -> None:
+    from . import runtime as _rt
     if getattr(qr.planned, "mesh", None) is not None:
         return _dispatch_pattern_sharded(qr, items)
     fn, xs, const = _prepare_pattern(qr, items)
-    qr.state, outs = fn(qr.state, xs, const)
+    _st, outs = _rt._step_phase(
+        qr, lambda: fn(qr.state, xs, const), mult=len(items))
+    _rt._rebind_state(qr, _st, mult=len(items))
     _deliver_fused(qr, outs, [now for _, _, now in items])
 
 
@@ -371,8 +377,11 @@ def _dispatch_pattern_sharded(qr, items) -> None:
           jnp.asarray(sel_k.reshape(k, n * Kb, E)),
           jnp.asarray(key_k.reshape(k, n * Kb)),
           _now_stack(items))
+    from . import runtime as _rt
     fn = p.shard_fused_steps[stream_id]
-    qr.state, outs = fn(qr.state, xs, qr._in_tabs())
+    _st, outs = _rt._step_phase(
+        qr, lambda: fn(qr.state, xs, qr._in_tabs()), mult=len(items))
+    _rt._rebind_state(qr, _st, mult=len(items))
     _deliver_fused(qr, outs, [now for _, _, now in items])
 
 
@@ -411,7 +420,10 @@ def _dispatch_join(qr, items) -> None:
     # live in the carry and stay exact)
     const = qr._other_table(is_left)
     fn = _fused_fn(qr, "join", body)
-    qr.state, outs = fn(qr.state, tuple(xs), const)
+    from . import runtime as _rt
+    _st, outs = _rt._step_phase(
+        qr, lambda: fn(qr.state, tuple(xs), const), mult=len(items))
+    _rt._rebind_state(qr, _st, mult=len(items))
     _deliver_fused(qr, outs, [now for _, _, now in items])
 
 
@@ -437,7 +449,11 @@ def _dispatch_merged(qr, items) -> None:
     xs = (batch.ts, batch.kind, batch.valid, batch.cols, gslots_k,
           _now_stack(items), pslots_k)
     fn = _fused_fn(qr, "merged", qr.raw_body)
-    qr._state, outs = fn(qr._state, xs, qr._in_tabs())
+    _st, outs = _rt._step_phase(
+        qr, lambda: fn(qr._state, xs, qr._in_tabs()),
+        name=f"merged:{qr.group}", mult=len(items))
+    _rt._rebind_state(qr, _st, mult=len(items),
+                      name=f"merged:{qr.group}", attr="_state")
     if stats.enabled:
         stats.counter_inc(f"merged.{qr.group}.dispatches")
         stats.counter_inc(f"merged.{qr.group}.member_batches",
@@ -455,7 +471,11 @@ def _dispatch_merged(qr, items) -> None:
     if consumers and not deferred:
         # ONE fetch for every consumed member's whole [K, ...] block;
         # per-batch views below are then numpy slices
+        tf = time.perf_counter_ns()
         host = jax.device_get([outs[i] for i in consumers])
+        if stats.enabled:
+            stats.phases.add(f"merged:{qr.group}", "d2h_drain",
+                             time.perf_counter_ns() - tf)
         outs = list(outs)
         for i, h in zip(consumers, host):
             outs[i] = h
@@ -510,10 +530,15 @@ def _deliver_fused(qr, outs, nows: List[int]) -> None:
         qr.__dict__["_ingest_ns"] = None
         return
     first_exc = None
+    _st = qr.app.stats
     if len(outs) == 6:
         # ONE fetch for the combined [K, 2] header (join headers are
         # [K, 2] vectors themselves; still one fetch)
+        tf = time.perf_counter_ns()
         h0, h1 = jax.device_get((outs[0], outs[1]))
+        if _st.enabled:
+            _st.phases.add(qr.name, "d2h_drain",
+                           time.perf_counter_ns() - tf)
         need_rows = bool(qr.callbacks) or \
             getattr(qr, "table_op", None) is not None or \
             getattr(qr, "rate_limiter", None) is not None or \
@@ -530,7 +555,11 @@ def _deliver_fused(qr, outs, nows: List[int]) -> None:
                 j = app.junctions.get(tgt)
                 need_rows = j is not None and bool(
                     j.queries or j.stream_callbacks or app.stats.enabled)
+        tf = time.perf_counter_ns()
         bulk = jax.device_get(outs[2:]) if need_rows else outs[2:]
+        if need_rows and _st.enabled:
+            _st.phases.add(qr.name, "d2h_drain",
+                           time.perf_counter_ns() - tf)
         for i in range(K):
             out_i = (h0[i], h1[i], bulk[0][i], bulk[1][i], bulk[2][i],
                      tuple(c[i] for c in bulk[3]))
@@ -543,7 +572,11 @@ def _deliver_fused(qr, outs, nows: List[int]) -> None:
     else:
         # plain outputs are window-capacity bounded and always ship
         # whole on the sequential path too: ONE fetch for the block
+        tf = time.perf_counter_ns()
         ots, okind, ovalid, ocols = jax.device_get(outs)
+        if _st.enabled:
+            _st.phases.add(qr.name, "d2h_drain",
+                           time.perf_counter_ns() - tf)
         for i in range(K):
             out_i = (ots[i], okind[i], ovalid[i],
                      tuple(c[i] for c in ocols))

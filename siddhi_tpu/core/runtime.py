@@ -25,6 +25,7 @@ import numpy as np
 from ..exceptions import (CannotRestoreStateError, DefinitionNotExistError,
                           MatchOverflowError, QueryNotExistError)
 from ..observability import tracing as _tracing
+from ..observability import phases as _phases
 from ..query_api.app import SiddhiApp
 from ..query_api.definition import StreamDefinition
 from ..query_api.query import Partition, Query, SingleInputStream
@@ -62,6 +63,57 @@ def _sub_name(sub, default: str) -> str:
     """Metric name of a junction subscriber (wrappers hold the runtime in
     _qr; plain runtimes carry .name)."""
     return getattr(getattr(sub, "_qr", sub), "name", default)
+
+
+def _step_phase(qr, fn, name=None, mult=1):
+    """Run one jitted step call, recording its wall as the
+    `dispatch_submit` phase (async dispatch: the call returns at SUBMIT,
+    so this wall says nothing about device time).  Every
+    `profile.sample.every` dispatches per query the deep mode fences the
+    returned pytree with `block_until_ready` and records the fence wall
+    as `device_compute` — the only block the profiler ever takes, and
+    never on the steady (unsampled) path.  `mult` is the number of
+    source batches one dispatch serves (a @fuse stack of K): each of the
+    K batches' `<q>:e2e` sample contains this full wall, so the phase
+    charges it K times to keep sum(phases) tracking sum(e2e) — the
+    attribution rule documented in observability/phases.py."""
+    st = qr.app.stats
+    if not st.enabled:
+        return fn()
+    qname = name or qr.name
+    ph = st.phases
+    t0 = time.perf_counter_ns()
+    res = fn()
+    t1 = time.perf_counter_ns()
+    ph.add(qname, "dispatch_submit", (t1 - t0) * mult)
+    every = _phases.sample_every(qr.app)
+    if every and ph.should_sample(qname, every):
+        jax.block_until_ready(res)
+        ph.add(qname, "device_compute",
+               (time.perf_counter_ns() - t1) * mult)
+    return res
+
+
+def _rebind_state(qr, v, mult=1, name=None, attr="state"):
+    """Rebind a query's device state to the step's returned pytree,
+    timing the rebind as `device_compute`.  Under async dispatch this
+    plain assignment is where the device wall surfaces on the host:
+    dropping the previous generation's buffers — live inputs of the
+    step still executing — blocks in the XLA client until that step
+    retires them.  No fence or fetch is added; the wait is inherent to
+    the rebind, so always-on mode stays zero-sync while still
+    accounting the compute wall each batch's e2e sample contains.
+    (When the sampled deep mode fenced this dispatch the buffers are
+    already retired and this records ~0 — the two never double-count.)
+    `mult`: batches served by one fused dispatch, as in _step_phase."""
+    st = qr.app.stats
+    if not st.enabled:
+        setattr(qr, attr, v)
+        return
+    t0 = time.perf_counter_ns()
+    setattr(qr, attr, v)
+    st.phases.add(name or qr.name, "device_compute",
+                  (time.perf_counter_ns() - t0) * mult)
 
 
 def current_millis() -> int:
@@ -360,11 +412,12 @@ class QueryRuntime(_MeshResolved):
         batch = staged.to_device(p.in_schema)
         in_tabs = self.app.in_probe_tables(p.in_deps)
         with _maybe_span("step", query=self.name, kind="window"):
-            self.state, out, wake = p.step(
+            _st, out, wake = _step_phase(self, lambda: p.step(
                 self.state, batch.ts, batch.kind, batch.valid, batch.cols,
                 jax.numpy.asarray(gslot),
                 jax.numpy.asarray(now, jax.numpy.int64),
-                in_tabs, pslots)
+                in_tabs, pslots))
+        _rebind_state(self, _st)
         # the device-computed wake scalar rides the emission fetch (a sync
         # int(wake) here would stall the send path one tunnel RTT per batch)
         wake_arg = None
@@ -416,11 +469,12 @@ class QueryRuntime(_MeshResolved):
                                staged.n).to_device(p.in_schema)
         in_tabs = self.app.in_probe_tables(p.in_deps)
         with _maybe_span("step", query=self.name, kind="keyed-window"):
-            self.state, out, wake = p.step(
+            _st, out, wake = _step_phase(self, lambda: p.step(
                 self.state, batch.ts, batch.kind, batch.valid, batch.cols,
                 jax.numpy.asarray(gslot), jax.numpy.asarray(key_idx),
                 jax.numpy.asarray(sel),
-                jax.numpy.asarray(now, jax.numpy.int64), in_tabs)
+                jax.numpy.asarray(now, jax.numpy.int64), in_tabs))
+        _rebind_state(self, _st)
         wake_arg = None
         if p.needs_timer:
             if getattr(p.window, "host_scheduled", False):
@@ -571,6 +625,11 @@ class PatternQueryRuntime(_MeshResolved):
         if self.shard_router is not None:
             self._process_sharded(stream_id, staged, now)
             return
+        # host prep wall (uploads, ts-wire fit check, key->slot routing)
+        # charges to stage_host right before the step — without it the
+        # pattern path's per-batch routing work lands in `other` and the
+        # flagship phase budget can't account its e2e (phases.py)
+        _prep0 = time.perf_counter_ns() if self.app.stats.enabled else None
         raw_cols = tuple(jax.numpy.asarray(c) for c in staged.cols)
         # ts-delta wire: ship (base scalar, i32 delta) instead of a fresh
         # i64 column when the batch's span fits i32 (PERF.md lever 1);
@@ -625,17 +684,22 @@ class PatternQueryRuntime(_MeshResolved):
                 key_lo = jax.numpy.asarray(int(key_idx_np[0]),
                                            jax.numpy.int32)
                 now_d = jax.numpy.asarray(now, jax.numpy.int64)
+                if _prep0 is not None:
+                    self.app.stats.phases.add(
+                        self.name, "stage_host",
+                        time.perf_counter_ns() - _prep0)
                 if ts_wire is not None:
-                    pstate, sel_state, out, wake = \
-                        p.dense_steps_w[stream_id](
+                    pstate, sel_state, out, wake = _step_phase(
+                        self, lambda: p.dense_steps_w[stream_id](
                             pstate, sel_state, raw_cols, ts_wire[0],
                             ts_wire[1], sel_d, key_lo, now_d,
-                            self._in_tabs())
+                            self._in_tabs()))
                 else:
-                    pstate, sel_state, out, wake = p.dense_steps[stream_id](
-                        pstate, sel_state, raw_cols, raw_ts, sel_d,
-                        key_lo, now_d, self._in_tabs())
-                self.state = (pstate, sel_state)
+                    pstate, sel_state, out, wake = _step_phase(
+                        self, lambda: p.dense_steps[stream_id](
+                            pstate, sel_state, raw_cols, raw_ts, sel_d,
+                            key_lo, now_d, self._in_tabs()))
+                _rebind_state(self, (pstate, sel_state))
                 _emit_output(self, out, now, wake=self._wake_arg(wake))
                 return
             key_idx = jax.numpy.asarray(key_idx_np)
@@ -652,16 +716,22 @@ class PatternQueryRuntime(_MeshResolved):
             key_idx = jax.numpy.asarray(np.zeros((1,), np.int32))
         pstate, sel_state = self.state
         now_d = jax.numpy.asarray(now, jax.numpy.int64)
+        if _prep0 is not None:
+            self.app.stats.phases.add(self.name, "stage_host",
+                                      time.perf_counter_ns() - _prep0)
         with _maybe_span("step", query=self.name, kind="pattern"):
             if ts_wire is not None:
-                pstate, sel_state, out, wake = p.steps_w[stream_id](
-                    pstate, sel_state, raw_cols, ts_wire[0], ts_wire[1],
-                    sel_d, key_idx, now_d, self._in_tabs())
+                pstate, sel_state, out, wake = _step_phase(
+                    self, lambda: p.steps_w[stream_id](
+                        pstate, sel_state, raw_cols, ts_wire[0],
+                        ts_wire[1], sel_d, key_idx, now_d,
+                        self._in_tabs()))
             else:
-                pstate, sel_state, out, wake = p.steps[stream_id](
-                    pstate, sel_state, raw_cols, raw_ts, sel_d, key_idx,
-                    now_d, self._in_tabs())
-        self.state = (pstate, sel_state)
+                pstate, sel_state, out, wake = _step_phase(
+                    self, lambda: p.steps[stream_id](
+                        pstate, sel_state, raw_cols, raw_ts, sel_d,
+                        key_idx, now_d, self._in_tabs()))
+        _rebind_state(self, (pstate, sel_state))
         _emit_output(self, out, now, wake=self._wake_arg(wake))
 
     def _shard_prep(self, stream_id: str, staged: ev.StagedBatch,
@@ -681,6 +751,7 @@ class PatternQueryRuntime(_MeshResolved):
             pos = p.partition_positions[stream_id]
             key_cols = [staged.cols[i] for i in pos]
             valid = staged.valid
+        t0 = time.perf_counter_ns()
         slots = self.slot_allocator.slots_for(key_cols, valid)
         if self._touch is not None:
             self._touch(slots, now)
@@ -690,9 +761,24 @@ class PatternQueryRuntime(_MeshResolved):
                 # global state column of slot s under the shard layout
                 self._dirty[router.state_row(live)] = True
         key_idx, sel, counts = router.group(slots, staged.valid)
+        t1 = time.perf_counter_ns()
         stats = self.app.stats
         if stats.enabled:
             stats.shard_events(self.name, counts)
+            # the [n, Kb, E] regroup is host staging work: it belongs to
+            # the stage_host phase even though it runs post-publish
+            stats.phases.add(self.name, "stage_host", t1 - t0)
+            tr = _tracing.active()
+            if tr is not None:
+                # per-shard sub-spans over the regroup wall: the even
+                # time split is nominal, but the per-shard event counts
+                # are real — trace viewers read the skew off the meta
+                n_sh = max(1, len(counts))
+                for d, c in enumerate(counts):
+                    tr.add_span(
+                        f"shard{d}", t0 + (t1 - t0) * d // n_sh,
+                        t0 + (t1 - t0) * (d + 1) // n_sh,
+                        {"query": self.name, "events": int(c)})
         return key_idx, sel
 
     def _process_sharded(self, stream_id: str, staged: ev.StagedBatch,
@@ -704,14 +790,16 @@ class PatternQueryRuntime(_MeshResolved):
         flat = lambda a: a.reshape((-1,) + a.shape[2:])   # noqa: E731
         pstate, sel_state = self.state
         with _maybe_span("step", query=self.name, kind="sharded-pattern"):
-            pstate, sel_state, out, wake = p.steps[stream_id](
-                pstate, sel_state,
-                tuple(jax.numpy.asarray(c) for c in staged.cols),
-                jax.numpy.asarray(staged.ts),
-                jax.numpy.asarray(flat(sel)),
-                jax.numpy.asarray(flat(key_idx)),
-                jax.numpy.asarray(now, jax.numpy.int64), self._in_tabs())
-        self.state = (pstate, sel_state)
+            pstate, sel_state, out, wake = _step_phase(
+                self, lambda: p.steps[stream_id](
+                    pstate, sel_state,
+                    tuple(jax.numpy.asarray(c) for c in staged.cols),
+                    jax.numpy.asarray(staged.ts),
+                    jax.numpy.asarray(flat(sel)),
+                    jax.numpy.asarray(flat(key_idx)),
+                    jax.numpy.asarray(now, jax.numpy.int64),
+                    self._in_tabs()))
+        _rebind_state(self, (pstate, sel_state))
         _emit_output(self, out, now, wake=self._wake_arg(wake))
 
     def on_timer(self, now: int) -> None:
@@ -792,10 +880,13 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
         # @pipeline: a deferred wake scalar would stall expiry), and
         # serving takes precedence over @async/@pipeline below.
         from ..serving import ring_append
-        ring_append(qr, out, now, ingest_ns)
+        # handoff(): arm + carry the dispatch thread's trace so the
+        # drainer's delivery spans join it (None when tracing is off)
+        ring_append(qr, out, now, ingest_ns, _tracing.handoff())
         return
     if getattr(qr, "async_emit", False) and qr.app._drainer is not None:
-        qr.app._drainer.enqueue(qr, out, now, wake, ingest_ns)
+        qr.app._drainer.enqueue(qr, out, now, wake, ingest_ns,
+                                _tracing.handoff())
         return
     depth = int(getattr(qr, "pipeline_emit", 0) or 0)
     if depth and wake is None and \
@@ -807,7 +898,7 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
         dq = getattr(qr, "_pending_emit", None)
         if dq is None:
             dq = qr._pending_emit = collections.deque()
-        dq.append((out, now, None, ingest_ns))
+        dq.append((out, now, None, ingest_ns, _tracing.handoff()))
         if len(dq) > depth:
             if depth == 1:
                 # exactly-one-deep contract: each send delivers its
@@ -828,16 +919,25 @@ def _emit_output(qr, out, now: int, wake=None) -> None:
     _deliver_output(qr, out, now, wake)
 
 
-def _deliver_output(qr, out, now: int, wake, ingest_ns=None) -> None:
-    """Blocking device->host fetch + delivery of one emission."""
+def _deliver_output(qr, out, now: int, wake, ingest_ns=None,
+                    trace=None) -> None:
+    """Blocking device->host fetch + delivery of one emission.  `trace`
+    is a handed-off BatchTrace for deferred (@pipeline) deliveries whose
+    originating dispatch has moved on — delivery spans adopt it."""
+    t0 = time.perf_counter_ns()
     if len(out) == 6:
         header, wake_h = jax.device_get(((out[0], out[1]), wake))
     else:
         out, wake_h = jax.device_get((out, wake))
         header = None
+    st = qr.app.stats
+    if st.enabled:
+        st.phases.add(qr.name, "d2h_drain",
+                      time.perf_counter_ns() - t0)
     if wake_h is not None:
         qr._apply_wake(int(wake_h))
-    _emit_output_sync(qr, out, now, header=header, ingest_ns=ingest_ns)
+    with _tracing.adopt(trace):
+        _emit_output_sync(qr, out, now, header=header, ingest_ns=ingest_ns)
 
 
 def _deliver_many(qr, items) -> None:
@@ -846,14 +946,28 @@ def _deliver_many(qr, items) -> None:
     if len(items) == 1:
         _deliver_output(qr, *items[0])
         return
+    t0 = time.perf_counter_ns()
     fetched = jax.device_get([
         (out[0], out[1]) if len(out) == 6 else out
-        for out, _, _, _ in items])
-    for (out, now, _, t_in), fetch_h in zip(items, fetched):
-        if len(out) == 6:
-            _emit_output_sync(qr, out, now, header=fetch_h, ingest_ns=t_in)
-        else:
-            _emit_output_sync(qr, fetch_h, now, ingest_ns=t_in)
+        for out, _, _, _, _ in items])
+    fetch_ns = time.perf_counter_ns() - t0
+    st = qr.app.stats
+    loop_t0 = time.perf_counter_ns()
+    for (out, now, _, t_in, trace), fetch_h in zip(items, fetched):
+        if st.enabled:
+            # latency attribution: the batched fetch wall charges to
+            # every item it served, and the serialized wait behind
+            # predecessors' deliveries is queue residency — both are
+            # inside each item's e2e sample (see phases.py)
+            st.phases.add(qr.name, "d2h_drain", fetch_ns)
+            st.phases.add(qr.name, "ring_wait",
+                          time.perf_counter_ns() - loop_t0)
+        with _tracing.adopt(trace):
+            if len(out) == 6:
+                _emit_output_sync(qr, out, now, header=fetch_h,
+                                  ingest_ns=t_in)
+            else:
+                _emit_output_sync(qr, fetch_h, now, ingest_ns=t_in)
 
 
 def _drain_pending_emit(qr) -> None:
@@ -959,12 +1073,13 @@ class _LazyBatchPayload(dict):
 
 def _emit_output_sync(qr, out, now: int, header=None,
                       ingest_ns=None) -> None:
-    """Emission with an `emit` span when a DETAIL pipeline trace is active
-    on this thread (sync/pipeline deliveries; drainer-thread deliveries
-    fall outside the dispatch trace by design — see observability/
-    tracing.py).  `ingest_ns` (send-acceptance perf_counter_ns) closes the
-    `<query>:e2e` histogram here — after callbacks, downstream routing,
-    and the synchronous sink publish they trigger."""
+    """Emission with an `emit` span when a pipeline trace is active on
+    this thread — which now includes drainer threads: deferred deliveries
+    carry the dispatch side's handed-off trace and run under
+    `tracing.adopt`, so their spans (tagged track="drain") join the
+    originating trace.  `ingest_ns` (send-acceptance perf_counter_ns)
+    closes the `<query>:e2e` histogram here — after callbacks, downstream
+    routing, and the synchronous sink publish they trigger."""
     try:
         if _tracing.active() is None:
             return _emit_output_sync_impl(qr, out, now, header)
@@ -1025,10 +1140,19 @@ def _emit_output_sync_impl(qr, out, now: int, header=None) -> None:
                          qr.name, now)
     counts = None
     overflow_exc = None
+    # phase split of this delivery: device fetches paid here (`d2h_drain`),
+    # consumer-facing work (`sink`), and everything else — header decode,
+    # unpack, ts-order restore — as `demux`
+    _st = qr.app.stats
+    _ph_t0 = time.perf_counter_ns() if _st.enabled else None
+    _sink_ns = 0
+    _fetch_ns = 0
     if len(out) == 6:
         n_valid, n_dropped, ots, okind, ovalid, ocols = out
         if header is None:
+            _tf = time.perf_counter_ns()
             header = jax.device_get((n_valid, n_dropped))
+            _fetch_ns += time.perf_counter_ns() - _tf
         h0 = np.asarray(header[0])
         nd = int(header[1])
         if h0.ndim:
@@ -1097,8 +1221,10 @@ def _emit_output_sync_impl(qr, out, now: int, header=None) -> None:
             # callbacks, batch payloads, downstream routing, table writes)
             # observes the same id per row
             if len(out) == 6:
+                _tf = time.perf_counter_ns()
                 ots, okind, ovalid, ocols = jax.device_get(
                     (ots, okind, ovalid, ocols))
+                _fetch_ns += time.perf_counter_ns() - _tf
             changed = ev.materialize_uuid_sentinels(
                 p.out_schema, np.asarray(ovalid), ocols)
             if changed:
@@ -1109,8 +1235,10 @@ def _emit_output_sync_impl(qr, out, now: int, header=None) -> None:
         if qr.batch_callbacks:
             payload = _LazyBatchPayload(p.out_schema.names, ots, okind,
                                         ovalid, ocols, counts)
+            _ts = time.perf_counter_ns()
             for bcb in qr.batch_callbacks:
                 bcb(now, payload)
+            _sink_ns += time.perf_counter_ns() - _ts
         if not qr.callbacks and not target_live:
             return
         if len(out) == 6:
@@ -1118,8 +1246,10 @@ def _emit_output_sync_impl(qr, out, now: int, header=None) -> None:
             # fetch them now and restore timestamp order for event delivery
             # with a host-side stable sort of just the valid rows
             # (O(matches), runs on the drainer thread)
+            _tf = time.perf_counter_ns()
             ts_np, okind, ovalid_np, ocols = jax.device_get(
                 (ots, okind, ovalid, ocols))
+            _fetch_ns += time.perf_counter_ns() - _tf
             idxv = np.nonzero(ovalid_np)[0]
             order = idxv[np.argsort(ts_np[idxv], kind="stable")]
             ots = ts_np[order]
@@ -1132,18 +1262,32 @@ def _emit_output_sync_impl(qr, out, now: int, header=None) -> None:
         if not pairs:
             return
         if getattr(qr, "table_op", None) is not None:
+            _ts = time.perf_counter_ns()
             current = [e for k, e in pairs if k == ev.CURRENT]
             expired = [e for k, e in pairs if k == ev.EXPIRED]
             for cb in qr.callbacks:
                 cb(now, current or None, expired or None)
             _apply_table_op(qr, ots, okind, ovalid, ocols, now)
+            _sink_ns += time.perf_counter_ns() - _ts
             return
         limiter = getattr(qr, "rate_limiter", None)
         if limiter is not None:
+            _ts = time.perf_counter_ns()
             limiter.process(pairs, now)
+            _sink_ns += time.perf_counter_ns() - _ts
             return
+        _ts = time.perf_counter_ns()
         _deliver_pairs(qr, pairs, now)
+        _sink_ns += time.perf_counter_ns() - _ts
     finally:
+        if _ph_t0 is not None:
+            _ph = _st.phases
+            if _sink_ns:
+                _ph.add(qr.name, "sink", _sink_ns)
+            if _fetch_ns:
+                _ph.add(qr.name, "d2h_drain", _fetch_ns)
+            _ph.add(qr.name, "demux",
+                    time.perf_counter_ns() - _ph_t0 - _sink_ns - _fetch_ns)
         if overflow_exc is not None:
             raise overflow_exc
 
@@ -1467,7 +1611,9 @@ class JoinQueryRuntime(_MeshResolved):
         args += [self._other_table(is_left),
                  jax.numpy.asarray(now, jax.numpy.int64)]
         with _maybe_span("step", query=self.name, kind="join"):
-            self.state, out, wake = step(*args)
+            _st, out, wake = _step_phase(
+                self, lambda: step(*args))
+        _rebind_state(self, _st)
         _emit_output(self, out, now,
                      wake=wake if p.needs_timer else None)
 
@@ -1706,11 +1852,19 @@ class StreamJunction:
 
     def enqueue(self, tag: str, payload, now: int) -> None:
         q = self._async_q
+        stats = self.app.stats if self.app is not None else None
         if tag == "staged":
+            s0 = time.perf_counter_ns()
             self._serve_stage(payload)
+            if stats is not None and stats.enabled:
+                # @async accept-edge upload: the h2d wall is paid here,
+                # not in dispatch_staged's idempotent re-call
+                h2d_ns = time.perf_counter_ns() - s0
+                ph = stats.phases
+                for sub in self.queries:
+                    ph.add(_sub_name(sub, self.stream_id), "h2d", h2d_ns)
         # ingest stamp taken BEFORE the queue put: the `<query>:e2e`
         # histogram must include @async queue wait, not start at dispatch
-        stats = self.app.stats if self.app is not None else None
         t_in = time.perf_counter_ns() \
             if stats is not None and stats.enabled else None
         if q is None:          # raced with stop_async: process inline
@@ -1807,7 +1961,11 @@ class StreamJunction:
         `ingest_ns` (send-acceptance stamp) is stashed on the runtime
         UNDER the query lock so the emission path — however deferred
         (@pipeline deque, @fuse stack, @async drainer) — can close the
-        `<query>:e2e` histogram against the right batch."""
+        `<query>:e2e` histogram against the right batch.  The stamp must
+        land on the REAL runtime (wrappers hold it in _qr, same deref as
+        _sub_name/_sub_lock) — _emit_output reads it from the runtime the
+        emission belongs to, so stamping a _Sub/_JSub wrapper would
+        silently drop e2e for every pattern/join query."""
         lk = _sub_lock(q)
         if stats is None:
             if lk is not None:
@@ -1817,29 +1975,30 @@ class StreamJunction:
                 q.process_staged(staged, now)
             return
         qname = _sub_name(q, self.stream_id)
+        tgt = getattr(q, "_qr", None) or q
         t0 = time.perf_counter_ns()
         try:
             with (_tracing.span("query", query=qname) if traced
                   else _NULL_CM):
                 if lk is not None:
                     with _query_lock(lk, self.stream_id):
-                        q.__dict__["_ingest_ns"] = ingest_ns
+                        tgt.__dict__["_ingest_ns"] = ingest_ns
                         try:
                             q.process_staged(staged, now)
                         finally:
                             # cleared so a later timer-driven emission
                             # can't close e2e against this batch's stamp
-                            q.__dict__["_ingest_ns"] = None
+                            tgt.__dict__["_ingest_ns"] = None
                 else:
-                    q.__dict__["_ingest_ns"] = ingest_ns
+                    tgt.__dict__["_ingest_ns"] = ingest_ns
                     try:
                         q.process_staged(staged, now)
                     finally:
-                        q.__dict__["_ingest_ns"] = None
+                        tgt.__dict__["_ingest_ns"] = None
         finally:
             stats.query_latency(qname, n, time.perf_counter_ns() - t0)
             if ingest_ns is not None and \
-                    q.__dict__.pop("_e2e_owed", False):
+                    tgt.__dict__.pop("_e2e_owed", False):
                 # emission delivered inline during this dispatch: close
                 # `<query>:e2e` here, after the step AND delivery — the
                 # stamp predates t0, so e2e >= the step-latency sample
@@ -1851,7 +2010,9 @@ class StreamJunction:
         """Run every subscribed query over a staged batch, serialized per
         QUERY (not per app) so queries on different streams — or workers of
         different streams — process concurrently."""
+        s0 = time.perf_counter_ns()
         self._serve_stage(staged)   # idempotent (skips if prestaged)
+        s1 = time.perf_counter_ns()
         stats = self.app.stats if self.app is not None else None
         if stats is None or not stats.enabled:
             for q in self.queries:
@@ -1862,6 +2023,10 @@ class StreamJunction:
             return
         if ingest_ns is None:
             ingest_ns = time.perf_counter_ns()   # synchronous send path
+        if s1 > s0:
+            ph = stats.phases
+            for q in self.queries:
+                ph.add(_sub_name(q, self.stream_id), "h2d", s1 - s0)
         stats.stream_in(self.stream_id, staged.n)
         tr = stats.tracer.start(self.stream_id, staged.n) \
             if stats.detail else None
@@ -1914,10 +2079,20 @@ class StreamJunction:
             for cb in self.stream_callbacks:
                 cb(events)
             if self.queries:
+                s0 = time.perf_counter_ns()
                 with (_tracing.span("ingest", stream=self.stream_id)
                       if tr is not None else _NULL_CM):
                     staged = ev.pack_np(self.schema, events)
+                s1 = time.perf_counter_ns()
                 self._serve_stage(staged)
+                s2 = time.perf_counter_ns()
+                # per-query latency attribution (see phases.py): pack and
+                # upload walls charge to every subscriber, as their e2e does
+                ph = stats.phases
+                for q in self.queries:
+                    qn = _sub_name(q, self.stream_id)
+                    ph.add(qn, "stage_host", s1 - s0)
+                    ph.add(qn, "h2d", s2 - s1)
                 for q in self.queries:
                     try:
                         self._dispatch_one(q, staged, now, stats,
@@ -2212,7 +2387,8 @@ class _EmissionDrainer:
             self._started = True
             self._thread.start()
 
-    def enqueue(self, qr, out, now, wake=None, ingest_ns=None):
+    def enqueue(self, qr, out, now, wake=None, ingest_ns=None,
+                trace=None):
         self.start()
         # start the D2H copy of everything the drainer will fetch NOW
         # (non-blocking): by the time the drainer's device_get runs, the
@@ -2226,7 +2402,7 @@ class _EmissionDrainer:
                     fn()
                 except Exception:  # noqa: BLE001 — best-effort prefetch
                     pass
-        self._q.put((qr, out, now, wake, ingest_ns))
+        self._q.put((qr, out, now, wake, ingest_ns, trace))
 
     def flush(self):
         self._q.join()
@@ -2261,26 +2437,41 @@ class _EmissionDrainer:
             # one roundtrip for ALL queued outputs: pattern outs (len 6)
             # contribute only their 16-byte count header; plain outs are
             # window-capacity bounded and ship whole
+            t_fetch = time.perf_counter_ns()
             try:
                 fetched = jax.device_get([
                     ((out[0], out[1]), wake) if len(out) == 6
                     else (out, wake)
-                    for _, out, _, wake, _ in items])
+                    for _, out, _, wake, _, _ in items])
             except Exception:  # noqa: BLE001 — drainer must survive
                 traceback.print_exc()
                 fetched = [(None, None)] * len(items)
-            for (qr, out, now, _, t_in), (fetch_h, wake_h) in \
+            fetch_ns = time.perf_counter_ns() - t_fetch
+            loop_t0 = time.perf_counter_ns()
+            for (qr, out, now, _, t_in, trace), (fetch_h, wake_h) in \
                     zip(items, fetched):
                 try:
+                    st = qr.app.stats
+                    if st.enabled:
+                        # latency attribution: the batched fetch charges
+                        # to every item it served, and a later item's
+                        # serialized wait behind its predecessors'
+                        # deliveries counts as queue residency — both
+                        # inside its e2e sample (see phases.py)
+                        st.phases.add(qr.name, "d2h_drain", fetch_ns)
+                        st.phases.add(qr.name, "ring_wait",
+                                      time.perf_counter_ns() - loop_t0)
                     if wake_h is not None:
                         qr._apply_wake(int(wake_h))
                     if fetch_h is None:
                         continue
-                    if len(out) == 6:
-                        _emit_output_sync(qr, out, now, header=fetch_h,
-                                          ingest_ns=t_in)
-                    else:
-                        _emit_output_sync(qr, fetch_h, now, ingest_ns=t_in)
+                    with _tracing.adopt(trace):
+                        if len(out) == 6:
+                            _emit_output_sync(qr, out, now, header=fetch_h,
+                                              ingest_ns=t_in)
+                        else:
+                            _emit_output_sync(qr, fetch_h, now,
+                                              ingest_ns=t_in)
                 except Exception as exc:  # noqa: BLE001 — drainer survives
                     # route to the app error path (reference: the Disruptor
                     # ExceptionHandler) — MatchOverflowError and callback
@@ -3492,6 +3683,7 @@ class SiddhiAppRuntime:
         junction = self.junctions.get(stream_id)
         if junction is None:
             raise DefinitionNotExistError(f"undefined stream {stream_id!r}")
+        pack_t0 = time.perf_counter_ns()
         n = len(cols[0])
         cap = ev.bucket_size(max(n, 1))
         schema = junction.schema
@@ -3529,6 +3721,13 @@ class SiddhiAppRuntime:
             a[:n] = c
             padded.append(a)
         staged = ev.StagedBatch(ts, kind, valid, padded, n)
+        if self.stats.enabled and junction.queries:
+            # columnar pad/adopt staging: stage_host for every subscriber
+            # (pack_np-path sends get the same charge inside publish)
+            pack_ns = time.perf_counter_ns() - pack_t0
+            ph = self.stats.phases
+            for sub in junction.queries:
+                ph.add(_sub_name(sub, stream_id), "stage_host", pack_ns)
         if self.playback and n:
             with self._lock:   # vs the idle-advance thread's bump
                 self._playback_time = max(self._playback_time,
@@ -3691,6 +3890,14 @@ class SiddhiAppRuntime:
         """Recent DETAIL-level batch traces, newest first, optionally only
         those that touched `query` (see observability/tracing.py)."""
         return self.stats.tracer.dump(query, limit)
+
+    def phase_report(self) -> Dict:
+        """Per-query phase budget (seconds + share per pipeline phase)
+        against the `<query>:e2e` histogram, unattributed remainder as
+        `other` — see observability/phases.py.  Host-side reads only:
+        safe to call on a live app."""
+        from ..observability.phases import phase_report as _pr
+        return _pr(self)
 
     def explain(self, query_name: Optional[str] = None,
                 deep: bool = True) -> Dict:

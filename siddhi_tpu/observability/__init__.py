@@ -45,7 +45,17 @@ and the soak-telemetry layer (metrics over TIME, not just at scrape):
   shard-imbalance, recompile-rate) evaluated over those series each
   tick with ok/pending/firing hysteresis, surfaced as
   `siddhi_slo_state` in `/metrics` and an `slo` section in `/healthz`
-  (`slo.py`).
+  (`slo.py`),
+- **phase profiler**: always-on per-(app, query, phase) wall-time
+  counters over the canonical hot-path taxonomy (stage_host, h2d,
+  dispatch_submit, device_compute, ring_wait, d2h_drain, demux, sink)
+  from host clocks only, a sampled deep mode
+  (`profile.sample.every=N`) that fences every Nth dispatch to split
+  submit from device compute, and cross-thread trace handoff/adoption
+  so one pipeline trace spans ingest -> dispatch -> drain -> sink
+  (`phases.py`; surfaced as `siddhi_phase_seconds_total`,
+  `GET /siddhi-apps/<app>/phases`, EXPLAIN, and a drain track with
+  flow arrows in `/trace.json`).
 
 Everything is allocation-free on the hot path when statistics are OFF: each
 hook sits behind a single `enabled`/`active()` check, and every scrape/
@@ -54,7 +64,9 @@ probe path (`/metrics`, `/healthz`) reads host-side metadata only — no
 """
 from .histogram import LogHistogram                       # noqa: F401
 from .recompile import RECOMPILES, RecompileRegistry      # noqa: F401
-from .tracing import PipelineTracer, active, span         # noqa: F401
+from .tracing import (PipelineTracer, active, adopt,      # noqa: F401
+                      handoff, span)
+from .phases import PHASES, PhaseProfiler, phase_report   # noqa: F401
 from .exposition import render_prometheus                 # noqa: F401
 from .explain import explain_app, explain_query           # noqa: F401
 from .memory import component_bytes, total_bytes          # noqa: F401
@@ -67,7 +79,8 @@ from .slo import SLOEngine, SLORule, default_rules            # noqa: F401
 
 __all__ = [
     "LogHistogram", "PipelineTracer", "RECOMPILES", "RecompileRegistry",
-    "active", "span", "render_prometheus",
+    "active", "adopt", "handoff", "span", "render_prometheus",
+    "PHASES", "PhaseProfiler", "phase_report",
     "explain_app", "explain_query", "component_bytes", "total_bytes",
     "chrome_trace", "start_profiler", "stop_profiler", "profiler_status",
     "app_health", "healthz", "liveness", "readiness",

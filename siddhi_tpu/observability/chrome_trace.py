@@ -13,7 +13,12 @@ Layout: one Chrome *process* per app, one *thread* (track) per batch
 trace — a batch's spans nest by time on its own track, and slow batches
 stand out as long tracks.  Timestamps are the tracer's own
 `perf_counter_ns` values scaled to microseconds: monotonic process-wide,
-so tracks order correctly across batches.
+so tracks order correctly across batches.  Spans recorded under a
+cross-thread adoption (tracing.adopt — drainer deliveries tagged
+`track="drain"`) render on ONE shared per-app "drain" track, and each
+trace with drain-side spans gets a flow arrow (`ph:"s"`/`ph:"f"`,
+id = trace id) from its dispatch track to the delivery span, so Perfetto
+draws the handoff the serving loop actually performs.
 
 Also here: the guarded `jax.profiler` start/stop used by
 `POST /profiler/start|stop` for device-level deep dives (XLA ops, HBM) —
@@ -24,6 +29,10 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
+# drain tracks sit far above any realistic trace id so they never collide
+# with per-batch tids (trace ids are a process-global counter from 1)
+_DRAIN_TID_BASE = 1_000_000_000
+
 
 def trace_events(runtimes: Dict, query: Optional[str] = None,
                  limit: int = 256) -> List[Dict]:
@@ -32,6 +41,11 @@ def trace_events(runtimes: Dict, query: Optional[str] = None,
     for pid, (app_name, rt) in enumerate(sorted(runtimes.items()), 1):
         events.append({"ph": "M", "name": "process_name", "pid": pid,
                        "tid": 0, "args": {"name": f"siddhi:{app_name}"}})
+        # all drain-side (adopted) spans of an app share one track: the
+        # drainer really is one thread, and a shared track makes its
+        # serialised deliveries visually obvious
+        drain_tid = _DRAIN_TID_BASE + pid
+        drain_named = False
         for tr in rt.trace_dump(query, limit):
             tid = int(tr["trace_id"])
             spans = tr.get("spans", ())
@@ -49,15 +63,44 @@ def trace_events(runtimes: Dict, query: Optional[str] = None,
                 "ts": base_us, "dur": float(tr.get("total_us", 0.0)),
                 "args": {"events": tr.get("events"),
                          "trace_id": tr.get("trace_id")}})
+            first_drain_ts = None
+            last_dispatch_end = base_us
             for s in spans:
+                on_drain = s.get("track") == "drain"
+                ts = base_us + float(s.get("offset_us") or 0.0)
+                dur = float(s.get("duration_us", 0.0))
                 args = {k: v for k, v in s.items()
-                        if k not in ("stage", "duration_us", "offset_us")}
+                        if k not in ("stage", "duration_us", "offset_us",
+                                     "track")}
                 events.append({
                     "ph": "X", "name": s["stage"], "cat": "span",
-                    "pid": pid, "tid": tid,
-                    "ts": base_us + float(s.get("offset_us") or 0.0),
-                    "dur": float(s.get("duration_us", 0.0)),
-                    "args": args})
+                    "pid": pid, "tid": drain_tid if on_drain else tid,
+                    "ts": ts, "dur": dur, "args": args})
+                if on_drain:
+                    if first_drain_ts is None or ts < first_drain_ts:
+                        first_drain_ts = ts
+                else:
+                    last_dispatch_end = max(last_dispatch_end, ts + dur)
+            if first_drain_ts is None:
+                continue
+            # flow arrow: dispatch track -> drainer delivery.  The start
+            # binds at the last dispatch-side span (the emit/handoff) and
+            # the finish (bp:"e" = bind to enclosing slice) at the first
+            # adopted span, so Perfetto draws one arrow per batch.
+            if not drain_named:
+                drain_named = True
+                events.append({
+                    "ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": drain_tid, "args": {"name": "drain"}})
+            flow_id = int(tr["trace_id"])
+            events.append({
+                "ph": "s", "name": "handoff", "cat": "flow",
+                "id": flow_id, "pid": pid, "tid": tid,
+                "ts": min(last_dispatch_end, first_drain_ts)})
+            events.append({
+                "ph": "f", "bp": "e", "name": "handoff", "cat": "flow",
+                "id": flow_id, "pid": pid, "tid": drain_tid,
+                "ts": first_drain_ts})
     # a stable time order keeps the JSON loadable by strict parsers and
     # the tracks deterministic (metadata records lead, then global ts
     # order across all processes)

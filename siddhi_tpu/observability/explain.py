@@ -388,6 +388,23 @@ def _serving_node(rt, qr) -> Dict:
     return node
 
 
+def _phases_node(rt, qr) -> Dict:
+    """Live phase budget for this query (observability/phases.py): the
+    per-phase seconds/share entry from phase_report, or a hint to send
+    traffic when nothing has accumulated yet.  Host counters only."""
+    try:
+        rep = rt.phase_report()
+        node = rep.get("queries", {}).get(qr.name)
+        if node is None:
+            return {"available": False,
+                    "reason": "no phase samples yet — send traffic, "
+                              "then re-run explain"}
+        return {"available": True,
+                "sample_every": rep.get("sample_every", 0), **node}
+    except Exception:  # noqa: BLE001 — diagnostics must not throw
+        return {"available": False, "reason": "phase report failed"}
+
+
 def _tree_for(qr, kind: str) -> Dict:
     """Planned operator tree from the query AST + compiled plan facts."""
     from ..query_api.query import (JoinInputStream, SingleInputStream,
@@ -481,6 +498,7 @@ def explain_query(rt, query_name: str, deep: bool = True) -> Dict:
         "fusion": _fusion_node(qr, kind),
         "merge": _merge_node(qr),
         "serving": _serving_node(rt, qr),
+        "phases": _phases_node(rt, qr),
         **_sharding_entry(qr, kind, deep),
         "recompiles": RECOMPILES.snapshot(
             [query_name, f"fused:{query_name}"]),

@@ -208,6 +208,15 @@ def render_prometheus(runtimes: Dict) -> str:
     srv_dep = fam("siddhi_serve_drainer_queue_depth", "gauge",
                   "Ring entries awaiting the serving drainer across "
                   "all of an app's rings right now")
+    ph_sec = fam("siddhi_phase_seconds_total", "counter",
+                 "Accumulated wall seconds attributed to each pipeline "
+                 "phase per query (host clocks only — see "
+                 "observability/phases.py for the latency-attribution "
+                 "semantics)")
+    ph_smp = fam("siddhi_phase_dispatches_sampled_total", "counter",
+                 "Dispatches fenced with block_until_ready by the "
+                 "sampled deep profiling mode (profile.sample.every=N) "
+                 "to split submit wall from device compute, per query")
 
     for app_name, rt in sorted(runtimes.items()):
         st = rt.stats
@@ -264,6 +273,20 @@ def render_prometheus(runtimes: Dict) -> str:
             elif name.endswith(".ring_grows"):
                 ring_gr.sample(n, app=app_name,
                                query=name[:-len(".ring_grows")])
+        # phase profiler: host-clock ns accumulators, snapshot under the
+        # profiler's own lock — still zero device work on the scrape
+        ph_snap = snap.get("phases", {})
+        ph_sampled = ph_snap.get("sampled", {})
+        for q, phases in sorted(ph_snap.get("queries", {}).items()):
+            for p, v in phases.items():
+                ph_sec.sample(v["ns"] / 1e9, app=app_name, query=q,
+                              phase=p)
+            # emitted at 0 while deep mode is off so rate() works from
+            # the first scrape after profile.sample.every flips on
+            ph_smp.sample(ph_sampled.get(q, 0), app=app_name, query=q)
+        for q, n in sorted(ph_sampled.items()):
+            if q not in ph_snap.get("queries", {}):
+                ph_smp.sample(n, app=app_name, query=q)
         for gid, mg in sorted(getattr(rt, "merged_groups", {}).items()):
             mrg_q.sample(len(getattr(mg, "members", ())), app=app_name,
                          group=gid)

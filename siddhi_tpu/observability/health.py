@@ -255,6 +255,17 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         except Exception:  # noqa: BLE001 — probe must not throw
             serving = None
 
+    # phase budget (observability/phases.py): per-query share of e2e wall
+    # by pipeline phase — the profiler's counters are host-clock sums, so
+    # this keeps the probe's never-fetch invariant
+    phases = None
+    try:
+        ph = rt.phase_report()
+        if ph.get("queries"):
+            phases = ph
+    except Exception:  # noqa: BLE001 — probe must not throw
+        phases = None
+
     report = {
         "started": started,
         "accepting_ingress": accepting,
@@ -265,6 +276,7 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         "sinks": sinks,
         "degraded": degraded,
         **({"shards": shards} if shards is not None else {}),
+        **({"phases": phases} if phases is not None else {}),
         **({"serving": serving} if serving is not None else {}),
         **({"slo": slo} if slo is not None else {}),
         **({"admission": admission} if admission is not None else {}),

@@ -302,6 +302,15 @@ class TimeSeriesSampler:
             rec(f"query.{q}.p99_us", now, h.quantile(0.99) / 1e3)
         for q, n in snap.get("query_events", {}).items():
             rec(f"query.{q}.events", now, n)
+        # phase profiler series: cumulative per-phase ns plus the sampled
+        # deep-mode dispatch counter (observability/phases.py) — windowed
+        # per-phase rates derive below with the other counter rates
+        ph_snap = snap.get("phases", {})
+        for q, phases in ph_snap.get("queries", {}).items():
+            for p, v in phases.items():
+                rec(f"phase.{q}.{p}_ns", now, v["ns"])
+        for q, n in ph_snap.get("sampled", {}).items():
+            rec(f"phase.{q}.sampled_dispatches", now, n)
         # shard balance (meshed apps): skew gauge from host counters
         try:
             from ..sharding import shard_report
@@ -341,6 +350,14 @@ class TimeSeriesSampler:
             s = store.get(src)
             if s is not None:
                 rec(dst, now, s.rate(rate_w))
+        # per-phase burn rates (ns of phase wall accumulated per second):
+        # the live view of where the pipeline budget is going right now
+        for q, phases in ph_snap.get("queries", {}).items():
+            for p in phases:
+                s = store.get(f"phase.{q}.{p}_ns")
+                if s is not None:
+                    rec(f"rate.phase.{q}.{p}_ns_per_s", now,
+                        s.rate(rate_w))
         # SLO rules evaluate over the freshly-appended series
         rt._slo_state = self.slo.evaluate(name, rt, store, now)
         # ... and the mitigation ladder climbs on the verdict: under

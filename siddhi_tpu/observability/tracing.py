@@ -12,9 +12,13 @@ traces land in a bounded ring buffer and are dumped via
 
 The active trace is a module-level thread-local so deep layers (rate
 limiters, sinks, the jitted-step wrappers) can attach spans without any
-plumbing; a batch handed to another thread (@async / drainer) simply stops
-collecting spans there — the dispatch-side stages are the ones that explain
-latency, and cross-thread handoff would need locking on the hot path.
+plumbing.  Cross-thread handoff is EXPLICIT: the dispatch side calls
+`handoff()` to arm the active trace for concurrent appends (a per-trace
+lock, paid only once armed) and carries the returned token on whatever
+queue crosses the thread boundary (@async drainer items, serving-ring
+generations); the drain side wraps its delivery in `adopt(token)`, so one
+trace spans ingest -> dispatch -> drain -> sink and the delivery-side
+spans carry `track="drain"` for the Chrome-trace drainer track.
 Everything is a no-op (one thread-local read) when no trace is active.
 """
 from __future__ import annotations
@@ -59,25 +63,30 @@ def _clamp_meta(meta: Dict) -> Dict:
 
 
 class Span:
-    __slots__ = ("stage", "start_ns", "end_ns", "meta")
+    __slots__ = ("stage", "start_ns", "end_ns", "meta", "track")
 
-    def __init__(self, stage: str, start_ns: int, end_ns: int, meta: Dict):
+    def __init__(self, stage: str, start_ns: int, end_ns: int, meta: Dict,
+                 track: Optional[str] = None):
         self.stage = stage
         self.start_ns = start_ns
         self.end_ns = end_ns
         self.meta = meta
+        self.track = track
 
     def to_dict(self) -> Dict:
         d = {"stage": self.stage,
              "duration_us": (self.end_ns - self.start_ns) / 1e3,
              "offset_us": None}  # filled by BatchTrace.to_dict
+        if self.track is not None:
+            d["track"] = self.track
         d.update(self.meta)
         return d
 
 
 class BatchTrace:
     __slots__ = ("trace_id", "stream_id", "n_events", "wall_ms",
-                 "start_ns", "end_ns", "spans")
+                 "start_ns", "end_ns", "spans", "spans_truncated",
+                 "_append_lock")
 
     def __init__(self, stream_id: str, n_events: int):
         self.trace_id = next(_ids)
@@ -87,15 +96,40 @@ class BatchTrace:
         self.start_ns = time.perf_counter_ns()
         self.end_ns = self.start_ns
         self.spans: List[Span] = []
+        self.spans_truncated = 0
+        # armed by PipelineTracer.handoff(): appends from an adopting
+        # thread serialize against the dispatch side.  None until a
+        # handoff happens, so single-thread traces never pay the lock.
+        self._append_lock = None
+
+    def arm(self) -> None:
+        if self._append_lock is None:
+            self._append_lock = threading.Lock()
 
     def add_span(self, stage: str, start_ns: int, end_ns: int,
-                 meta: Dict) -> None:
+                 meta: Dict, track: Optional[str] = None) -> None:
+        lk = self._append_lock
+        if lk is None:
+            self._add_span(stage, start_ns, end_ns, meta, track)
+        else:
+            with lk:
+                self._add_span(stage, start_ns, end_ns, meta, track)
+
+    def _add_span(self, stage: str, start_ns: int, end_ns: int,
+                  meta: Dict, track: Optional[str]) -> None:
         # bounded entries: meta values clamp to a bounded repr and a
         # runaway dispatch (re-ingestion loop) can't make one trace hold
-        # unlimited spans
+        # unlimited spans — drops are COUNTED and surface as
+        # `spans_truncated` in the dump, never lost silently
         if len(self.spans) >= _MAX_SPANS:
+            self.spans_truncated += 1
             return
-        self.spans.append(Span(stage, start_ns, end_ns, _clamp_meta(meta)))
+        self.spans.append(
+            Span(stage, start_ns, end_ns, _clamp_meta(meta), track))
+        # adopted spans land after finish(): keep the trace total honest
+        # so drain-side time shows in `total_us`, not past its end
+        if end_ns > self.end_ns:
+            self.end_ns = end_ns
 
     def queries(self) -> List[str]:
         return sorted({s.meta["query"] for s in tuple(self.spans)
@@ -116,6 +150,7 @@ class BatchTrace:
             "wall_ms": self.wall_ms,
             "total_us": (self.end_ns - self.start_ns) / 1e3,
             "spans": spans,
+            "spans_truncated": self.spans_truncated,
         }
 
 
@@ -134,11 +169,47 @@ def span(stage: str, **meta):
     if tr is None:
         yield
         return
+    track = getattr(_tls, "track", None)
     t0 = time.perf_counter_ns()
     try:
         yield
     finally:
-        tr.add_span(stage, t0, time.perf_counter_ns(), meta)
+        tr.add_span(stage, t0, time.perf_counter_ns(), meta, track)
+
+
+def handoff() -> Optional[BatchTrace]:
+    """Arm the active trace for cross-thread appends and return it as the
+    token to carry on the handoff queue (@async drainer items, serving-
+    ring generations).  None when no trace is active — the token rides
+    the queue either way, so the drain side needs no special case."""
+    tr = getattr(_tls, "trace", None)
+    if tr is not None:
+        tr.arm()
+    return tr
+
+
+@contextlib.contextmanager
+def adopt(token: Optional[BatchTrace], track: str = "drain"):
+    """Make a handed-off trace the thread's active trace for the scope of
+    one delivery: spans recorded inside (emit, sink, nested re-ingestion
+    dispatches) attach to the ORIGINATING trace, tagged with `track` for
+    the Chrome-trace drainer lane.  With a None token this is the plain
+    no-op path.  Nested dispatch under adoption behaves exactly like
+    same-thread nesting: PipelineTracer.start() sees the adopted trace
+    and returns None, so the inner hop's spans join the outer story
+    instead of being silently skipped."""
+    if token is None:
+        yield
+        return
+    prev_tr = getattr(_tls, "trace", None)
+    prev_track = getattr(_tls, "track", None)
+    _tls.trace = token
+    _tls.track = track
+    try:
+        yield
+    finally:
+        _tls.trace = prev_tr
+        _tls.track = prev_track
 
 
 class PipelineTracer:
@@ -164,7 +235,9 @@ class PipelineTracer:
         if tr is None:      # nested dispatch: outer owner finishes it
             return
         _tls.trace = None
-        tr.end_ns = time.perf_counter_ns()
+        # max(): an adopted drain-side span may already have pushed the
+        # trace end past the dispatch side's finish instant
+        tr.end_ns = max(tr.end_ns, time.perf_counter_ns())
         with self._lock:
             self._ring.append(tr)
 

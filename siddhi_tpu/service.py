@@ -43,6 +43,16 @@ Endpoints (JSON in/out):
                                                per-app `slo` section when the
                                                time-series sampler runs (a
                                                FIRING rule flips `degraded`)
+  GET    /siddhi-apps/<name>/phases         -> phase-level latency report:
+                                               per-query wall seconds for
+                                               stage_host/h2d/dispatch_
+                                               submit/device_compute/ring_
+                                               wait/d2h_drain/demux/sink,
+                                               share of e2e accounted, and
+                                               sampled-dispatch counts
+                                               (observability/phases.py;
+                                               host clocks only — never
+                                               fetches or blocks)
   GET    /siddhi-apps/<name>/timeseries     -> windowed ring-buffer series
                                                (events/s, drops, p99
                                                trajectories, queue depths),
@@ -179,6 +189,16 @@ class SiddhiRestService:
                             self._json(200, {
                                 "app": parts[1],
                                 **rt.admission.report()})
+                    elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "phases":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                        else:
+                            # host-clock phase attribution only — this
+                            # endpoint never fetches or blocks on the
+                            # device (observability/phases.py)
+                            self._json(200, rt.phase_report())
                     elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                             and parts[2] == "timeseries":
                         rt = svc.manager.runtimes.get(parts[1])

@@ -147,25 +147,43 @@ class ServingDrainer:
     def _deliver(self, items) -> None:
         import traceback
         from ..core.runtime import _emit_output_sync
+        from ..observability import tracing as _tracing
+        # phase accounting: each item's ring residency (append -> take,
+        # stamped by ring.take) plus this cycle's batched fetch wall —
+        # charged per item, exactly as each item's e2e sample counts it
+        t_fetch = time.perf_counter_ns()
         # ONE blocking fetch for every segment taken this cycle: len-6
         # outs contribute the 16-byte header, len-4 outs ship whole
         try:
             fetched = jax.device_get([
                 (out[0], out[1]) if len(out) == 6 else out
-                for _, out, _, _ in items])
+                for _, out, _, _, _, _ in items])
         except Exception:  # noqa: BLE001 — drainer must survive
             traceback.print_exc()
             fetched = [None] * len(items)
+        fetch_ns = time.perf_counter_ns() - t_fetch
         per_q = {}
-        for (qr, out, now, t_in), fetch_h in zip(items, fetched):
+        loop_t0 = time.perf_counter_ns()
+        for (qr, out, now, t_in, trace, wait_ns), fetch_h in \
+                zip(items, fetched):
+            ph = qr.app.stats.phases
+            # in-batch wait: deliveries run serially, so a later item's
+            # e2e contains every predecessor's demux/sink wall — that
+            # residency is drainer wait, charged here so the phase sum
+            # keeps tracking e2e (attribution rule in phases.py)
+            ph.add(qr.name, "ring_wait",
+                   wait_ns + (time.perf_counter_ns() - loop_t0))
+            ph.add(qr.name, "d2h_drain", fetch_ns)
             try:
                 if fetch_h is None:
                     continue
-                if len(out) == 6:
-                    _emit_output_sync(qr, out, now, header=fetch_h,
-                                      ingest_ns=t_in)
-                else:
-                    _emit_output_sync(qr, fetch_h, now, ingest_ns=t_in)
+                with _tracing.adopt(trace):
+                    if len(out) == 6:
+                        _emit_output_sync(qr, out, now, header=fetch_h,
+                                          ingest_ns=t_in)
+                    else:
+                        _emit_output_sync(qr, fetch_h, now,
+                                          ingest_ns=t_in)
                 per_q[qr] = per_q.get(qr, 0) + 1
             except Exception as exc:  # noqa: BLE001 — drainer survives
                 # same fault routing as _EmissionDrainer._run: overflow

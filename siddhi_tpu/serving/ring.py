@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -156,7 +157,11 @@ class EmissionRing:
         self.capacity = max(1, int(capacity))
         self._cond = threading.Condition()
         self._gens: List[_Generation] = []
-        # (generation, now, ingest_ns) in send order, across generations
+        # (generation, now, ingest_ns, trace_token, append_ns) in send
+        # order, across generations: the token is the dispatch thread's
+        # handed-off BatchTrace (observability/tracing.handoff) so the
+        # drainer's delivery spans join the originating trace; append_ns
+        # stamps ring entry for the `ring_wait` phase (take - append)
         self._meta: "list" = []
         self._on_highwater = on_highwater
         self.appends_total = 0
@@ -164,7 +169,8 @@ class EmissionRing:
         self.generation = 0
 
     # -- producer edge (query lock held; never fetches) ---------------------
-    def append(self, out, now: int, ingest_ns=None) -> None:
+    def append(self, out, now: int, ingest_ns=None, trace=None) -> None:
+        append_ns = time.perf_counter_ns()
         with self._cond:
             gen = self._gens[-1] if self._gens else None
             if gen is None or gen.key != _aval_key(out):
@@ -177,7 +183,7 @@ class EmissionRing:
             if gen.count >= gen.slots:
                 gen = self._make_room(gen, out)
             gen.append(out)
-            self._meta.append((gen, now, ingest_ns))
+            self._meta.append((gen, now, ingest_ns, trace, append_ns))
             self.appends_total += 1
             kick = len(self._meta) >= self._high_water()
         if kick and self._on_highwater is not None:
@@ -234,14 +240,17 @@ class EmissionRing:
     def take(self, max_n: Optional[int] = None) -> List[Tuple]:
         """Pop up to `max_n` pending entries in send order, dispatching
         each slot's device read (lazy arrays — the caller does ONE
-        batched blocking fetch for everything it took)."""
+        batched blocking fetch for everything it took).  Each item is
+        (qr, out, now, ingest_ns, trace_token, ring_wait_ns)."""
         out: List[Tuple] = []
+        take_ns = time.perf_counter_ns()
         with self._cond:
             n = len(self._meta) if max_n is None else \
                 min(max_n, len(self._meta))
             for _ in range(n):
-                gen, now, ingest_ns = self._meta.pop(0)
-                out.append((self.qr, gen.read_tail(), now, ingest_ns))
+                gen, now, ingest_ns, trace, append_ns = self._meta.pop(0)
+                out.append((self.qr, gen.read_tail(), now, ingest_ns,
+                            trace, take_ns - append_ns))
             # drop fully-drained sealed generations (their buffers free)
             while len(self._gens) > 1 and self._gens[0].count == 0:
                 self._gens.pop(0)

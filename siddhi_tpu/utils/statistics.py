@@ -19,6 +19,7 @@ import time
 from typing import Dict, Optional
 
 from ..observability.histogram import LogHistogram, hist_of
+from ..observability.phases import PhaseProfiler
 from ..observability.recompile import RECOMPILES
 from ..observability.tracing import PipelineTracer
 
@@ -49,6 +50,10 @@ class StatisticsManager:
         self._shard_hist: Dict[str, LogHistogram] = {}
         self._counters: Dict[str, int] = {}
         self.tracer = PipelineTracer()
+        # always-on phase accumulator (observability/phases.py): host-
+        # clock ns per (query, phase), fed regardless of level — the
+        # per-phase budget must survive a BASIC production config
+        self.phases = PhaseProfiler()
         self._start = time.time()
 
     def _included(self, path: str) -> bool:
@@ -86,6 +91,13 @@ class StatisticsManager:
         per batch, e2e >= the per-hop step latency by construction."""
         hist_of(self._query_hist, name + ":e2e", self._lock) \
             .record(elapsed_ns)
+
+    def e2e_sum_ns(self, name: str) -> int:
+        """Total `<query>:e2e` wall ns — the denominator phase_report()
+        decomposes (phases + `other` must track this sum)."""
+        with self._lock:
+            h = self._query_hist.get(name + ":e2e")
+        return int(h.sum_ns) if h is not None else 0
 
     def emitted(self, name: str, rows: int, nbytes: int) -> None:
         """Output rows (and their schema-derived payload bytes) a query
@@ -195,6 +207,7 @@ class StatisticsManager:
                                  for k, v in self._shard_events.items()},
                 "shard_hist": dict(self._shard_hist),
                 "counters": dict(self._counters),
+                "phases": self.phases.snapshot(),
             }
 
     # -- reporting -------------------------------------------------------------
@@ -302,6 +315,7 @@ class StatisticsManager:
             self._shard_hist.clear()
             self._counters.clear()
             self._start = time.time()
+        self.phases.reset()
 
 
 class ConsoleReporter:

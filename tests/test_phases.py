@@ -1,0 +1,276 @@
+"""Phase-level hot-path profiler (observability/phases.py): accumulator
+arithmetic, phase-budget-vs-e2e parity, the never-fetch/never-block
+guarantee of always-on mode, cross-thread trace handoff/adoption, and
+the REST/EXPLAIN surfaces."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from siddhi_tpu.observability import phases as ph_mod
+from siddhi_tpu.observability import tracing
+from siddhi_tpu.observability.phases import PHASES, PhaseProfiler
+from siddhi_tpu.utils.config import InMemoryConfigManager
+
+BASIC_QL = """
+@app:name('PhApp')
+@app:statistics('BASIC')
+define stream S (k long, v float);
+@info(name='q') from S[v > 0.0] select k, v * 2.0 as v2 insert into Out;
+"""
+
+SERVED_QL = """
+@app:name('PhServe')
+@app:statistics('DETAIL')
+define stream S (k long, v float);
+@serve
+@info(name='q') from S[v > 0.0] select k, v insert into Out;
+"""
+
+
+def _send(rt, n=4, B=64):
+    h = rt.get_input_handler("S")
+    for i in range(n):
+        h.send_columns([np.arange(B, dtype=np.int64),
+                        np.full(B, 2.0, np.float32)],
+                       timestamps=np.full(B, 1000 + i, np.int64))
+    rt.flush()
+
+
+# -- accumulator arithmetic ---------------------------------------------------
+
+def test_profiler_accumulates_and_snapshots_in_canonical_order():
+    p = PhaseProfiler()
+    p.add("q", "sink", 5)
+    p.add("q", "stage_host", 7)
+    p.add("q", "stage_host", 3)
+    p.add("q", "demux", 0)        # non-positive samples are dropped
+    p.add("q", "demux", -4)
+    snap = p.snapshot()
+    q = snap["queries"]["q"]
+    assert q["stage_host"] == {"ns": 10, "count": 2}
+    assert q["sink"] == {"ns": 5, "count": 1}
+    assert "demux" not in q
+    # canonical pipeline order, not insertion order
+    assert list(q) == [p_ for p_ in PHASES if p_ in q]
+    p.reset()
+    assert p.snapshot() == {"queries": {}, "sampled": {}}
+
+
+def test_should_sample_modulus_and_sampled_counter():
+    p = PhaseProfiler()
+    assert not any(p.should_sample("q", 0) for _ in range(8))
+    hits = [p.should_sample("q", 4) for _ in range(12)]
+    assert hits == [False, False, False, True] * 3
+    assert p.snapshot()["sampled"] == {"q": 3}
+
+
+def test_sample_every_memoized_from_config(manager):
+    manager.set_config_manager(InMemoryConfigManager(
+        {"profile.sample.every": "5"}))
+    rt = manager.create_siddhi_app_runtime(BASIC_QL)
+    assert ph_mod.sample_every(rt) == 5
+    # memoized: a config swap mid-flight doesn't change the hot path
+    manager.set_config_manager(InMemoryConfigManager({}))
+    assert ph_mod.sample_every(rt) == 5
+
+
+# -- phase budget vs e2e ------------------------------------------------------
+
+def test_phase_report_accounts_e2e_budget(manager):
+    rt = manager.create_siddhi_app_runtime(BASIC_QL)
+    rt.add_callback("q", lambda ts, cur, exp: None)
+    rt.start()
+    _send(rt)
+    rep = rt.phase_report()
+    node = rep["queries"]["q"]
+    assert node["e2e_seconds"] > 0
+    total = sum(v["seconds"] for v in node["phases"].values())
+    # arithmetic identity: accounted == min(sum(phases)/e2e, 1) and the
+    # remainder is `other`
+    base = node["e2e_seconds"]
+    assert node["accounted"] == pytest.approx(
+        min(total / base, 1.0), abs=0.01)
+    assert node["other_seconds"] == pytest.approx(
+        max(0.0, base - total), abs=0.01)
+    # the blocking path must attribute the bulk of its own wall: submit,
+    # drain fetch, demux and sink all run on host clocks
+    assert node["accounted"] >= 0.2
+    for p_ in ("dispatch_submit", "d2h_drain", "demux", "sink"):
+        assert node["phases"][p_]["count"] >= 4, p_
+
+
+def test_phase_report_empty_without_statistics(manager):
+    rt = manager.create_siddhi_app_runtime(
+        BASIC_QL.replace("@app:statistics('BASIC')", ""))
+    rt.add_callback("q", lambda ts, cur, exp: None)
+    rt.start()
+    _send(rt, n=1)
+    assert rt.phase_report()["queries"] == {}
+
+
+# -- never-fetch / never-block ------------------------------------------------
+
+def _count_syncs(monkeypatch, ql, config=None, n=4):
+    """Run n sends and count jax.device_get / block_until_ready calls."""
+    from siddhi_tpu import SiddhiManager
+    m = SiddhiManager()
+    if config:
+        m.set_config_manager(InMemoryConfigManager(config))
+    gets = [0]
+    blocks = [0]
+    real_get, real_block = jax.device_get, jax.block_until_ready
+
+    def g(*a, **k):
+        gets[0] += 1
+        return real_get(*a, **k)
+
+    def b(*a, **k):
+        blocks[0] += 1
+        return real_block(*a, **k)
+
+    try:
+        rt = m.create_siddhi_app_runtime(ql)
+        rt.add_callback("q", lambda ts, cur, exp: None)
+        rt.start()
+        _send(rt, n=1)                      # warm/compile outside count
+        monkeypatch.setattr(jax, "device_get", g)
+        monkeypatch.setattr(jax, "block_until_ready", b)
+        _send(rt, n=n)
+        monkeypatch.setattr(jax, "device_get", real_get)
+        monkeypatch.setattr(jax, "block_until_ready", real_block)
+    finally:
+        m.shutdown()
+    return gets[0], blocks[0]
+
+
+def test_always_on_profiling_adds_no_sync(monkeypatch):
+    """Always-on phase accounting (statistics BASIC, deep mode off) must
+    take exactly the device syncs the OFF path takes — none of its own."""
+    off_ql = BASIC_QL.replace("@app:statistics('BASIC')", "")
+    g_off, b_off = _count_syncs(monkeypatch, off_ql)
+    g_on, b_on = _count_syncs(monkeypatch, BASIC_QL)
+    assert g_on == g_off
+    assert b_on == b_off
+    # ... while the sampled deep mode's ONLY addition is the fence
+    g_deep, b_deep = _count_syncs(monkeypatch, BASIC_QL,
+                                  config={"profile.sample.every": "2"},
+                                  n=4)
+    assert g_deep == g_off
+    assert b_deep > b_off
+
+
+def test_scrape_surfaces_never_touch_device(manager, monkeypatch):
+    from siddhi_tpu.observability import render_prometheus
+    from siddhi_tpu.observability.explain import explain_query
+    rt = manager.create_siddhi_app_runtime(BASIC_QL)
+    rt.add_callback("q", lambda ts, cur, exp: None)
+    rt.start()
+    _send(rt)
+
+    def bomb(*a, **k):
+        raise AssertionError("observability surface touched the device")
+
+    monkeypatch.setattr(jax, "device_get", bomb)
+    monkeypatch.setattr(jax, "block_until_ready", bomb)
+    text = render_prometheus(manager.runtimes)
+    rep = rt.phase_report()
+    exp = explain_query(rt, "q", deep=False)["phases"]
+    assert "siddhi_phase_seconds_total" in text
+    assert "siddhi_phase_dispatches_sampled_total" in text
+    assert rep["queries"]["q"]["phases"]["dispatch_submit"]["count"] >= 4
+    assert exp["available"]
+
+
+# -- cross-thread trace handoff/adoption --------------------------------------
+
+def test_handoff_adopt_attaches_spans_to_originating_trace():
+    tracer = tracing.PipelineTracer()
+    tr = tracer.start("S", 8)
+    with tracing.span("dispatch", query="q"):
+        pass
+    token = tracing.handoff()
+    assert token is tr and tr._append_lock is not None
+
+    def drain():
+        with tracing.adopt(token):
+            with tracing.span("deliver", query="q"):
+                pass
+            # nested dispatch under adoption joins the outer trace
+            assert tracer.start("S", 8) is None
+
+    t = threading.Thread(target=drain)
+    t.start()
+    t.join()
+    tracer.finish(tr)
+    (d,) = tracer.dump("q")
+    tracks = {s["stage"]: s.get("track") for s in d["spans"]}
+    assert tracks == {"dispatch": None, "deliver": "drain"}
+    assert len({d["trace_id"]}) == 1       # one trace holds both sides
+
+
+def test_adopt_none_token_is_noop():
+    with tracing.adopt(None):
+        assert tracing.active() is None
+
+
+def test_spans_truncated_counted_and_surfaced():
+    tracer = tracing.PipelineTracer()
+    tr = tracer.start("S", 1)
+    for i in range(tracing._MAX_SPANS + 7):
+        tr.add_span("s", i, i + 1, {"query": "q"})
+    tracer.finish(tr)
+    (d,) = tracer.dump("q")
+    assert len(d["spans"]) == tracing._MAX_SPANS
+    assert d["spans_truncated"] == 7
+
+
+def test_served_drain_spans_share_dispatch_trace(manager):
+    manager.set_config_manager(InMemoryConfigManager(
+        {"profile.sample.every": "2"}))
+    rt = manager.create_siddhi_app_runtime(SERVED_QL)
+    got = [0]
+    rt.add_callback("q", lambda ts, cur, exp: got.__setitem__(
+        0, got[0] + len(cur or [])))
+    rt.start()
+    _send(rt, n=6)
+    assert got[0] > 0
+    linked = [t for t in rt.trace_dump("q", 32)
+              if any(s.get("track") == "drain" for s in t["spans"])
+              and any(s.get("track") is None for s in t["spans"])]
+    assert linked, "no trace spans both dispatch and drainer threads"
+    # and the full taxonomy shows up for the served query
+    node = rt.phase_report()["queries"]["q"]
+    missing = [p_ for p_ in PHASES if p_ not in node["phases"]]
+    assert not missing, f"phases never recorded: {missing}"
+    assert node["sampled_dispatches"] >= 1
+
+
+# -- REST surface -------------------------------------------------------------
+
+def test_phases_endpoint():
+    from siddhi_tpu.service import SiddhiRestService
+    svc = SiddhiRestService()
+    svc.start()
+    try:
+        base = f"http://127.0.0.1:{svc.port}"
+        req = urllib.request.Request(
+            f"{base}/siddhi-apps", data=BASIC_QL.encode(), method="POST")
+        assert urllib.request.urlopen(req).status == 201
+        rt = svc.manager.runtimes["PhApp"]
+        rt.add_callback("q", lambda ts, cur, exp: None)
+        _send(rt)
+        rep = json.loads(urllib.request.urlopen(
+            f"{base}/siddhi-apps/PhApp/phases").read())
+        assert rep["app"] == "PhApp"
+        assert rep["queries"]["q"]["phases"]["dispatch_submit"]["count"] \
+            >= 4
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/siddhi-apps/nope/phases")
+        assert e.value.code == 404
+    finally:
+        svc.stop()

@@ -136,6 +136,11 @@ def test_full_exposition_lints(soaked_manager):
     assert families["siddhi_emitted_rows_total"] == "counter"
     assert families["siddhi_emitted_bytes_total"] == "counter"
     assert families["siddhi_query_latency_seconds"] == "histogram"
+    assert families["siddhi_phase_seconds_total"] == "counter"
+    assert families["siddhi_phase_dispatches_sampled_total"] == "counter"
+    # phase counters actually sampled for the busy apps (always-on mode)
+    assert any(f == "siddhi_phase_seconds_total" and lb.get("phase")
+               for f, _, lb, _ in samples)
     # every series key appears at most once per scrape
     keys = [_series_key(s, lb) for _, s, lb, _ in samples]
     assert len(keys) == len(set(keys)), "duplicate series in one scrape"
