@@ -5,12 +5,12 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 .PHONY: test lint lint-apps lint-smoke dryrun bench metrics-smoke \
 	fuse-smoke explain-smoke chaos-smoke multichip-smoke soak-smoke \
 	admission-smoke audit audit-update audit-smoke docgen-check \
-	join-smoke mqo-smoke serve-smoke phase-smoke all
+	join-smoke mqo-smoke serve-smoke phase-smoke state-smoke all
 
 all: lint lint-apps docgen-check audit test dryrun metrics-smoke \
 	fuse-smoke explain-smoke lint-smoke chaos-smoke multichip-smoke \
 	soak-smoke admission-smoke audit-smoke join-smoke mqo-smoke \
-	serve-smoke phase-smoke
+	serve-smoke phase-smoke state-smoke
 
 # static gate on our own code: ruff (rule set in pyproject.toml) when
 # available, with compileall kept as the syntax floor for samples and
@@ -146,6 +146,16 @@ serve-smoke:
 phase-smoke:
 	$(CPU_ENV) $(PY) samples/phase_smoke.py
 	$(CPU_ENV) $(PY) bench.py --mode phase_profile --quick --out /tmp/phases_quick.json
+
+# the state observatory in <30 s: occupancy arithmetic against known
+# traffic, the sizing-hints ledger surviving snapshot->restore, the
+# near-capacity healthz verdict with its config-key cite, and all
+# surfaces (3 /metrics families, EXPLAIN utilization, state_report)
+# touching zero device state (README "State observatory"); plus the
+# quick Zipf-vs-uniform hot-set A-B
+state-smoke:
+	$(CPU_ENV) $(PY) samples/state_smoke.py
+	$(CPU_ENV) $(PY) bench.py --mode state_profile --quick --out /tmp/state_quick.json
 
 # overload is decided, not discovered, in <30 s: an over-ceiling deploy
 # denied BEFORE any compile, exact shed accounting (offered == accepted

@@ -692,6 +692,124 @@ def run_phase_profile(quick=False, out_path=None, sample_every=16):
     return payload
 
 
+def _state_trace(dist, n_batches, B, n_keys, seed=16):
+    """[n_batches, B] int64 key trace: 'zipf' (s=1.2, clipped to the
+    key space) or 'uniform'."""
+    rng = np.random.default_rng(seed)
+    if dist == "zipf":
+        keys = np.minimum(rng.zipf(1.2, (n_batches, B)) - 1, n_keys - 1)
+    else:
+        keys = rng.integers(0, n_keys, (n_batches, B))
+    return keys.astype(np.int64)
+
+
+def _exact_hot_share(keys, fraction=0.01):
+    """Ground truth for the observatory's estimate: exact share of
+    traffic landing in the hottest ceil(distinct * fraction) keys."""
+    _, counts = np.unique(keys, return_counts=True)
+    top = max(1, int(np.ceil(len(counts) * fraction)))
+    counts.sort()
+    return float(counts[-top:].sum() / counts.sum())
+
+
+def run_state_profile(quick=False, out_path=None):
+    """--mode state_profile: what the state observatory measures on the
+    flagship under skewed vs flat key traffic (STATE artifact).
+
+    Two arms of the partitioned flagship NFA, identical except for the
+    key trace: Zipf(1.2) vs uniform over the same key space.  Each arm
+    reports the observatory's per-structure occupancy/high-water and
+    its estimated hot-set concentration (share of traffic in the top
+    1% of keys, from the count-min + space-saving sketches) against
+    the EXACT concentration computed from the generated trace — the
+    sketch error is part of the artifact.  The Zipf arm's hot-set
+    share is the measured motivation for ROADMAP item 4's tiered key
+    state; the high-water table is the sizing-hints ledger a restart
+    would adopt."""
+    from siddhi_tpu import SiddhiManager
+    from siddhi_tpu.utils.config import InMemoryConfigManager
+    if quick:
+        n_keys, B, n_batches = 256, 256, 8
+    else:
+        n_keys, B, n_batches = 1 << 12, 1 << 11, 32
+
+    arms = {}
+    for dist in ("zipf", "uniform"):
+        manager = SiddhiManager()
+        manager.set_config_manager(InMemoryConfigManager(
+            {"state.obs.sample.every": "4"}))
+        rt = manager.create_siddhi_app_runtime(QL_TEMPLATE.format(
+            async_ann="", pipe_ann="", n_keys=n_keys, slots=SLOTS))
+        rt.set_statistics_level("BASIC")
+        matches = [0]
+        rt.add_batch_callback(
+            "flagship", lambda ts, b: matches.__setitem__(
+                0, matches[0] + b["n_current"]))
+        rt.start()
+        h = rt.get_input_handler("TradeStream")
+        keys = _state_trace(dist, n_batches, B, n_keys)
+        clock = 1000
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            kb = keys[i]
+            # volumes cycle 1..4 so NFA chains progress and complete
+            vol = np.full(B, (i % 4) + 1, np.int32)
+            price = ((kb % 7) + (i % 4) + 1).astype(np.float32)
+            clock += 10
+            h.send_columns([kb.copy(), price, vol],
+                           timestamps=np.full(B, clock, np.int64))
+        rt.flush()
+        dt = time.perf_counter() - t0
+        rep = rt.state_report()
+        node = rep["structures"].get("flagship", {})
+        hot = rep["hotness"].get("flagship", {})
+        exact = _exact_hot_share(keys)
+        arms[dist] = {
+            "events_per_sec": round(n_batches * B / dt),
+            "matches": matches[0],
+            "distinct_keys_sent": int(len(np.unique(keys))),
+            "hot_share_top1pct_exact": round(exact, 4),
+            "hot_share_top1pct_estimated": hot.get("hot_share_1pct"),
+            "hotness": hot,
+            "structures": node,
+            "sizing_hints": rep["sizing_hints"].get("flagship", {}),
+        }
+        print(f"state_profile[{dist}]: {arms[dist]['events_per_sec']:,}"
+              f" ev/s, hot-1% exact={exact:.3f} "
+              f"est={hot.get('hot_share_1pct')}", file=sys.stderr)
+        manager.shutdown()
+
+    # the artifact's claim: the observatory separates skewed from flat
+    z = arms["zipf"]["hot_share_top1pct_estimated"] or 0.0
+    u = arms["uniform"]["hot_share_top1pct_estimated"] or 1.0
+    assert z > 2 * u, f"hot-set estimate failed to separate " \
+        f"zipf ({z}) from uniform ({u})"
+
+    payload = {
+        "mode": "state_profile",
+        "quick": quick,
+        "n_keys": n_keys, "batch": B, "n_batches": n_batches,
+        "arms": arms,
+        "note": (
+            "flagship partitioned NFA driven by Zipf(1.2) vs uniform "
+            "key traces over the same key space; hot_share_top1pct_* "
+            "is the share of keyed traffic in the hottest 1% of "
+            "distinct keys — 'exact' from the generated trace, "
+            "'estimated' from the observatory's count-min + space-"
+            "saving sketches fed by staging's per-batch key sets "
+            "(observability/stateobs.py, zero device fetches).  "
+            "structures/sizing_hints are the per-structure occupancy "
+            "and high-water a snapshot carries across restarts."),
+    }
+    print(json.dumps({k: v for k, v in payload.items() if k != "note"}))
+    if out_path:
+        with open(out_path, "w") as fh:
+            json.dump(payload, fh, indent=1)
+            fh.write("\n")
+        print(f"wrote {out_path}", file=sys.stderr)
+    return payload
+
+
 def run_join_compare(B=1 << 10, n_batches=8, out_path=None):
     """--mode join_compare: the windowed_join corpus shape with the
     equi-join fast path ON vs OFF (full [R,C] grid), plus the
@@ -1958,7 +2076,8 @@ if __name__ == "__main__":
                     choices=["full", "device_loop", "fuse_compare",
                              "cost_analysis", "multichip", "soak",
                              "join_compare", "mqo_compare",
-                             "serve_compare", "phase_profile"],
+                             "serve_compare", "phase_profile",
+                             "state_profile"],
                     help="full: the flagship suite (default); "
                          "device_loop: tunnel-independent chip-side "
                          "events/sec via fused dispatch re-execution; "
@@ -1983,7 +2102,11 @@ if __name__ == "__main__":
                          "phase_profile: per-phase wall-time tables "
                          "for flagship blocking vs @serve and sharded "
                          "1/2/4/8 from the always-on phase profiler "
-                         "(PHASES artifact)")
+                         "(PHASES artifact); "
+                         "state_profile: flagship under Zipf vs "
+                         "uniform key traces — observatory occupancy/"
+                         "high-water tables and hot-set concentration "
+                         "estimate vs exact (STATE artifact)")
     ap.add_argument("--k", type=int, default=16,
                     help="fused stack depth (device_loop/fuse_compare)")
     ap.add_argument("--batch", type=int, default=1 << 11,
@@ -2046,6 +2169,10 @@ if __name__ == "__main__":
         _enable_compile_cache()
         run_phase_profile(quick=args.quick,
                           out_path=args.out or "PHASES_r14.json")
+    elif args.mode == "state_profile":
+        _enable_compile_cache()
+        run_state_profile(quick=args.quick,
+                          out_path=args.out or "STATE_r16.json")
     elif args.mode == "multichip":
         _enable_compile_cache()
         run_multichip(quick=args.quick, out_path=args.out)

@@ -866,8 +866,62 @@ def _serve_blocking_sink(ctx: AnalysisContext) -> Iterator[Finding]:
                      "ring in the app", query=f.name, node=ann)
 
 
+@rule("STATE003", "WARN",
+      "sized state capacity far from observed high-water",
+      "Every stateful structure here occupies FIXED device shapes sized "
+      "at compile time: keyed window slabs, group-slot arenas, NFA key "
+      "blocks, join key lanes.  The state observatory "
+      "(observability/stateobs.py) tracks each structure's occupancy "
+      "and high-water from its host mirror.  A capacity 4x or more "
+      "above the observed high-water wastes HBM against admission's "
+      "state ceilings for the whole app lifetime; an occupancy at 90%+ "
+      "of a NON-growable cap means the next new key raises a slot-"
+      "exhaustion error instead of degrading gracefully.",
+      "resize via the cited config key (e.g. @capacity(keys='N')) to "
+      "~2x the observed high-water; the high-water persists across "
+      "restarts in snapshots, so a bench-scale soak gives a durable "
+      "sizing hint")
+def _state_capacity_mismatch(ctx: AnalysisContext) -> Iterator[Finding]:
+    rt = ctx.runtime
+    if rt is None:
+        return          # utilization is measured, never guessed
+    from ..observability.stateobs import (
+        _NEAR_CAPACITY_EXEMPT, collect, near_capacity, obs_enabled)
+    if not obs_enabled(rt):
+        return
+    try:
+        collect(rt)
+        snap = rt.stats.stateobs.snapshot()
+    except Exception:  # noqa: BLE001 — analysis must not die
+        return
+    for q, structures in snap["structures"].items():
+        for s, rec in structures.items():
+            hwm, cap = rec["high_water"], rec["capacity"]
+            if rec["growable"] or s in _NEAR_CAPACITY_EXEMPT:
+                continue
+            # oversized: enough traffic to trust the high-water, and
+            # the configured cap dwarfs it
+            if hwm >= 8 and cap >= 4 * hwm:
+                ck = rec.get("config_key") or "its capacity annotation"
+                yield _f(f"{s} capacity {cap} is {cap / hwm:.0f}x the "
+                         f"observed high-water {hwm} — device state is "
+                         "sized for traffic that never arrived",
+                         query=q,
+                         hint=f"shrink {ck} toward ~{max(16, 2 * hwm)} "
+                              "(2x observed high-water)")
+    for rec in near_capacity(rt, snap):
+        ck = rec.get("config_key") or "its capacity annotation"
+        yield _f(f"{rec['structure']} occupancy {rec['occupancy']}/"
+                 f"{rec['capacity']} "
+                 f"({rec['utilization'] * 100:.0f}%) on a non-growable "
+                 "cap — the next new key past the cap raises instead "
+                 "of degrading", query=rec["query"],
+                 hint=f"raise {ck} before the arena exhausts")
+
+
 ALL_RULE_IDS: List[str] = [
     "STATE001", "STATE002", "MEM001", "FUSE001", "JOIN001", "JOIN002",
     "DEAD001", "DEAD002", "NULL001", "PART001", "PART002", "TYPE001",
     "RATE001", "APP001", "SINK001", "ADM001", "MQO001", "SERVE001",
+    "STATE003",
 ]

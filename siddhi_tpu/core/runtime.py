@@ -26,6 +26,7 @@ from ..exceptions import (CannotRestoreStateError, DefinitionNotExistError,
                           MatchOverflowError, QueryNotExistError)
 from ..observability import tracing as _tracing
 from ..observability import phases as _phases
+from ..observability import stateobs as _stateobs
 from ..query_api.app import SiddhiApp
 from ..query_api.definition import StreamDefinition
 from ..query_api.query import Partition, Query, SingleInputStream
@@ -209,6 +210,42 @@ def _allocator_of(qr):
     return a
 
 
+_STATEOBS_ONE = np.ones(1, np.int64)
+
+
+def _stateobs_feed_slots(qr, alloc, slots) -> None:
+    """Fold one batch's resolved key slots (per-event slot ids, -1 =
+    invalid) into the app's key-hotness tracker — host numpy only; a
+    disabled observatory costs one memoized dict read."""
+    if not _stateobs.obs_enabled(qr.app):
+        return
+    live = slots[slots >= 0]
+    if live.size == 0:
+        return
+    if live.size == 1:
+        # single-row sends dominate interactive/test traffic; skip the
+        # np.unique pass (KeyHotness.update has the matching fast path)
+        keys, counts = live, _STATEOBS_ONE
+    else:
+        keys, counts = np.unique(live, return_counts=True)
+    qr.app.stats.stateobs.feed_keys(qr.name, alloc.capacity, keys, counts)
+
+
+def _stateobs_feed_group(qr, alloc, key_idx, sel, pad) -> None:
+    """Fold one grouped batch's key set into the hotness tracker: the
+    per-key row counts fall out of the already-computed [Kb, E] group
+    selection (`(sel >= 0).sum(axis=1)`) — no extra np.unique pass."""
+    if not _stateobs.obs_enabled(qr.app):
+        return
+    keys = np.asarray(key_idx)
+    live = keys < pad
+    if not live.any():
+        return
+    counts = (np.asarray(sel) >= 0).sum(axis=1)
+    qr.app.stats.stateobs.feed_keys(qr.name, alloc.capacity,
+                                    keys[live], counts[live])
+
+
 def _wrap_stream_callback(cb) -> Callable[[List[ev.Event]], None]:
     if isinstance(cb, StreamCallback):
         return cb.receive
@@ -369,6 +406,7 @@ class QueryRuntime(_MeshResolved):
         if p.group_by_positions and p.slot_allocator is not None:
             gslot = p.slot_allocator.slots_for(
                 [staged.cols[i] for i in p.group_by_positions], valid)
+            _stateobs_feed_slots(self, p.slot_allocator, gslot)
         else:
             gslot = _zero_slots(staged.ts.shape[0])
         if self._touch is not None:
@@ -418,6 +456,9 @@ class QueryRuntime(_MeshResolved):
                 jax.numpy.asarray(now, jax.numpy.int64),
                 in_tabs, pslots))
         _rebind_state(self, _st)
+        # sampled window-fill probe: dispatch-only; its scalar rides the
+        # delivery fetch in _deliver_output (observability/stateobs.py)
+        _stateobs.arm_fill_probe(self)
         # the device-computed wake scalar rides the emission fetch (a sync
         # int(wake) here would stall the send path one tunnel RTT per batch)
         wake_arg = None
@@ -452,6 +493,8 @@ class QueryRuntime(_MeshResolved):
         if not all_keys:
             _, key_idx, sel = p.window_key_allocator.slots_and_group(
                 kcols, valid, pad=p.key_capacity)
+            _stateobs_feed_group(self, p.window_key_allocator, key_idx,
+                                 sel, p.key_capacity)
         if self._touch is not None and not all_keys:
             self._touch(key_idx, now)
         if p.slot_allocator is not None and not all_keys:
@@ -660,6 +703,8 @@ class PatternQueryRuntime(_MeshResolved):
                 key_cols = [staged.cols[i] for i in pos]
                 valid = staged.valid
             key_idx_np, sel = self._grouped_slots(key_cols, valid, p)
+            _stateobs_feed_group(self, self.slot_allocator, key_idx_np,
+                                 sel, p.key_capacity)
             if self._touch is not None:
                 self._touch(key_idx_np, now)
             sel_d = jax.numpy.asarray(sel)
@@ -753,6 +798,7 @@ class PatternQueryRuntime(_MeshResolved):
             valid = staged.valid
         t0 = time.perf_counter_ns()
         slots = self.slot_allocator.slots_for(key_cols, valid)
+        _stateobs_feed_slots(self, self.slot_allocator, slots)
         if self._touch is not None:
             self._touch(slots, now)
         if self._dirty is not None:
@@ -925,15 +971,21 @@ def _deliver_output(qr, out, now: int, wake, ingest_ns=None,
     is a handed-off BatchTrace for deferred (@pipeline) deliveries whose
     originating dispatch has moved on — delivery spans adopt it."""
     t0 = time.perf_counter_ns()
+    # sampled window-fill probe rides THIS fetch (same device_get call:
+    # the never-fetch guard counts calls, and this adds none)
+    probe = _stateobs.take_fill_probe(qr)
     if len(out) == 6:
-        header, wake_h = jax.device_get(((out[0], out[1]), wake))
+        header, wake_h, fills = jax.device_get(
+            ((out[0], out[1]), wake, probe))
     else:
-        out, wake_h = jax.device_get((out, wake))
+        out, wake_h, fills = jax.device_get((out, wake, probe))
         header = None
     st = qr.app.stats
     if st.enabled:
         st.phases.add(qr.name, "d2h_drain",
                       time.perf_counter_ns() - t0)
+    if fills is not None:
+        _stateobs.record_fill(qr, fills)
     if wake_h is not None:
         qr._apply_wake(int(wake_h))
     with _tracing.adopt(trace):
@@ -1198,6 +1250,15 @@ def _emit_output_sync_impl(qr, out, now: int, header=None) -> None:
             # pattern matches are always CURRENT-kind rows
             counts = {"n_valid": nv, "n_current": nv, "n_expired": 0,
                       "n_dropped": nd}
+        # emission-cap demand (nv + nd rows wanted out this batch) is
+        # already host-side off the header fetch — the high-water mark
+        # the sizing ledger persists for @emit pre-sizing
+        _cap = getattr(qr.planned, "compact_rows", None)
+        if _cap is not None and _stateobs.obs_enabled(qr.app):
+            qr.app.stats.stateobs.observe(
+                qr.name, "emission_cap", nv + nd, _cap,
+                growable=not getattr(qr.planned, "emit_explicit", True),
+                config_key="@emit(rows='N')")
     try:
         if len(out) == 6:
             if nv == 0:
@@ -1466,6 +1527,14 @@ class JoinQueryRuntime(_MeshResolved):
         if need > p.lane_k:
             self._grow_lane_k(need)
         out = np.where(kvalid, slots, -1).astype(np.int32)
+        if _stateobs.obs_enabled(self.app):
+            # lane demand is a running bucket-occupancy max the tracker
+            # already mirrors host-side; push it so the HWM survives
+            # window expiry shrinking the live lanes back down
+            self.app.stats.stateobs.observe(
+                self.name, "join_lane", need, self.planned.lane_k,
+                growable=True, config_key="auto (lane grows via replan)")
+            _stateobs_feed_slots(self, p.join_key_allocator, out)
         cache[key] = out
         return out
 
@@ -3899,6 +3968,15 @@ class SiddhiAppRuntime:
         from ..observability.phases import phase_report as _pr
         return _pr(self)
 
+    def state_report(self) -> Dict:
+        """State observatory report: per-(query, structure) occupancy /
+        capacity / high-water utilization, key hotness (count-min +
+        top-K), near-capacity verdicts, and the sizing-hints ledger a
+        snapshot would persist — see observability/stateobs.py.  Host-
+        side reads only: safe to call on a live app."""
+        from ..observability.stateobs import state_report as _sr
+        return _sr(self)
+
     def explain(self, query_name: Optional[str] = None,
                 deep: bool = True) -> Dict:
         """EXPLAIN report: planned operator tree + per-step XLA cost
@@ -4020,12 +4098,17 @@ class SiddhiAppRuntime:
                     for aid, a in self.aggregations.items()}
             from .table import _table_state
             tables = {tid: _table_state(t) for tid, t in self.tables.items()}
+            _stateobs.collect(self)
             payload = {
                 "states": states,
                 "windows": windows,
                 "aggregations": aggs,
                 "tables": tables,
                 "interner": list(self.interner._to_str),
+                # sizing-hints ledger: learned high-water marks ride the
+                # snapshot so a restarted app reports its observed
+                # capacities from tick zero (observability/stateobs.py)
+                "sizing": self.stats.stateobs.ledger(),
             }
             # a full snapshot resets the incremental baseline
             for qr in self.query_runtimes.values():
@@ -4100,6 +4183,8 @@ class SiddhiAppRuntime:
                            for tid, t in self.tables.items()},
                 "interner": list(self.interner._to_str),
             }
+            _stateobs.collect(self)
+            payload["sizing"] = self.stats.stateobs.ledger()
             return pickle.dumps(payload)
 
     def restore_increment(self, blob: bytes) -> None:
@@ -4250,6 +4335,11 @@ class SiddhiAppRuntime:
             t = self.tables.get(tid)
             if t is not None:
                 _restore_table_state(t, tdata)
+        # sizing-hints ledger: max-merge the snapshotted high-water
+        # marks so the restored app reports them from tick zero
+        sizing = payload.get("sizing")
+        if sizing:
+            self.stats.stateobs.adopt_ledger(sizing)
 
 
 class SiddhiManager:

@@ -46,6 +46,16 @@ and the soak-telemetry layer (metrics over TIME, not just at scrape):
   tick with ok/pending/firing hysteresis, surfaced as
   `siddhi_slo_state` in `/metrics` and an `slo` section in `/healthz`
   (`slo.py`),
+- **state observatory**: always-on per-(app, query, structure)
+  occupancy/capacity/high-water tracking for every sized device
+  structure (keyed slabs, group slots, join lanes, window fill,
+  emission caps, serve rings) plus key hotness from a host-side
+  count-min sketch + space-saving top-K; high-water marks persist
+  across restarts as a sizing-hints ledger carried in snapshots
+  (`stateobs.py`; surfaced as `siddhi_state_occupancy` /
+  `siddhi_state_high_water` / `siddhi_key_hotset_share`,
+  `GET /siddhi-apps/<app>/state`, EXPLAIN `utilization`, and a
+  `state` section in `/healthz`),
 - **phase profiler**: always-on per-(app, query, phase) wall-time
   counters over the canonical hot-path taxonomy (stage_host, h2d,
   dispatch_submit, device_compute, ring_wait, d2h_drain, demux, sink)
@@ -67,6 +77,8 @@ from .recompile import RECOMPILES, RecompileRegistry      # noqa: F401
 from .tracing import (PipelineTracer, active, adopt,      # noqa: F401
                       handoff, span)
 from .phases import PHASES, PhaseProfiler, phase_report   # noqa: F401
+from .stateobs import (STRUCTURES, KeyHotness,            # noqa: F401
+                       StateObservatory, state_report)
 from .exposition import render_prometheus                 # noqa: F401
 from .explain import explain_app, explain_query           # noqa: F401
 from .memory import component_bytes, total_bytes          # noqa: F401
@@ -81,6 +93,7 @@ __all__ = [
     "LogHistogram", "PipelineTracer", "RECOMPILES", "RecompileRegistry",
     "active", "adopt", "handoff", "span", "render_prometheus",
     "PHASES", "PhaseProfiler", "phase_report",
+    "STRUCTURES", "KeyHotness", "StateObservatory", "state_report",
     "explain_app", "explain_query", "component_bytes", "total_bytes",
     "chrome_trace", "start_profiler", "stop_profiler", "profiler_status",
     "app_health", "healthz", "liveness", "readiness",

@@ -405,6 +405,32 @@ def _phases_node(rt, qr) -> Dict:
         return {"available": False, "reason": "phase report failed"}
 
 
+def _utilization_node(rt, qr) -> Dict:
+    """Live state-observatory view for this query (observability/
+    stateobs.py): per-structure occupancy/capacity/high-water plus key
+    hotness, or a hint to send traffic.  Host mirrors only — this node
+    never touches the device."""
+    try:
+        from .stateobs import collect, obs_enabled
+        if not obs_enabled(rt):
+            return {"available": False,
+                    "reason": "state observatory disabled "
+                              "(state.obs.enabled=false)"}
+        collect(rt)
+        snap = rt.stats.stateobs.snapshot()
+        structures = snap["structures"].get(qr.name)
+        hotness = snap["hotness"].get(qr.name)
+        if not structures and not hotness:
+            return {"available": False,
+                    "reason": "no sized structures observed yet — send "
+                              "traffic, then re-run explain"}
+        return {"available": True,
+                "structures": structures or {},
+                **({"hotness": hotness} if hotness else {})}
+    except Exception:  # noqa: BLE001 — diagnostics must not throw
+        return {"available": False, "reason": "state report failed"}
+
+
 def _tree_for(qr, kind: str) -> Dict:
     """Planned operator tree from the query AST + compiled plan facts."""
     from ..query_api.query import (JoinInputStream, SingleInputStream,
@@ -499,6 +525,7 @@ def explain_query(rt, query_name: str, deep: bool = True) -> Dict:
         "merge": _merge_node(qr),
         "serving": _serving_node(rt, qr),
         "phases": _phases_node(rt, qr),
+        "utilization": _utilization_node(rt, qr),
         **_sharding_entry(qr, kind, deep),
         "recompiles": RECOMPILES.snapshot(
             [query_name, f"fused:{query_name}"]),

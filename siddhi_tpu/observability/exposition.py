@@ -217,9 +217,26 @@ def render_prometheus(runtimes: Dict) -> str:
                  "Dispatches fenced with block_until_ready by the "
                  "sampled deep profiling mode (profile.sample.every=N) "
                  "to split submit wall from device compute, per query")
+    so_occ = fam("siddhi_state_occupancy", "gauge",
+                 "Utilization (occupancy/capacity, 0-1) of each sized "
+                 "device state structure, from its host mirror "
+                 "(observability/stateobs.py — never a device fetch)")
+    so_hwm = fam("siddhi_state_high_water", "gauge",
+                 "High-water occupancy of each sized device state "
+                 "structure (rows/slots/keys) — monotone per process "
+                 "and max-merged across snapshot restores")
+    so_hot = fam("siddhi_key_hotset_share", "gauge",
+                 "Share of keyed traffic landing in the hottest 1% of "
+                 "observed keys (count-min + space-saving top-K over "
+                 "staging's per-batch key sets), per query")
 
+    from .stateobs import collect as _stateobs_collect
     for app_name, rt in sorted(runtimes.items()):
         st = rt.stats
+        # refresh the observatory from the host mirrors first (plain
+        # attribute reads: allocator lengths, ring counters — no device
+        # work rides the scrape)
+        _stateobs_collect(rt)
         snap = st.exposition_snapshot()
         uptime.sample(snap["uptime_s"], app=app_name)
         level.sample({"OFF": 0, "BASIC": 1, "DETAIL": 2}.get(st.level, 0),
@@ -287,6 +304,18 @@ def render_prometheus(runtimes: Dict) -> str:
         for q, n in sorted(ph_sampled.items()):
             if q not in ph_snap.get("queries", {}):
                 ph_smp.sample(n, app=app_name, query=q)
+        # state observatory: occupancy ratio + high-water per sized
+        # structure, hot-set concentration per keyed query
+        so_snap = snap.get("stateobs", {})
+        for q, structures in sorted(so_snap.get("structures",
+                                                {}).items()):
+            for s, rec in structures.items():
+                so_occ.sample(rec["utilization"], app=app_name,
+                              query=q, structure=s)
+                so_hwm.sample(rec["high_water"], app=app_name,
+                              query=q, structure=s)
+        for q, hot in sorted(so_snap.get("hotness", {}).items()):
+            so_hot.sample(hot["hot_share_1pct"], app=app_name, query=q)
         for gid, mg in sorted(getattr(rt, "merged_groups", {}).items()):
             mrg_q.sample(len(getattr(mg, "members", ())), app=app_name,
                          group=gid)

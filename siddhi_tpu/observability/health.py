@@ -266,6 +266,44 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
     except Exception:  # noqa: BLE001 — probe must not throw
         phases = None
 
+    # state observatory (observability/stateobs.py): per-structure
+    # utilization + high-water from the HOST mirrors, key-hotness
+    # concentration, and near-capacity verdicts.  A non-growable
+    # structure at/over the near-capacity threshold flips the same
+    # `degraded` verdict a BROKEN sink does — the app still processes,
+    # but the next key/slot past the cap raises instead of degrading
+    # gracefully, so the operator should resize BEFORE that happens
+    state = None
+    try:
+        from .stateobs import (_NEAR_CAPACITY_EXEMPT, collect,
+                               near_capacity, obs_enabled)
+        if obs_enabled(rt):
+            collect(rt)
+            so_snap = rt.stats.stateobs.snapshot()
+            near = near_capacity(rt, so_snap)
+            worst = 0.0
+            n_structs = 0
+            for q, structures in so_snap["structures"].items():
+                for s, rec in structures.items():
+                    n_structs += 1
+                    # window_fill runs 100% full at steady state by
+                    # design — not a capacity-pressure signal
+                    if not rec["growable"] and \
+                            s not in _NEAR_CAPACITY_EXEMPT:
+                        worst = max(worst, rec["utilization"])
+            state = {
+                "structures_tracked": n_structs,
+                "worst_fixed_utilization": round(worst, 4),
+                "near_capacity": near,
+                "hot_share_1pct": {
+                    q: h["hot_share_1pct"]
+                    for q, h in so_snap["hotness"].items()},
+            }
+            if near:
+                degraded = True
+    except Exception:  # noqa: BLE001 — probe must not throw
+        state = None
+
     report = {
         "started": started,
         "accepting_ingress": accepting,
@@ -277,6 +315,7 @@ def app_health(rt, now_ms: Optional[int] = None) -> Dict:
         "degraded": degraded,
         **({"shards": shards} if shards is not None else {}),
         **({"phases": phases} if phases is not None else {}),
+        **({"state": state} if state is not None else {}),
         **({"serving": serving} if serving is not None else {}),
         **({"slo": slo} if slo is not None else {}),
         **({"admission": admission} if admission is not None else {}),

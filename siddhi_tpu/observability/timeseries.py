@@ -200,7 +200,27 @@ def tenant_account(rt, snap: Optional[Dict] = None) -> Dict:
                                   "shed_total", 0),
         "admission_blocked_ms": getattr(getattr(rt, "admission", None),
                                         "blocked_ms_total", 0),
+        # state observatory (observability/stateobs.py): the worst
+        # fixed-capacity utilization and the deepest high-water a
+        # tenant's structures have reached — the sizing exposure an
+        # admission controller would charge for
+        "state_worst_utilization": _stateobs_worst(snap),
+        "state_high_water_sum": sum(
+            rec.get("high_water", 0)
+            for structures in snap.get("stateobs", {})
+            .get("structures", {}).values()
+            for rec in structures.values()),
     }
+
+
+def _stateobs_worst(snap: Dict) -> float:
+    worst = 0.0
+    for structures in snap.get("stateobs", {}).get("structures",
+                                                   {}).values():
+        for rec in structures.values():
+            if not rec.get("growable", True):
+                worst = max(worst, rec.get("utilization", 0.0))
+    return round(worst, 4)
 
 
 class TimeSeriesSampler:
@@ -255,6 +275,10 @@ class TimeSeriesSampler:
         if store is None or store.window != self.window:
             store = rt.__dict__["_timeseries"] = SeriesStore(self.window)
         st = rt.stats
+        # refresh the state observatory from the host mirrors before
+        # snapshotting, so the tick's series see current occupancy
+        from .stateobs import collect as _stateobs_collect
+        _stateobs_collect(rt)
         snap = st.exposition_snapshot()
         acct = tenant_account(rt, snap)
         rt._tenant_account = acct
@@ -311,6 +335,16 @@ class TimeSeriesSampler:
                 rec(f"phase.{q}.{p}_ns", now, v["ns"])
         for q, n in ph_snap.get("sampled", {}).items():
             rec(f"phase.{q}.sampled_dispatches", now, n)
+        # state observatory series: per-(query, structure) utilization +
+        # high-water trajectories and per-query hot-set concentration —
+        # the occupancy histogram ROADMAP item 4's tiering design reads
+        so_snap = snap.get("stateobs", {})
+        for q, structures in so_snap.get("structures", {}).items():
+            for s, v in structures.items():
+                rec(f"state.{q}.{s}.utilization", now, v["utilization"])
+                rec(f"state.{q}.{s}.high_water", now, v["high_water"])
+        for q, hot in so_snap.get("hotness", {}).items():
+            rec(f"state.{q}.hot_share_1pct", now, hot["hot_share_1pct"])
         # shard balance (meshed apps): skew gauge from host counters
         try:
             from ..sharding import shard_report

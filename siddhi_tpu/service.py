@@ -53,6 +53,16 @@ Endpoints (JSON in/out):
                                                (observability/phases.py;
                                                host clocks only — never
                                                fetches or blocks)
+  GET    /siddhi-apps/<name>/state          -> state observatory report:
+                                               per-structure occupancy /
+                                               capacity / high-water, key
+                                               hotness (top-K + hot-set
+                                               share), near-capacity
+                                               verdicts, and the sizing-
+                                               hints ledger persisted in
+                                               snapshots (observability/
+                                               stateobs.py; host counters
+                                               only — never fetches)
   GET    /siddhi-apps/<name>/timeseries     -> windowed ring-buffer series
                                                (events/s, drops, p99
                                                trajectories, queue depths),
@@ -199,6 +209,15 @@ class SiddhiRestService:
                             # endpoint never fetches or blocks on the
                             # device (observability/phases.py)
                             self._json(200, rt.phase_report())
+                    elif len(parts) == 3 and parts[0] == "siddhi-apps" \
+                            and parts[2] == "state":
+                        rt = svc.manager.runtimes.get(parts[1])
+                        if rt is None:
+                            self._json(404, {"error": "no such app"})
+                        else:
+                            # occupancy/high-water/hotness from host
+                            # counters only (observability/stateobs.py)
+                            self._json(200, rt.state_report())
                     elif len(parts) == 3 and parts[0] == "siddhi-apps" \
                             and parts[2] == "timeseries":
                         rt = svc.manager.runtimes.get(parts[1])

@@ -44,6 +44,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 
+from ..observability import stateobs as _stateobs
+
 jnp = jax.numpy
 log = logging.getLogger("siddhi_tpu")
 
@@ -185,7 +187,15 @@ class EmissionRing:
             gen.append(out)
             self._meta.append((gen, now, ingest_ns, trace, append_ns))
             self.appends_total += 1
-            kick = len(self._meta) >= self._high_water()
+            occ = len(self._meta)
+            kick = occ >= self._high_water()
+        if _stateobs.obs_enabled(self.qr.app):
+            # serve-ring depth high-water for the sizing ledger (host
+            # counter read — the producer edge stays fetch-free)
+            self.qr.app.stats.stateobs.observe(
+                self.qr.name, "serve_ring", occ, self.capacity,
+                growable=self.capacity < RING_CAP_MAX,
+                config_key="serving.ring.capacity")
         if kick and self._on_highwater is not None:
             # bounded-lag watermark: occupancy crossed high-water, wake
             # the drainer NOW instead of waiting out its interval

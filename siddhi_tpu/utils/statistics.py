@@ -21,6 +21,7 @@ from typing import Dict, Optional
 from ..observability.histogram import LogHistogram, hist_of
 from ..observability.phases import PhaseProfiler
 from ..observability.recompile import RECOMPILES
+from ..observability.stateobs import StateObservatory
 from ..observability.tracing import PipelineTracer
 
 OFF, BASIC, DETAIL = "OFF", "BASIC", "DETAIL"
@@ -54,6 +55,10 @@ class StatisticsManager:
         # clock ns per (query, phase), fed regardless of level — the
         # per-phase budget must survive a BASIC production config
         self.phases = PhaseProfiler()
+        # always-on state observatory (observability/stateobs.py):
+        # occupancy/high-water per sized device structure + key hotness,
+        # fed from host mirrors only — like phases, survives BASIC
+        self.stateobs = StateObservatory()
         self._start = time.time()
 
     def _included(self, path: str) -> bool:
@@ -208,6 +213,7 @@ class StatisticsManager:
                 "shard_hist": dict(self._shard_hist),
                 "counters": dict(self._counters),
                 "phases": self.phases.snapshot(),
+                "stateobs": self.stateobs.snapshot(),
             }
 
     # -- reporting -------------------------------------------------------------
@@ -316,6 +322,7 @@ class StatisticsManager:
             self._counters.clear()
             self._start = time.time()
         self.phases.reset()
+        self.stateobs.reset()
 
 
 class ConsoleReporter:

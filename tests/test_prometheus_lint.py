@@ -103,6 +103,8 @@ def soaked_manager(manager):
     @info(name='q') from S[v > 0] select v insert into Out;
     @info(name='f') from S#window.lengthBatch(4)
     select count() as c insert into C;
+    @info(name='g') from S#window.length(4)
+    select v, count() as c group by v insert into G;
     """)
     rt.start()
     rt2 = manager.create_siddhi_app_runtime("""
@@ -138,9 +140,19 @@ def test_full_exposition_lints(soaked_manager):
     assert families["siddhi_query_latency_seconds"] == "histogram"
     assert families["siddhi_phase_seconds_total"] == "counter"
     assert families["siddhi_phase_dispatches_sampled_total"] == "counter"
+    # the state-observatory families (grouped query 'g' feeds them)
+    assert families["siddhi_state_occupancy"] == "gauge"
+    assert families["siddhi_state_high_water"] == "gauge"
+    assert families["siddhi_key_hotset_share"] == "gauge"
     # phase counters actually sampled for the busy apps (always-on mode)
     assert any(f == "siddhi_phase_seconds_total" and lb.get("phase")
                for f, _, lb, _ in samples)
+    # state samples carry the full (app, query, structure) label set
+    assert any(f == "siddhi_state_high_water" and lb.get("structure")
+               and lb.get("query") and lb.get("app")
+               for f, _, lb, _ in samples)
+    assert any(f == "siddhi_key_hotset_share" and 0 < v <= 1
+               for f, _, _, v in samples)
     # every series key appears at most once per scrape
     keys = [_series_key(s, lb) for _, s, lb, _ in samples]
     assert len(keys) == len(set(keys)), "duplicate series in one scrape"
@@ -199,6 +211,27 @@ def test_counters_monotone_across_scrapes(soaked_manager):
         assert v2[key] >= old, f"counter {key} went backwards"
         grew += v2[key] > old
     assert grew > 0, "traffic between scrapes moved no counter"
+
+
+def test_high_water_gauges_monotone_across_scrapes(soaked_manager):
+    """siddhi_state_high_water is a gauge (it can be adopted from a
+    snapshot, not just incremented) but within one process it must
+    never move backwards — the observatory only max-raises it."""
+    m = soaked_manager
+    _, s1 = _parse(render_prometheus(m.runtimes))
+    for name, rt in m.runtimes.items():
+        for i in range(8):
+            rt.get_input_handler("S").send([i + 1])
+        rt.flush()
+    _, s2 = _parse(render_prometheus(m.runtimes))
+    hwm1 = {_series_key(s, lb): v for f, s, lb, v in s1
+            if f == "siddhi_state_high_water"}
+    hwm2 = {_series_key(s, lb): v for f, s, lb, v in s2
+            if f == "siddhi_state_high_water"}
+    assert hwm1, "no high-water series rendered?"
+    for key, old in hwm1.items():
+        assert key in hwm2, f"high-water series {key} vanished"
+        assert hwm2[key] >= old, f"high-water {key} went backwards"
 
 
 def test_label_escaping_round_trips(manager):
